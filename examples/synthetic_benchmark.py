@@ -1,0 +1,154 @@
+"""Synthetic data-parallel training benchmark.
+
+The TPU-native counterpart of the reference's
+``examples/tensorflow2_synthetic_benchmark.py`` /
+``pytorch_synthetic_benchmark.py``: train a model on synthetic data and
+print images/sec (per chip and total) ± stdev over timed batches.
+
+Usage::
+
+    python examples/synthetic_benchmark.py                  # default MLP
+    python examples/synthetic_benchmark.py --model resnet50 # flagship CNN
+    HOROVOD_TIMELINE=/tmp/tl.json python examples/synthetic_benchmark.py
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="mlp", choices=["mlp", "resnet50"])
+    p.add_argument("--batch-size", type=int, default=32,
+                   help="per-chip batch size")
+    p.add_argument("--num-iters", type=int, default=10)
+    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--num-warmup-batches", type=int, default=3)
+    p.add_argument("--mode", default="pjit", choices=["pjit", "shard_map"])
+    p.add_argument("--adasum", action="store_true")
+    p.add_argument("--fp16-allreduce", action="store_true")
+    p.add_argument("--platform", default=None,
+                   help="force jax platform (cpu for the virtual mesh)")
+    return p.parse_args()
+
+
+def make_model(name: str):
+    if name == "mlp":
+        def init(key):
+            k1, k2, k3 = jax.random.split(key, 3)
+            return {
+                "w1": jax.random.normal(k1, (784, 512)) * 0.05,
+                "b1": jnp.zeros((512,)),
+                "w2": jax.random.normal(k2, (512, 512)) * 0.05,
+                "b2": jnp.zeros((512,)),
+                "w3": jax.random.normal(k3, (512, 10)) * 0.05,
+                "b3": jnp.zeros((10,)),
+            }
+
+        def apply(params, x):
+            x = x.reshape(x.shape[0], -1)
+            x = jax.nn.relu(x @ params["w1"] + params["b1"])
+            x = jax.nn.relu(x @ params["w2"] + params["b2"])
+            return x @ params["w3"] + params["b3"]
+
+        input_shape = (28, 28, 1)
+        return init, apply, input_shape
+
+    from horovod_tpu.models.resnet import ResNet50
+
+    model = ResNet50(num_classes=1000)
+
+    def init(key):
+        x = jnp.zeros((1, 224, 224, 3), jnp.float32)
+        return model.init(key, x, train=False)
+
+    def apply(params, x):
+        return model.apply(params, x, train=False)
+
+    return init, apply, (224, 224, 3)
+
+
+def main():
+    args = parse_args()
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+
+    init, apply, input_shape = make_model(args.model)
+    num_classes = 10 if args.model == "mlp" else 1000
+
+    def loss_fn(params, batch):
+        logits = apply(params, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    mode = args.mode
+    if (args.adasum or args.fp16_allreduce) and mode == "pjit":
+        mode = "shard_map"  # custom reduction/wire format needs explicit mode
+        if hvd.rank() == 0:
+            print("note: --adasum/--fp16-allreduce require the explicit "
+                  "reduction path; switching to --mode shard_map")
+    step = hvd.DistributedTrainStep(
+        loss_fn,
+        optax.sgd(0.01 * hvd.size(), momentum=0.9),
+        mode=mode,
+        op=hvd.Adasum if args.adasum else hvd.Average,
+        compression=hvd.Compression.fp16 if args.fp16_allreduce else None,
+    )
+    params, opt_state = step.init(init(jax.random.PRNGKey(0)))
+
+    global_bs = args.batch_size * hvd.size()
+    rng = np.random.RandomState(0)
+    batch = step.shard_batch({
+        "x": jnp.asarray(rng.rand(global_bs, *input_shape), jnp.float32),
+        "y": jnp.asarray(rng.randint(0, num_classes, (global_bs,)), jnp.int32),
+    })
+
+    if hvd.rank() == 0:
+        print(f"Model: {args.model}")
+        print(f"Batch size: {args.batch_size} per chip "
+              f"({global_bs} global, {hvd.size()} chips)")
+        print(f"Mode: {mode}"
+              + (" + adasum" if args.adasum else "")
+              + (" + fp16-allreduce" if args.fp16_allreduce else ""))
+
+    # warmup (compile)
+    t0 = time.perf_counter()
+    for _ in range(args.num_warmup_batches):
+        params, opt_state, loss = step(params, opt_state, batch)
+    jax.block_until_ready(loss)
+    if hvd.rank() == 0:
+        print(f"Warmup (incl. compile): {time.perf_counter() - t0:.1f}s, "
+              f"loss={float(loss):.4f}")
+
+    img_secs = []
+    for it in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            params, opt_state, loss = step(params, opt_state, batch)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        img_sec = global_bs * args.num_batches_per_iter / dt
+        img_secs.append(img_sec)
+        if hvd.rank() == 0:
+            print(f"Iter #{it}: {img_sec:.1f} img/sec total")
+
+    if hvd.rank() == 0:
+        mean, std = np.mean(img_secs), np.std(img_secs)
+        print(f"Img/sec per chip: {mean / hvd.size():.1f} +- "
+              f"{1.96 * std / hvd.size():.1f}")
+        print(f"Total img/sec on {hvd.size()} chip(s): "
+              f"{mean:.1f} +- {1.96 * std:.1f}")
+        print(f"Final loss: {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

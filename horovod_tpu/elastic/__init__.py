@@ -1,0 +1,20 @@
+"""Elastic (fault-tolerant, dynamic-world-size) training.
+
+Reference: ``horovod/common/elastic.py`` (framework-independent State and
+retry loop), ``horovod/runner/elastic/`` (driver, discovery, registration,
+rendezvous).  The semantics preserved exactly: ``state.sync()`` →
+``train(state)`` → on ``HorovodInternalError`` restore to last commit / on
+``HostsUpdatedInterrupt`` keep going → ``reset()`` → ``on_reset()`` →
+retry.  The TPU-specific hard part — XLA compiles for a static world — is
+handled in ``reset()``: the runtime is shut down, jax.distributed
+re-initialized against the new rendezvous, meshes rebuilt, and all jitted
+collectives recompile on first use (caches are invalidated here).
+"""
+
+from horovod_tpu.elastic.state import ObjectState, State, TpuState, run
+from horovod_tpu.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+
+__all__ = [
+    "State", "ObjectState", "TpuState", "run",
+    "HorovodInternalError", "HostsUpdatedInterrupt",
+]

@@ -1,0 +1,247 @@
+"""Framework-independent elastic state + retry loop.
+
+Reference: ``horovod/common/elastic.py`` — ``State`` (commit/
+check_host_updates:60-93), ``ObjectState:112``, ``run_fn`` retry loop
+(:147-168); TF/torch specializations in ``tensorflow/elastic.py`` /
+``torch/elastic.py``.  ``TpuState`` is the JAX specialization: model
+params + optimizer state are pytrees, so save/restore is a host-side
+pytree copy and ``sync()`` is a ``broadcast_variables`` from rank 0.
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from horovod_tpu.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from horovod_tpu.utils import logging as hvd_logging
+
+
+class State:
+    """Base elastic state (reference ``common/elastic.py:State``).
+
+    Subclasses implement ``save``/``restore``/``sync``.  ``commit()``
+    persists a known-good snapshot and then checks for host changes;
+    ``check_host_updates()`` alone is the cheap between-batch probe.
+    """
+
+    def __init__(self, **kwargs):
+        self._host_messages: "queue.Queue" = queue.Queue()
+        self._last_updated_timestamp = 0
+        self._reset_callbacks = []
+
+    def register_reset_callbacks(self, callbacks) -> None:
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        self._host_messages = queue.Queue()
+        self.reset()
+        for callback in self._reset_callbacks:
+            callback()
+
+    def on_hosts_updated(self, timestamp, update_res=None) -> None:
+        """Called by the worker notification service when the driver reports
+        a host-set change (reference ``elastic.py:54``)."""
+        self._host_messages.put((timestamp, update_res))
+
+    def commit(self) -> None:
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self) -> None:
+        """Raise ``HostsUpdatedInterrupt`` if new hosts arrived/left; all
+        workers agree on the decision via a max-allreduce of the newest
+        timestamp they saw (reference ``elastic.py:70-93``)."""
+        last_updated_timestamp = prev_timestamp = self._last_updated_timestamp
+        all_update_res = 0
+        while not self._host_messages.empty():
+            timestamp, update_res = self._host_messages.get()
+            if timestamp > last_updated_timestamp:
+                last_updated_timestamp = timestamp
+                if update_res:
+                    all_update_res |= int(update_res)
+
+        # coordinate the view across workers so everyone interrupts together
+        prev_timestamp, last_updated_timestamp, all_update_res = \
+            self._sync_host_updates(prev_timestamp, last_updated_timestamp,
+                                    all_update_res)
+
+        if last_updated_timestamp > prev_timestamp:
+            self._last_updated_timestamp = last_updated_timestamp
+            raise HostsUpdatedInterrupt(all_update_res == 0)
+
+    def _sync_host_updates(self, prev_ts, last_ts, update_res):
+        from horovod_tpu.ops import eager
+        from horovod_tpu.ops.collectives import ReduceOp
+
+        if eager.process_mesh().devices.size == 1:
+            return prev_ts, last_ts, update_res
+        import jax.numpy as jnp
+
+        agreed = eager.allreduce(
+            jnp.asarray([last_ts, update_res], jnp.int64),
+            op=ReduceOp.MAX, name="elastic.host_updates")
+        return prev_ts, int(agreed[0]), int(agreed[1])
+
+    # -- to implement -------------------------------------------------------
+
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+
+class ObjectState(State):
+    """Elastic state for arbitrary picklable attributes (reference
+    ``elastic.py:112``): everything passed as kwargs becomes a synced,
+    commit/restorable attribute."""
+
+    def __init__(self, bcast_object: Optional[Callable] = None, **kwargs):
+        if bcast_object is None:
+            from horovod_tpu.functions import broadcast_object
+
+            bcast_object = broadcast_object
+        self._bcast_object = bcast_object
+        self._saved_state: Dict[str, Any] = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        super().__init__()
+
+    def save(self) -> None:
+        new_state = {}
+        for attr in self._saved_state.keys():
+            new_state[attr] = copy.deepcopy(getattr(self, attr))
+        self._saved_state = new_state
+
+    def restore(self) -> None:
+        for attr, value in self._saved_state.items():
+            setattr(self, attr, copy.deepcopy(value))
+
+    def sync(self) -> None:
+        if self._saved_state:
+            synced = self._bcast_object(self._saved_state, root_rank=0)
+            for attr, value in synced.items():
+                setattr(self, attr, value)
+                self._saved_state[attr] = copy.deepcopy(value)
+
+
+class TpuState(ObjectState):
+    """JAX/TPU elastic state: pytree model+optimizer state with host-side
+    snapshots (the analogue of ``TensorFlowKerasState`` /
+    ``TorchState``).
+
+    ``params``/``opt_state`` (and any extra kwargs) are committed as numpy
+    host copies — cheap, device-memory-free snapshots — and restored /
+    rank-0-broadcast as pytrees.
+    """
+
+    def __init__(self, params=None, opt_state=None, **kwargs):
+        super().__init__(params=params, opt_state=opt_state, **kwargs)
+
+    def save(self) -> None:
+        new_state = {}
+        for attr in self._saved_state.keys():
+            val = getattr(self, attr)
+            new_state[attr] = jax.tree_util.tree_map(
+                lambda x: np.asarray(x) if hasattr(x, "shape") else
+                copy.deepcopy(x), val)
+        self._saved_state = new_state
+
+    def restore(self) -> None:
+        for attr, value in self._saved_state.items():
+            setattr(self, attr, value)
+
+    def sync(self) -> None:
+        from horovod_tpu.functions import broadcast_variables
+
+        for attr in list(self._saved_state.keys()):
+            val = getattr(self, attr)
+            if val is None:
+                continue
+            is_tree = any(hasattr(l, "shape")
+                          for l in jax.tree_util.tree_leaves(val))
+            if is_tree:
+                synced = broadcast_variables(val, root_rank=0,
+                                             name=f"elastic.sync.{attr}")
+            else:
+                synced = self._bcast_object(val, root_rank=0,
+                                            name=f"elastic.sync.{attr}")
+            setattr(self, attr, synced)
+        self.save()
+
+
+def run(func: Callable) -> Callable:
+    """Elastic run decorator (reference ``run_fn``, ``elastic.py:147-168``)::
+
+        @hvd.elastic.run
+        def train(state, ...):
+            ...
+
+        train(state)
+
+    Loop: notification init → ``state.sync()`` → ``func(state)``; on
+    ``HorovodInternalError`` restore committed state, on
+    ``HostsUpdatedInterrupt`` continue with live state; then ``reset()``
+    (runtime re-init over the new world) and retry.
+    """
+
+    def wrapper(state: State, *args, **kwargs):
+        from horovod_tpu.elastic.worker import init_notification_manager
+
+        notification_manager = init_notification_manager()
+        if notification_manager is not None:
+            notification_manager.register_listener(state)
+
+        skip_sync = False
+        try:
+            while True:
+                if not skip_sync:
+                    state.sync()
+                try:
+                    return func(state, *args, **kwargs)
+                except HorovodInternalError:
+                    hvd_logging.warning(
+                        "elastic: collective failure — restoring last "
+                        "committed state and re-initializing")
+                    state.restore()
+                    skip_sync = False
+                except HostsUpdatedInterrupt as e:
+                    hvd_logging.info(
+                        "elastic: host set changed — re-initializing")
+                    skip_sync = e.skip_sync
+                _reset()
+                state.on_reset()
+        finally:
+            if notification_manager is not None:
+                notification_manager.remove_listener(state)
+
+    return wrapper
+
+
+def _reset() -> None:
+    """Tear down and re-initialize the runtime for a changed world.
+
+    The TPU-specific fidelity point (SURVEY §7 hard part #1): XLA programs
+    are compiled for a fixed mesh, so a world change means shutdown,
+    re-rendezvous via jax.distributed, mesh rebuild, and recompilation of
+    every jitted collective — accomplished by clearing the compiled-fn
+    caches so first use recompiles against the new mesh.
+    """
+    from horovod_tpu.ops import eager
+    from horovod_tpu.runtime import state as rt_state
+
+    rt_state.shutdown()
+    eager._reset_mesh_cache()
+    eager._reducer_cache.clear()
+    rt_state.init()

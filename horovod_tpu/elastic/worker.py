@@ -1,0 +1,89 @@
+"""In-worker notification service for elastic host updates.
+
+Reference: ``horovod/runner/elastic/worker.py`` — each worker runs a tiny
+service the driver pings with ``HostsUpdatedRequest``; the notification
+manager fans the timestamp out to registered ``State`` listeners, which
+turn it into ``HostsUpdatedInterrupt`` at the next ``commit()``/
+``check_host_updates()``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional
+
+from horovod_tpu.utils import logging as hvd_logging
+
+
+class WorkerNotificationManager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._listeners: List = []
+        self._service: Optional["WorkerNotificationService"] = None
+
+    def init(self) -> None:
+        if self._service is not None:
+            return
+        secret_key = os.environ.get("HOROVOD_SECRET_KEY")
+        addr = os.environ.get("HOROVOD_ELASTIC_NOTIFY_ADDR")
+        if addr:
+            self._service = WorkerNotificationService(self, secret_key)
+            self._service.start()
+            # register our address with the driver so it can notify us
+            driver_addr = os.environ.get("HOROVOD_ELASTIC_DRIVER_ADDR")
+            if driver_addr:
+                from horovod_tpu.runner.network import notify_worker_registered
+
+                notify_worker_registered(driver_addr, self._service.address,
+                                         secret_key)
+
+    def register_listener(self, listener) -> None:
+        with self._lock:
+            self._listeners.append(listener)
+
+    def remove_listener(self, listener) -> None:
+        with self._lock:
+            if listener in self._listeners:
+                self._listeners.remove(listener)
+
+    def handle_hosts_updated(self, timestamp: int, update_res=None) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+        for listener in listeners:
+            listener.on_hosts_updated(timestamp, update_res)
+        hvd_logging.debug("elastic: hosts-updated notification ts=%s",
+                          timestamp)
+
+
+class WorkerNotificationService:
+    """TCP listener receiving HostsUpdated pings (lazy import of runner
+    network layer; constructed only under an elastic launcher)."""
+
+    def __init__(self, manager: WorkerNotificationManager, secret_key):
+        from horovod_tpu.runner.network import NotificationServer
+
+        self._server = NotificationServer(manager, secret_key)
+
+    def start(self) -> None:
+        self._server.start()
+
+    @property
+    def address(self):
+        return self._server.address
+
+
+_manager: Optional[WorkerNotificationManager] = None
+_manager_lock = threading.Lock()
+
+
+def init_notification_manager() -> Optional[WorkerNotificationManager]:
+    global _manager
+    with _manager_lock:
+        if _manager is None:
+            _manager = WorkerNotificationManager()
+            try:
+                _manager.init()
+            except Exception as e:  # non-elastic runs have no driver
+                hvd_logging.debug("notification manager init skipped: %s", e)
+        return _manager

@@ -1,0 +1,100 @@
+"""State-synchronization helpers over pytrees and Python objects.
+
+Reference: ``horovod/tensorflow/functions.py`` (``broadcast_variables:47``,
+``broadcast_object:59``, ``allgather_object:136``) and
+``horovod/torch/functions.py`` (``broadcast_parameters:30``,
+``broadcast_optimizer_state:62``).  JAX model state is a pytree, so all
+four collapse to pytree-walking wrappers over the eager collectives.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.ops import eager
+
+
+def broadcast_variables(variables, root_rank: int = 0, name: Optional[str] = None):
+    """Broadcast a pytree of arrays from ``root_rank`` to all processes
+    (reference ``broadcast_variables`` / the post-restore sync in the
+    5-line recipe, ``tensorflow/functions.py:47``).
+
+    Single-process SPMD note: with one process there is nothing to sync —
+    all chips already read the same host values; returns input unchanged.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(variables)
+    prefix = name or "broadcast_variables"
+    out = [eager.broadcast(leaf, root_rank, name=f"{prefix}.{i}")
+           for i, leaf in enumerate(leaves)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# torch-flavored alias (reference torch/functions.py:30)
+def broadcast_parameters(params, root_rank: int = 0):
+    return broadcast_variables(params, root_rank=root_rank,
+                               name="broadcast_parameters")
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0):
+    """Broadcast optimizer state (reference ``torch/functions.py:62`` walks
+    the torch state dict; optax state is already a pytree)."""
+    return broadcast_variables(opt_state, root_rank=root_rank,
+                               name="broadcast_optimizer_state")
+
+
+def _obj_to_bytes_tensor(obj: Any) -> jnp.ndarray:
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    return jnp.frombuffer(np.frombuffer(buf.getvalue(), np.uint8), jnp.uint8)
+
+
+def _bytes_tensor_to_obj(t) -> Any:
+    return pickle.loads(np.asarray(t).tobytes())
+
+
+def broadcast_object(obj: Any = None, root_rank: int = 0,
+                     name: Optional[str] = None) -> Any:
+    """Serialize an arbitrary Python object on ``root_rank`` and broadcast
+    it (reference ``tensorflow/functions.py:59`` / ``torch/functions.py``:
+    pickle → length bcast → payload bcast)."""
+    name = name or "broadcast_object"
+    if eager.process_mesh().devices.size == 1:
+        return obj
+    if jax.process_index() == root_rank:
+        payload = _obj_to_bytes_tensor(obj)
+        length = jnp.asarray([payload.size], jnp.int64)
+    else:
+        payload = jnp.zeros((0,), jnp.uint8)
+        length = jnp.asarray([0], jnp.int64)
+    length = eager.broadcast(length, root_rank, name=f"{name}.len")
+    n = int(length[0])
+    if jax.process_index() != root_rank:
+        payload = jnp.zeros((n,), jnp.uint8)
+    payload = eager.broadcast(payload, root_rank, name=f"{name}.payload")
+    return _bytes_tensor_to_obj(payload)
+
+
+def allgather_object(obj: Any, name: Optional[str] = None) -> list:
+    """Gather one Python object per process into an ordered list (reference
+    ``tensorflow/functions.py:136``)."""
+    name = name or "allgather_object"
+    nproc = eager.process_mesh().devices.size
+    if nproc == 1:
+        return [obj]
+    payload = _obj_to_bytes_tensor(obj)
+    gathered = eager.allgather(payload, name=name)       # concatenated bytes
+    sizes = eager.allgather(jnp.asarray([payload.size], jnp.int64),
+                            name=f"{name}.sizes")
+    out, off = [], 0
+    sizes_np = np.asarray(sizes)
+    for p in range(nproc):
+        n = int(sizes_np[p])
+        out.append(_bytes_tensor_to_obj(gathered[off:off + n]))
+        off += n
+    return out

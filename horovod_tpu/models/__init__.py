@@ -1,0 +1,11 @@
+"""Model zoo for benchmarks and examples.
+
+The reference ships its benchmark models via ``tf.keras.applications`` /
+``torchvision.models`` in ``examples/*_synthetic_benchmark.py``; this
+package provides the TPU-native (flax, NHWC, bf16-friendly) equivalents
+used by ``examples/`` and ``bench.py``.
+"""
+
+from horovod_tpu.models.resnet import ResNet50, ResNet101, ResNet152
+
+__all__ = ["ResNet50", "ResNet101", "ResNet152"]

@@ -1,0 +1,40 @@
+"""Collective operations: SPMD primitives, eager API, fusion, compression.
+
+Layer map (vs reference ``horovod/common/ops/``):
+
+* :mod:`~horovod_tpu.ops.collectives` — in-mesh XLA collectives (the
+  NCCL/MPI op implementations' replacement).
+* :mod:`~horovod_tpu.ops.eager` — host-level named-tensor API with async
+  handles (the enqueue API + framework-binding replacement).
+* :mod:`~horovod_tpu.ops.bucketing` — tensor fusion for eager submissions.
+* :mod:`~horovod_tpu.ops.adasum` — adaptive-summation reduction.
+* :mod:`~horovod_tpu.ops.compression` — fp16/bf16 wire compression.
+"""
+
+from horovod_tpu.ops.collectives import (
+    Adasum,
+    Average,
+    ReduceOp,
+    Sum,
+)
+from horovod_tpu.ops.compression import Compression
+from horovod_tpu.ops.eager import (
+    Handle,
+    HorovodInternalError,
+    allgather,
+    allreduce,
+    allreduce_async,
+    alltoall,
+    barrier,
+    broadcast,
+    join,
+    poll,
+    synchronize,
+)
+
+__all__ = [
+    "Adasum", "Average", "ReduceOp", "Sum", "Compression",
+    "Handle", "HorovodInternalError",
+    "allreduce", "allreduce_async", "allgather", "alltoall", "barrier",
+    "broadcast", "join", "poll", "synchronize",
+]

@@ -1,0 +1,123 @@
+"""Adasum: adaptive-summation gradient reduction, TPU formulation.
+
+The reference implements Adasum (arXiv 2006.02924) as a templated C++
+recursive-halving allreduce (``horovod/common/ops/adasum/adasum.h`` —
+``FusedAllreduce`` with per-layer ``ComputeDotAndNormSqrds``; MPI variant
+``adasum_mpi.{h,cc}`` builds log2(N) nested reduction communicators; GPU
+variant ``adasum_gpu_operations.cc:38`` does NCCL reduce-scatter inside the
+node, Adasum-MPI across nodes, NCCL allgather back).
+
+The pairwise rule, per layer: given gradients ``a``, ``b``,
+
+    a' = (1 - a.b / (2|a|^2)) * a  +  (1 - a.b / (2|b|^2)) * b
+
+which is ``a+b`` for orthogonal gradients and the average for parallel
+ones — summation that adapts to gradient correlation.
+
+TPU formulation: recursive *doubling* over a mesh axis with
+``lax.ppermute`` (XOR-partner exchange, log2(N) rounds).  Each round
+exchanges the full vector and both partners apply the symmetric rule, so
+all shards converge to the identical result — no separate allgather-back
+phase.  Dots/norms are elementwise-multiply + psum-free local reductions
+(vectors are full after exchange), computed in fp32 regardless of input
+dtype (the reference's fp16 path does the same accumulation widening,
+``adasum.h:107``).
+
+Hierarchy: for the (dcn, ici) runtime mesh we mirror the reference GPU
+dispatch — plain *average* inside the ici axis (postscale ``1/local_size``,
+``operations.cc:859-866``), Adasum across the dcn axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.runtime.topology import AXIS_DCN, AXIS_ICI, GLOBAL_AXES
+
+AxisSpec = Union[str, Sequence[str]]
+
+
+def _combine(a: jax.Array, b: jax.Array) -> jax.Array:
+    """One pairwise Adasum combine (reference ``adasum.h`` coefficient
+    computation inside ``FusedAllreduce``)."""
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    dot = jnp.vdot(af, bf)
+    anormsq = jnp.vdot(af, af)
+    bnormsq = jnp.vdot(bf, bf)
+    acoeff = jnp.where(anormsq >= 1e-30, 1.0 - dot / (2.0 * anormsq + 1e-30), 1.0)
+    bcoeff = jnp.where(bnormsq >= 1e-30, 1.0 - dot / (2.0 * bnormsq + 1e-30), 1.0)
+    return (acoeff * af + bcoeff * bf).astype(a.dtype)
+
+
+def _combine_many(azs: list, bzs: list) -> list:
+    """Per-tensor (per-layer) combine for fused calls — each tensor gets its
+    own dot/norm, matching the per-layer semantics of
+    ``ComputeDotAndNormSqrds`` over the fusion buffer's tensor table."""
+    return [_combine(a, b) for a, b in zip(azs, bzs)]
+
+
+def _is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def _adasum_axis(xs: list, axis: str) -> list:
+    """Adasum over one named mesh axis for a list of tensors."""
+    n = lax.axis_size(axis)
+    if n == 1:
+        return xs
+    if _is_pow2(n):
+        rounds = n.bit_length() - 1
+        for r in range(rounds):
+            dist = 1 << r
+            perm = [(i, i ^ dist) for i in range(n)]
+            partners = [lax.ppermute(x, axis, perm=perm) for x in xs]
+            xs = _combine_many(xs, partners)
+        return xs
+    # Non-power-of-two fallback: gather everything and run the identical
+    # binary-tree reduction on every shard (replicated compute, one
+    # all_gather of bandwidth — acceptable for the uncommon world sizes the
+    # reference also special-cases).
+    out = []
+    for x in xs:
+        stacked = lax.all_gather(x, axis, tiled=False)  # (n, ...)
+        vals = [stacked[i] for i in range(n)]
+        while len(vals) > 1:
+            nxt = []
+            for i in range(0, len(vals) - 1, 2):
+                nxt.append(_combine(vals[i], vals[i + 1]))
+            if len(vals) % 2:
+                nxt.append(vals[-1])
+            vals = nxt
+        out.append(vals[0])
+    return out
+
+
+def adasum_grouped_allreduce(xs: Sequence[jax.Array],
+                             axis: AxisSpec = GLOBAL_AXES) -> list:
+    """Adasum-reduce a group of tensors with per-tensor coefficients.
+
+    Multi-axis (dcn, ici) dispatch mirrors ``AdasumGpuAllreduceOp::Execute``
+    (``adasum_gpu_operations.cc:38``): average within ici, Adasum across dcn.
+    """
+    xs = list(xs)
+    if isinstance(axis, str):
+        return _adasum_axis(xs, axis)
+    axes = tuple(axis)
+    if len(axes) == 1:
+        return _adasum_axis(xs, axes[0])
+    if axes != GLOBAL_AXES and set(axes) != set(GLOBAL_AXES):
+        raise ValueError(f"adasum over unsupported axis tuple {axes}")
+    local_n = lax.axis_size(AXIS_ICI)
+    xs = [lax.psum(x, AXIS_ICI) / local_n for x in xs]
+    return _adasum_axis(xs, AXIS_DCN)
+
+
+def adasum_allreduce(x: jax.Array, axis: AxisSpec = GLOBAL_AXES) -> jax.Array:
+    """Single-tensor Adasum allreduce (request type ADASUM,
+    ``message.h:51``; dispatched from :func:`horovod_tpu.ops.collectives.allreduce`)."""
+    return adasum_grouped_allreduce([x], axis=axis)[0]

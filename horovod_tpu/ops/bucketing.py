@@ -1,0 +1,127 @@
+"""Tensor fusion for the eager path — the fusion buffer, compiler-era.
+
+The reference's headline optimization is Tensor Fusion: the background
+loop packs every gradient that became ready within one cycle (default
+5 ms) into a 64 MiB fusion buffer and runs a single collective
+(``fusion_buffer_manager.{h,cc}``, threshold default
+``operations.cc:432``, packing in ``controller.cc:686 FuseResponses``).
+
+Eager async submissions here accumulate in per-(op, dtype, scale) buckets —
+the same grouping key ``FuseResponses`` uses (response type, devices,
+dtype, ``controller.cc:720-745``) — and flush as ONE concatenated
+collective when any of the reference's triggers fires:
+
+* accumulated bytes reach ``HOROVOD_FUSION_THRESHOLD`` (64 MiB default);
+* a ``synchronize()``/``poll()`` needs a pending result (drain, like the
+  reference's shutdown/stall drain paths).
+
+Flush points deliberately depend ONLY on program order (submission
+sequence, byte counts), never on wall-clock timers: every process must
+fuse the *same* tensor set into the same collective or the global
+computations diverge — the invariant the reference's controller
+negotiation establishes with ``FuseResponses`` and that SPMD gets for
+free as long as flush decisions are deterministic.  ``HOROVOD_CYCLE_TIME``
+is therefore advisory on TPU (autotune may still tune it for telemetry
+parity), not a flush trigger.
+
+There is no double memcpy: concatenation happens on device inside the same
+jitted program as the reduction, so XLA fuses pack + collective + unpack.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from horovod_tpu.runtime import state
+from horovod_tpu.utils import timeline as tl
+
+
+class _Entry:
+    __slots__ = ("name", "tensor", "op", "prescale", "postscale", "handle",
+                 "nbytes")
+
+    def __init__(self, name, tensor, op, prescale, postscale, handle):
+        self.name = name
+        self.tensor = tensor
+        self.op = op
+        self.prescale = prescale
+        self.postscale = postscale
+        self.handle = handle
+        self.nbytes = tensor.size * tensor.dtype.itemsize
+
+
+class Bucketer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buckets: Dict[tuple, List[_Entry]] = {}
+        self._bytes: Dict[tuple, int] = {}
+
+    def _config(self):
+        if state.is_initialized():
+            return state.global_state().config
+        from horovod_tpu.runtime.config import Config
+
+        return Config()
+
+    def add(self, name, tensor, op, prescale, postscale, handle) -> None:
+        from horovod_tpu.ops.eager import _dispatch_group
+
+        cfg = self._config()
+        e = _Entry(name, tensor, op, prescale, postscale, handle)
+        key = (op, str(tensor.dtype), prescale, postscale)
+        group = None
+        with self._lock:
+            self._buckets.setdefault(key, []).append(e)
+            self._bytes[key] = self._bytes.get(key, 0) + e.nbytes
+            # deterministic trigger only: byte threshold in submission order
+            if self._bytes[key] >= max(cfg.fusion_threshold_bytes, 1):
+                group = self._take(key)
+        if group:
+            self._mark_cycle()
+            _dispatch_group(group)
+            self._record_autotune(group)
+
+    def _take(self, key) -> List[_Entry]:
+        entries = self._buckets.pop(key, [])
+        self._bytes.pop(key, None)
+        return entries
+
+    def flush(self) -> None:
+        """Drain all pending buckets in insertion order
+        (synchronize/poll/shutdown path) — insertion order is program
+        order, so the drain is cross-process deterministic too."""
+        from horovod_tpu.ops.eager import _dispatch_group
+
+        with self._lock:
+            groups = [self._take(k) for k in list(self._buckets)]
+        for g in groups:
+            if g:
+                self._mark_cycle()
+                _dispatch_group(g)
+                self._record_autotune(g)
+
+    def _mark_cycle(self) -> None:
+        if state.is_initialized():
+            tline = state.global_state().timeline
+            if tline is not None:
+                tline.mark_cycle_start()
+
+    def _record_autotune(self, group) -> None:
+        if state.is_initialized():
+            pm = state.global_state().parameter_manager
+            if pm is not None and pm.active:
+                pm.record_bytes(sum(e.nbytes for e in group))
+
+
+_bucketer: Optional[Bucketer] = None
+_bucketer_lock = threading.Lock()
+
+
+def global_bucketer() -> Bucketer:
+    global _bucketer
+    if _bucketer is None:
+        with _bucketer_lock:
+            if _bucketer is None:
+                _bucketer = Bucketer()
+    return _bucketer

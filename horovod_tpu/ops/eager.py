@@ -1,0 +1,479 @@
+"""Eager host-level collectives with Horovod's API shape.
+
+The reference's user surface (``horovod/torch/mpi_ops.py``,
+``tensorflow/mpi_ops.py``) is *eager per-tensor*: each call enqueues one
+named tensor into the C++ background loop which negotiates, fuses and
+executes (``operations.cc:840-1068``).  The TPU replacement keeps the
+call shape — ``allreduce``/``allreduce_async``/``synchronize``/``poll``,
+named tensors, pre/postscale, Average/Sum/Adasum — but the machinery
+underneath is re-rooted:
+
+* *world* = JAX processes (one multi-chip host process each).  Tensors are
+  lifted into a global array sharded over a one-device-per-process "proc"
+  mesh and reduced by a jitted SPMD computation; XLA runs the collective
+  over ICI/DCN.  With a single process the ops reduce to local scaling.
+* *async* = JAX's dispatch-and-return execution: a handle wraps the
+  not-yet-materialized output array — the role the reference's handle
+  manager plays for torch (``torch/handle_manager.{h,cc}``,
+  ``mpi_ops.py:590-627 poll/synchronize``).
+* *fusion* = the :class:`~horovod_tpu.ops.bucketing.Bucketer`: async
+  submissions accumulate and flush as one grouped collective per dtype
+  (see ``bucketing.py`` for the fusion-buffer mapping).
+
+In-jit training code should use ``horovod_tpu.ops.collectives`` directly;
+this module is for host-side orchestration (metric averaging, parameter
+broadcast, object exchange) and API familiarity.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from horovod_tpu.ops.collectives import Adasum, Average, ReduceOp, Sum
+from horovod_tpu.ops import adasum as adasum_mod
+from horovod_tpu.runtime import state
+from horovod_tpu.utils import logging as hvd_logging
+from horovod_tpu.utils import timeline as tl
+
+# Reference error text: common.h:163 DUPLICATE_NAME_ERROR
+_DUPLICATE_NAME_ERROR = (
+    "Requested to collect a tensor with the same name as another tensor "
+    "that is currently being processed. If you want to request another "
+    "tensor, use a different tensor name.")
+
+
+# Collective failures raise HorovodInternalError; elastic mode catches it
+# and restores state (reference ``common/exceptions.py:18``).
+from horovod_tpu.exceptions import HorovodInternalError  # noqa: E402
+
+
+_lock = threading.Lock()
+_in_flight: dict = {}
+_name_counter = 0
+_proc_mesh: Optional[Mesh] = None
+
+
+def _next_name(prefix: str) -> str:
+    global _name_counter
+    with _lock:
+        _name_counter += 1
+        return f"{prefix}.noname.{_name_counter}"
+
+
+def process_mesh() -> Mesh:
+    """One-device-per-process mesh: the eager ops' communicator.
+
+    The analogue of the reference's GLOBAL communicator over worker
+    processes (``common.h:113``)."""
+    global _proc_mesh
+    if _proc_mesh is None:
+        by_proc: dict = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, d)
+        devs = [by_proc[p] for p in sorted(by_proc)]
+        _proc_mesh = Mesh(np.array(devs), ("proc",))
+    return _proc_mesh
+
+
+def _reset_mesh_cache() -> None:
+    global _proc_mesh
+    _proc_mesh = None
+
+
+def _lift(tensor: jax.Array) -> jax.Array:
+    """Lift this process's tensor into a (nproc, ...) global array sharded
+    one-row-per-process."""
+    mesh = process_mesh()
+    nproc = mesh.devices.size
+    local = jnp.asarray(tensor)[None]
+    sharding = NamedSharding(mesh, P("proc", *([None] * tensor.ndim)))
+    if nproc == 1:
+        return jax.device_put(local, sharding)
+    my_dev = mesh.devices.flat[jax.process_index()]
+    return jax.make_array_from_single_device_arrays(
+        (nproc,) + tuple(tensor.shape), sharding,
+        [jax.device_put(local, my_dev)])
+
+
+def _replicated(mesh: Mesh):
+    return NamedSharding(mesh, P())
+
+
+_reducer_cache: dict = {}
+
+
+def _reduce_global(garr, op: ReduceOp, prescale, postscale, nproc: int,
+                   segments: tuple = ()):
+    """jit-compiled reduction over the proc mesh with replicated output;
+    compiled once per (op, scales, segments) — jax.jit memoizes per
+    shape/dtype (the response-cache analogue, ``response_cache.{h,cc}``).
+
+    ``segments`` (tuple of flat lengths) marks per-tensor boundaries inside
+    a fused buffer; only Adasum consumes it — its dot/norm coefficients are
+    per layer, never over the whole fusion buffer (reference
+    ``ComputeDotAndNormSqrds`` walks the tensor table per entry).
+    """
+    mesh = process_mesh()
+    key = (id(mesh), op, prescale, postscale, nproc, segments)
+    fn = _reducer_cache.get(key)
+    st = state.global_state() if state.is_initialized() else None
+    if fn is None:
+        fn = jax.jit(
+            partial(_reduce_impl, op=op, prescale=prescale,
+                    postscale=postscale, nproc=nproc, segments=segments),
+            out_shardings=_replicated(mesh))
+        _reducer_cache[key] = fn
+        if st:
+            st.cache_stats["misses"] += 1
+    elif st:
+        st.cache_stats["hits"] += 1
+    return fn(garr)
+
+
+def _adasum_tree(rows: list):
+    vals = list(rows)
+    while len(vals) > 1:
+        nxt = [adasum_mod._combine(vals[i], vals[i + 1])
+               for i in range(0, len(vals) - 1, 2)]
+        if len(vals) % 2:
+            nxt.append(vals[-1])
+        vals = nxt
+    return vals[0]
+
+
+def _reduce_impl(garr, *, op: ReduceOp, prescale, postscale, nproc: int,
+                 segments: tuple = ()):
+    x = garr.astype(jnp.float32) if garr.dtype in (jnp.float16, jnp.bfloat16) \
+        and (prescale or postscale) else garr
+    if prescale:
+        x = x * prescale
+    if op == ReduceOp.ADASUM:
+        if segments:
+            outs, off = [], 0
+            for seg in segments:
+                rows = [x[i, off:off + seg] for i in range(nproc)]
+                outs.append(_adasum_tree(rows))
+                off += seg
+            y = jnp.concatenate(outs) if len(outs) > 1 else outs[0]
+        else:
+            y = _adasum_tree([x[i] for i in range(nproc)])
+    elif op == ReduceOp.AVERAGE:
+        y = jnp.mean(x, axis=0)
+    elif op == ReduceOp.SUM:
+        y = jnp.sum(x, axis=0)
+    elif op == ReduceOp.MIN:
+        y = jnp.min(x, axis=0)
+    elif op == ReduceOp.MAX:
+        y = jnp.max(x, axis=0)
+    elif op == ReduceOp.PRODUCT:
+        y = jnp.prod(x, axis=0)
+    else:
+        raise ValueError(f"unsupported op {op}")
+    if postscale:
+        y = y * postscale
+    return y.astype(garr.dtype)
+
+
+class Handle:
+    """Async collective handle (reference torch handle model:
+    ``allreduce_async_`` returns an int handle resolved by
+    ``synchronize()``, ``torch/mpi_ops.py:606``)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._result = None
+        self._done = threading.Event()
+        self._error: Optional[Exception] = None
+
+    def _fulfill(self, result) -> None:
+        self._result = result
+        self._done.set()
+        st = state.global_state() if state.is_initialized() else None
+        if st and st.stall_inspector:
+            st.stall_inspector.record_complete(self.name)
+        with _lock:
+            _in_flight.pop(self.name, None)
+
+    def _fail(self, err: Exception) -> None:
+        self._error = err
+        self._done.set()
+        st = state.global_state() if state.is_initialized() else None
+        if st and st.stall_inspector:
+            st.stall_inspector.record_complete(self.name)
+        with _lock:
+            _in_flight.pop(self.name, None)
+
+
+def _register(name: str, handle: Handle) -> None:
+    with _lock:
+        if name in _in_flight:
+            raise HorovodInternalError(_DUPLICATE_NAME_ERROR + f" (name={name})")
+        _in_flight[name] = handle
+    st = state.global_state() if state.is_initialized() else None
+    if st and st.stall_inspector:
+        st.stall_inspector.record_dispatch(name)
+
+
+# ---------------------------------------------------------------------------
+# public eager ops
+# ---------------------------------------------------------------------------
+
+def allreduce(tensor, average: Optional[bool] = None, name: Optional[str] = None,
+              op: Optional[ReduceOp] = None,
+              prescale_factor: Optional[float] = None,
+              postscale_factor: Optional[float] = None,
+              compression=None):
+    """Synchronous allreduce across worker processes (reference
+    ``horovod/torch/mpi_ops.py:allreduce`` / ``tensorflow/__init__.py:52``)."""
+    h = allreduce_async(tensor, average=average, name=name, op=op,
+                        prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor,
+                        compression=compression)
+    return synchronize(h)
+
+
+def allreduce_async(tensor, average: Optional[bool] = None,
+                    name: Optional[str] = None, op: Optional[ReduceOp] = None,
+                    prescale_factor: Optional[float] = None,
+                    postscale_factor: Optional[float] = None,
+                    compression=None) -> Handle:
+    from horovod_tpu.ops.bucketing import global_bucketer
+
+    if op is None:
+        op = Average if (average is None or average) else Sum
+    name = name or _next_name("allreduce")
+    handle = Handle(name)
+    _register(name, handle)
+    tensor = jnp.asarray(tensor)
+    ctx = None
+    if compression is not None:
+        tensor, ctx = compression.compress(tensor)
+    handle._decompress = (compression, ctx)
+    global_bucketer().add(name, tensor, op, prescale_factor,
+                          postscale_factor, handle)
+    return handle
+
+
+def _dispatch_group(entries) -> None:
+    """Flush callback from the bucketer: one fused collective per flush.
+
+    This is ``PerformOperation`` (``operations.cc:253``) re-rooted: instead
+    of memcpy-into-fusion-buffer + NCCL, we concatenate flat tensors and
+    run one jitted reduction over the proc mesh.
+    """
+    nproc = process_mesh().devices.size
+    with tl.activity(entries[0].name, tl.XLA_ALLREDUCE):
+        try:
+            if len(entries) == 1:
+                e = entries[0]
+                garr = _lift(e.tensor)
+                out = _reduce_global(garr, e.op, e.prescale, e.postscale, nproc)
+                e.handle._fulfill(out)
+                return
+            flat = jnp.concatenate([jnp.ravel(e.tensor) for e in entries])
+            e0 = entries[0]
+            garr = _lift(flat)
+            segments = tuple(int(e.tensor.size) for e in entries) \
+                if e0.op == ReduceOp.ADASUM else ()
+            red = _reduce_global(garr, e0.op, e0.prescale, e0.postscale,
+                                 nproc, segments)
+            off = 0
+            for e in entries:
+                n = e.tensor.size
+                e.handle._fulfill(red[off:off + n].reshape(e.tensor.shape))
+                off += n
+        except Exception as err:  # surface as HorovodInternalError for elastic
+            for e in entries:
+                e.handle._fail(HorovodInternalError(str(err)))
+
+
+def synchronize(handle: Handle):
+    """Block until the handle's collective completed and return the result
+    (reference ``torch/mpi_ops.py:606``)."""
+    from horovod_tpu.ops.bucketing import global_bucketer
+
+    if not handle._done.is_set():
+        global_bucketer().flush()
+    handle._done.wait()
+    if handle._error is not None:
+        raise handle._error
+    result = handle._result
+    compression, ctx = getattr(handle, "_decompress", (None, None))
+    if compression is not None:
+        result = compression.decompress(result, ctx)
+    return jax.block_until_ready(result)
+
+
+def poll(handle: Handle) -> bool:
+    """Non-blocking completion check (reference ``torch/mpi_ops.py:590``).
+
+    Polling an undispatched handle drains the pending buckets first (the
+    reference's background loop would have picked the tensor up within one
+    cycle; with no background thread, the poll itself is the cycle edge —
+    and a deterministic one, since it follows program order on every
+    process)."""
+    if not handle._done.is_set():
+        from horovod_tpu.ops.bucketing import global_bucketer
+
+        global_bucketer().flush()
+    if not handle._done.is_set():
+        return False
+    r = handle._result
+    try:
+        return bool(r.is_ready()) if hasattr(r, "is_ready") else True
+    except Exception:
+        return True
+
+
+def allgather(tensor, name: Optional[str] = None):
+    """Gather tensors from all processes, concatenated on dim 0; first dims
+    may differ per process (reference ``EnqueueTensorAllgather``
+    ``operations.cc:903``, recvcounts in ``mpi_operations.cc:96``)."""
+    name = name or _next_name("allgather")
+    tensor = jnp.asarray(tensor)
+    mesh = process_mesh()
+    nproc = mesh.devices.size
+    if nproc == 1:
+        return tensor
+    handle = Handle(name)
+    _register(name, handle)
+    try:
+        with tl.activity(name, tl.XLA_ALLGATHER):
+            # negotiate first-dim sizes (the controller's recvcount exchange)
+            sizes = _allgather_host_metadata(
+                np.asarray([tensor.shape[0]], np.int64))
+            max_rows = int(sizes.max())
+            pad = jnp.zeros((max_rows,) + tensor.shape[1:], tensor.dtype)
+            pad = pad.at[:tensor.shape[0]].set(tensor)
+            garr = _lift(pad)   # (nproc, max_rows, ...)
+            rep = jax.jit(lambda g: g, out_shardings=_replicated(mesh))(garr)
+            parts = [rep[p, :int(sizes[p])] for p in range(nproc)]
+            out = jnp.concatenate(parts, axis=0)
+            handle._fulfill(out)
+    except Exception as err:
+        handle._fail(HorovodInternalError(str(err)))
+    return synchronize(handle)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    """Broadcast from ``root_rank`` process to all (reference
+    ``EnqueueTensorBroadcast``, ``operations.cc:928``)."""
+    name = name or _next_name("broadcast")
+    tensor = jnp.asarray(tensor)
+    mesh = process_mesh()
+    nproc = mesh.devices.size
+    if nproc == 1:
+        return tensor
+    handle = Handle(name)
+    _register(name, handle)
+    try:
+        with tl.activity(name, tl.XLA_BROADCAST):
+            garr = _lift(tensor)
+            out = jax.jit(lambda g: g[root_rank],
+                          out_shardings=_replicated(mesh))(garr)
+            handle._fulfill(out)
+    except Exception as err:
+        handle._fail(HorovodInternalError(str(err)))
+    return synchronize(handle)
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None):
+    """Distribute slices of dim 0 to all processes (reference
+    ``EnqueueTensorAlltoall``, ``operations.cc:979``).  ``splits[i]`` rows go
+    to process i; uniform split when ``splits`` is None.  Returns the
+    concatenation of slices received from every process."""
+    name = name or _next_name("alltoall")
+    tensor = jnp.asarray(tensor)
+    mesh = process_mesh()
+    nproc = mesh.devices.size
+    if splits is None:
+        if tensor.shape[0] % nproc != 0:
+            raise ValueError(
+                "tensor dim 0 not divisible by world size; pass splits")
+        splits = np.full((nproc,), tensor.shape[0] // nproc, np.int64)
+    splits = np.asarray(splits, np.int64)
+    if splits.sum() != tensor.shape[0]:
+        raise ValueError("splits must sum to tensor.shape[0]")
+    if nproc == 1:
+        return tensor
+    handle = Handle(name)
+    _register(name, handle)
+    try:
+        with tl.activity(name, tl.XLA_ALLTOALL):
+            all_splits = _allgather_host_metadata(splits)  # (nproc, nproc)
+            all_splits = all_splits.reshape(nproc, nproc)
+            max_rows = int(all_splits.max())
+            # slot-pack: slot d holds rows destined to process d
+            slots = jnp.zeros((nproc, max_rows) + tensor.shape[1:],
+                              tensor.dtype)
+            off = 0
+            for d in range(nproc):
+                cnt = int(splits[d])
+                if cnt:
+                    slots = slots.at[d, :cnt].set(tensor[off:off + cnt])
+                off += cnt
+            garr = _lift(slots)  # (nproc, nproc, max_rows, ...)
+            rep = jax.jit(lambda g: g, out_shardings=_replicated(mesh))(garr)
+            me = jax.process_index()
+            parts = [rep[src, me, :int(all_splits[src, me])]
+                     for src in range(nproc)]
+            out = jnp.concatenate(parts, axis=0)
+            handle._fulfill(out)
+    except Exception as err:
+        handle._fail(HorovodInternalError(str(err)))
+    return synchronize(handle)
+
+
+def _allgather_host_metadata(arr: np.ndarray) -> np.ndarray:
+    """Tiny fixed-shape host metadata allgather over processes — the
+    control-plane exchange (recvcounts / splits negotiation,
+    ``mpi_controller.cc:164-231``)."""
+    mesh = process_mesh()
+    nproc = mesh.devices.size
+    if nproc == 1:
+        return np.asarray(arr)[None]
+    garr = _lift(jnp.asarray(arr))
+    rep = jax.jit(lambda g: g, out_shardings=_replicated(mesh))(garr)
+    return np.asarray(rep).reshape((nproc,) + arr.shape)
+
+
+def barrier(name: Optional[str] = None) -> None:
+    """Block until all processes arrive (reference
+    ``MPIController::Barrier``, ``mpi_controller.cc:225``)."""
+    _allgather_host_metadata(np.zeros((1,), np.int64))
+
+
+def join() -> int:
+    """Uneven-data termination barrier (reference ``EnqueueJoin``
+    ``operations.cc:1044``; joined ranks contribute zeros,
+    ``controller.cc:263-274``).
+
+    Eager semantics under SPMD: ``join()`` is called by every process once
+    it runs out of data; it synchronizes outstanding work and returns the
+    rank of the last process to join.  Ragged *per-step* participation is
+    handled in-graph by zero-masking (see
+    ``horovod_tpu.optim.join_step``); this call is the final barrier.
+    """
+    from horovod_tpu.ops.bucketing import global_bucketer
+
+    global_bucketer().flush()
+    mesh = process_mesh()
+    nproc = mesh.devices.size
+    me = jax.process_index()
+    if nproc == 1:
+        return 0
+    # order of arrival is not observable without a negotiation thread;
+    # reference returns the last rank to join — we return the max rank that
+    # reported the latest logical join counter.
+    import time
+
+    stamp = np.asarray([time.monotonic_ns(), me], np.int64)
+    all_stamps = _allgather_host_metadata(stamp).reshape(nproc, 2)
+    return int(all_stamps[np.argmax(all_stamps[:, 0]), 1])

@@ -1,0 +1,23 @@
+"""Distributed optimizer layer: Horovod's user ergonomics on optax/JAX.
+
+Reference surface being re-created: ``horovod/torch/optimizer.py``
+(``_DistributedOptimizer`` with per-parameter async hooks),
+``horovod/tensorflow/__init__.py`` (``_DistributedOptimizer:289``,
+``DistributedGradientTape:508``), plus gradient accumulation
+(``backward_passes_per_step``) and Adasum variants.
+"""
+
+from horovod_tpu.optim.optimizer import (
+    DistributedGradientTape,
+    DistributedOptimizer,
+    distributed_gradients,
+)
+from horovod_tpu.optim.train_step import DistributedTrainStep, join_step
+
+__all__ = [
+    "DistributedOptimizer",
+    "DistributedGradientTape",
+    "distributed_gradients",
+    "DistributedTrainStep",
+    "join_step",
+]

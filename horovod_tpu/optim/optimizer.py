@@ -1,0 +1,168 @@
+"""DistributedOptimizer / DistributedGradientTape for JAX training.
+
+The reference wraps a framework optimizer so gradients are allreduced
+before ``step()``: torch hooks per-parameter grad accumulators and fires
+async allreduces as each gradient is produced
+(``torch/optimizer.py:103-200``), TF rewrites ``compute_gradients``
+(``tensorflow/__init__.py:289-316``), both honoring
+``backward_passes_per_step`` accumulation and compression.
+
+optax formulation: gradient averaging is itself a gradient transformation,
+so ``DistributedOptimizer(opt)`` = ``chain(distributed_gradients(...),
+opt)``, wrapped in ``optax.MultiSteps`` when ``backward_passes_per_step >
+1``.  Three reduction modes, because JAX has three distribution idioms:
+
+* ``"shard_map"`` (default): the transform runs inside
+  ``shard_map``/``pmap`` with mesh axes bound; gradients are reduced with
+  one fused in-graph collective per dtype
+  (:func:`horovod_tpu.ops.collectives.grouped_allreduce`) which XLA
+  overlaps with backward compute — the role of the reference's
+  hook-fired async NCCL calls.
+* ``"pjit"``: under global-array pjit the batch axis is sharded and XLA
+  already inserts the gradient psum during autodiff; the transform is the
+  identity (documented no-op, so user code is portable between modes).
+* ``"process"``: host-level eager reduction across worker processes via
+  the async-handle API (the closest literal analogue of the reference's
+  per-tensor enqueue path).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence, Union
+
+import jax
+import optax
+
+from horovod_tpu.ops import collectives as C
+from horovod_tpu.ops.collectives import Average, ReduceOp
+from horovod_tpu.runtime.topology import GLOBAL_AXES
+
+AxisSpec = Union[str, Sequence[str]]
+
+
+def distributed_gradients(op: ReduceOp = Average,
+                          axis: AxisSpec = GLOBAL_AXES,
+                          mode: str = "shard_map",
+                          compression=None,
+                          prescale_factor: Optional[float] = None,
+                          postscale_factor: Optional[float] = None
+                          ) -> optax.GradientTransformation:
+    """optax transform that cross-replica-reduces gradients.
+
+    The composable core of :func:`DistributedOptimizer`; usable standalone
+    in any optax chain.
+    """
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        leaves, treedef = jax.tree_util.tree_flatten(updates)
+        if mode == "pjit":
+            reduced = leaves  # XLA autodiff already reduced (see docstring)
+        elif mode == "shard_map":
+            ins = leaves
+            ctxs = None
+            if compression is not None:
+                pairs = [compression.compress(g) for g in ins]
+                ins = [p[0] for p in pairs]
+                ctxs = [p[1] for p in pairs]
+            reduced = C.grouped_allreduce(
+                ins, op=op, axis=axis,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)
+            if compression is not None:
+                reduced = [compression.decompress(r, c)
+                           for r, c in zip(reduced, ctxs)]
+        elif mode == "process":
+            from horovod_tpu.ops import eager
+
+            handles = [
+                eager.allreduce_async(g, op=op,
+                                      prescale_factor=prescale_factor,
+                                      postscale_factor=postscale_factor,
+                                      compression=compression)
+                for g in leaves]
+            reduced = [eager.synchronize(h) for h in handles]
+        else:
+            raise ValueError(f"unknown mode {mode!r}")
+        return jax.tree_util.tree_unflatten(treedef, reduced), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def DistributedOptimizer(optimizer: optax.GradientTransformation,
+                         named_parameters=None,
+                         op: ReduceOp = Average,
+                         axis: AxisSpec = GLOBAL_AXES,
+                         mode: str = "shard_map",
+                         compression=None,
+                         backward_passes_per_step: int = 1,
+                         prescale_factor: Optional[float] = None,
+                         postscale_factor: Optional[float] = None
+                         ) -> optax.GradientTransformation:
+    """Wrap an optax optimizer so each update uses cross-replica-reduced
+    gradients (reference ``DistributedOptimizer`` factory,
+    ``torch/optimizer.py:381``, ``tensorflow/__init__.py:356``).
+
+    ``named_parameters`` is accepted for reference-signature parity (JAX
+    pytrees carry structure; names are not needed).
+    ``backward_passes_per_step`` accumulates N micro-batch gradients
+    locally before one reduction+step — note the reduction lives *inside*
+    MultiSteps, so skipped micro-steps do no communication, matching the
+    reference's delayed-allreduce semantics (``torch/optimizer.py``
+    backward_passes_per_step counting).
+    """
+    del named_parameters
+    chained = optax.chain(
+        distributed_gradients(op=op, axis=axis, mode=mode,
+                              compression=compression,
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor),
+        optimizer,
+    )
+    if backward_passes_per_step > 1:
+        return optax.MultiSteps(chained,
+                                every_k_schedule=backward_passes_per_step)
+    return chained
+
+
+class DistributedGradientTape:
+    """Eager-style gradient wrapper (reference ``DistributedGradientTape``,
+    ``tensorflow/__init__.py:508-572``).
+
+    Wraps a JAX gradient function; calling ``.gradient`` computes local
+    gradients then reduces them across worker processes with overlapped
+    async allreduces::
+
+        tape = hvd.DistributedGradientTape(jax.grad(loss_fn))
+        grads = tape.gradient(params, batch)
+    """
+
+    def __init__(self, grad_fn, op: ReduceOp = Average, compression=None,
+                 prescale_factor: Optional[float] = None,
+                 postscale_factor: Optional[float] = None):
+        self._grad_fn = grad_fn
+        self._op = op
+        self._compression = compression
+        self._prescale = prescale_factor
+        self._postscale = postscale_factor
+
+    def __call__(self, *args, **kwargs):
+        return self.gradient(*args, **kwargs)
+
+    def gradient(self, *args, **kwargs):
+        from horovod_tpu.ops import eager
+
+        grads = self._grad_fn(*args, **kwargs)
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        handles = [
+            eager.allreduce_async(g, op=self._op,
+                                  compression=self._compression,
+                                  prescale_factor=self._prescale,
+                                  postscale_factor=self._postscale)
+            for g in leaves]
+        reduced = [eager.synchronize(h) for h in handles]
+        return jax.tree_util.tree_unflatten(treedef, reduced)

@@ -1,0 +1,5 @@
+"""Launcher: hvdrun CLI, host assignment, rendezvous, elastic driver plumbing.
+
+Reference: ``horovod/runner/`` (launch.py CLI, gloo_run/mpi_run, driver and
+task services, elastic driver).
+"""

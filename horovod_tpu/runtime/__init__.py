@@ -1,0 +1,32 @@
+"""Core runtime: global state, config, mesh topology.
+
+TPU-native replacement for the reference's L1/L2 layers
+(``horovod/common/operations.cc``, ``global_state.h``, ``controller.cc``):
+the negotiation plane disappears under SPMD; what remains is the process
+singleton, the env-var contract and the device mesh.
+"""
+
+from horovod_tpu.runtime.config import Config
+from horovod_tpu.runtime.state import (
+    GlobalState,
+    NotInitializedError,
+    global_state,
+    init,
+    is_initialized,
+    shutdown,
+)
+from horovod_tpu.runtime.topology import AXIS_DCN, AXIS_ICI, GLOBAL_AXES, build_mesh
+
+__all__ = [
+    "Config",
+    "GlobalState",
+    "NotInitializedError",
+    "global_state",
+    "init",
+    "is_initialized",
+    "shutdown",
+    "AXIS_DCN",
+    "AXIS_ICI",
+    "GLOBAL_AXES",
+    "build_mesh",
+]

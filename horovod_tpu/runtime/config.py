@@ -1,0 +1,164 @@
+"""Runtime configuration from the ``HOROVOD_*`` environment contract.
+
+The reference funnels three config layers (env vars, ``horovodrun`` CLI flags,
+runtime autotune) into ``HOROVOD_*`` env vars read by the C++ core
+(``horovod/common/utils/env_parser.{h,cc}``, knob names in
+``horovod/common/common.h:64-90``).  We keep the same contract and knob names
+where they still make sense on TPU, and add TPU-specific ones
+(``HOROVOD_TPU_OPERATIONS``, mesh shape overrides).
+
+Knobs that exist purely because of the reference's negotiation machinery
+(cycle time, response cache capacity) are kept as accepted-but-advisory
+settings: SPMD compilation removes per-tensor negotiation, so they only
+influence the eager bucketing layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {v!r}")
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        raise ValueError(f"{name} must be a float, got {v!r}")
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+def _env_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+@dataclasses.dataclass
+class Config:
+    """All runtime knobs, resolved once at ``init()`` time.
+
+    Mirrors the env contract in the reference (``common.h:64-90``,
+    ``gloo_context.cc:47-55``) plus TPU-mesh additions.
+    """
+
+    # -- process identity (set by the launcher; reference gloo_context.cc:47-55)
+    rank: Optional[int] = None
+    size: Optional[int] = None
+    local_rank: Optional[int] = None
+    local_size: Optional[int] = None
+    cross_rank: Optional[int] = None
+    cross_size: Optional[int] = None
+
+    # -- coordination service (jax.distributed)
+    coordinator_addr: Optional[str] = None
+
+    # -- data-plane selection; the analogue of HOROVOD_GPU_OPERATIONS=NCCL
+    tpu_operations: str = "XLA"
+
+    # -- fusion / bucketing (reference: 64 MiB default, operations.cc:432)
+    fusion_threshold_bytes: int = 64 * 1024 * 1024
+    cycle_time_ms: float = 5.0   # advisory: eager bucket flush interval
+    cache_capacity: int = 1024   # advisory: compiled-collective cache entries
+
+    # -- hierarchical collectives (ici/dcn mesh split)
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+
+    # -- autotune (reference parameter_manager.h:58-78)
+    autotune: bool = False
+    autotune_log: Optional[str] = None
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+
+    # -- timeline (reference operations.cc:417-424)
+    timeline_filename: Optional[str] = None
+    timeline_mark_cycles: bool = False
+
+    # -- stall inspector (reference stall_inspector.h:73-81)
+    stall_check_enabled: bool = True
+    stall_warning_time_seconds: float = 60.0
+    stall_shutdown_time_seconds: float = 0.0  # 0 = never
+
+    # -- adasum
+    adasum_num_chunks: int = 1
+
+    # -- elastic
+    elastic_enabled: bool = False
+
+    # -- mesh overrides: "8" or "2,4" → (dcn, ici) axis sizes
+    mesh_shape: Optional[str] = None
+
+    # knobs the user set explicitly must not be autotuned
+    # (reference "fixed" flag, operations.cc:436)
+    fixed_knobs: frozenset = frozenset()
+
+    @staticmethod
+    def from_env() -> "Config":
+        fixed = set()
+
+        def mark(name: str, knob: str):
+            if os.environ.get(name) not in (None, ""):
+                fixed.add(knob)
+
+        mark("HOROVOD_FUSION_THRESHOLD", "fusion_threshold_bytes")
+        mark("HOROVOD_CYCLE_TIME", "cycle_time_ms")
+        mark("HOROVOD_CACHE_CAPACITY", "cache_capacity")
+        mark("HOROVOD_HIERARCHICAL_ALLREDUCE", "hierarchical_allreduce")
+        mark("HOROVOD_HIERARCHICAL_ALLGATHER", "hierarchical_allgather")
+
+        def opt_int(name: str) -> Optional[int]:
+            v = os.environ.get(name)
+            return int(v) if v not in (None, "") else None
+
+        return Config(
+            rank=opt_int("HOROVOD_RANK"),
+            size=opt_int("HOROVOD_SIZE"),
+            local_rank=opt_int("HOROVOD_LOCAL_RANK"),
+            local_size=opt_int("HOROVOD_LOCAL_SIZE"),
+            cross_rank=opt_int("HOROVOD_CROSS_RANK"),
+            cross_size=opt_int("HOROVOD_CROSS_SIZE"),
+            coordinator_addr=os.environ.get("HOROVOD_COORDINATOR_ADDR"),
+            tpu_operations=_env_str("HOROVOD_TPU_OPERATIONS", "XLA").upper(),
+            fusion_threshold_bytes=_env_int(
+                "HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024),
+            cycle_time_ms=_env_float("HOROVOD_CYCLE_TIME", 5.0),
+            cache_capacity=_env_int("HOROVOD_CACHE_CAPACITY", 1024),
+            hierarchical_allreduce=_env_bool(
+                "HOROVOD_HIERARCHICAL_ALLREDUCE", False),
+            hierarchical_allgather=_env_bool(
+                "HOROVOD_HIERARCHICAL_ALLGATHER", False),
+            autotune=_env_bool("HOROVOD_AUTOTUNE", False),
+            autotune_log=os.environ.get("HOROVOD_AUTOTUNE_LOG"),
+            autotune_warmup_samples=_env_int("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3),
+            autotune_steps_per_sample=_env_int(
+                "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", 10),
+            timeline_filename=os.environ.get("HOROVOD_TIMELINE"),
+            timeline_mark_cycles=_env_bool("HOROVOD_TIMELINE_MARK_CYCLES", False),
+            stall_check_enabled=not _env_bool("HOROVOD_STALL_CHECK_DISABLE", False),
+            stall_warning_time_seconds=_env_float(
+                "HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0),
+            stall_shutdown_time_seconds=_env_float(
+                "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0),
+            adasum_num_chunks=_env_int("HOROVOD_ADASUM_NUM_CHUNKS", 1),
+            elastic_enabled=_env_bool("HOROVOD_ELASTIC", False),
+            mesh_shape=os.environ.get("HOROVOD_TPU_MESH_SHAPE"),
+            fixed_knobs=frozenset(fixed),
+        )

@@ -1,0 +1,188 @@
+"""Process-wide runtime state: the TPU-native ``HorovodGlobalState``.
+
+The reference keeps one ``HorovodGlobalState`` singleton per process
+(``horovod/common/global_state.h:42-122``, instantiated at
+``operations.cc:114``) owning the background thread, controller, fusion
+buffer, timeline and tensor queue.  SPMD compilation removes the
+negotiation thread and the tensor queue — XLA schedules collectives inside
+the compiled step — but the process singleton survives: it owns the device
+mesh, resolved config, timeline, stall watchdog and shutdown flag, and it is
+what ``init()``/``shutdown()`` (``operations.cc:679``, ``basics.py:33``)
+create and destroy.
+
+Identity semantics (deliberate TPU re-design, documented in README):
+
+* a *worker* in the reference is one process == one GPU; under JAX one
+  process drives many chips.  ``rank``/``size`` here are **chip-level** —
+  ``size()`` is the data-parallel degree you scale the LR by, exactly as in
+  reference examples — while ``process_rank``/``process_count`` give the
+  host-process identity.  ``rank() == 0`` iff ``process_rank == 0``, so the
+  "checkpoint on rank 0" idiom carries over unchanged.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import Optional
+
+import jax
+
+from horovod_tpu.runtime.config import Config
+from horovod_tpu.runtime import topology
+from horovod_tpu.utils import logging as hvd_logging
+
+
+class NotInitializedError(RuntimeError):
+    def __init__(self):
+        super().__init__(
+            "horovod_tpu has not been initialized; call horovod_tpu.init() first.")
+
+
+class GlobalState:
+    """Singleton runtime object (reference ``HorovodGlobalState``)."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        self.initialization_done = False
+        self.shut_down = False
+        self._lock = threading.Lock()
+
+        # populated by initialize()
+        self.mesh = None
+        self.process_rank = 0
+        self.process_count = 1
+        self.rank = 0
+        self.size = 1
+        self.local_rank = 0
+        self.local_size = 1
+        self.cross_rank = 0
+        self.cross_size = 1
+        self.is_homogeneous = True
+
+        # aux subsystems, attached lazily to avoid import cycles
+        self.timeline = None
+        self.stall_inspector = None
+        self.parameter_manager = None
+        self.elastic_context = None
+        # compiled-collective cache (the response-cache analogue):
+        # jit itself memoizes, this just tracks hit statistics.
+        self.cache_stats = {"hits": 0, "misses": 0}
+
+    # -- bootstrap ---------------------------------------------------------
+
+    def initialize(self, ranks: Optional[list] = None) -> None:
+        cfg = self.config
+
+        # Multi-process bootstrap: the coordination-service analogue of the
+        # reference's gloo rendezvous (gloo_context.cc:71-91).  The launcher
+        # sets HOROVOD_COORDINATOR_ADDR + HOROVOD_RANK/SIZE; jax.distributed
+        # then wires all processes into one SPMD world.
+        if cfg.coordinator_addr and cfg.size and cfg.size > 1:
+            if not getattr(jax.distributed, "is_initialized", lambda: False)():
+                jax.distributed.initialize(
+                    coordinator_address=cfg.coordinator_addr,
+                    num_processes=cfg.size,
+                    process_id=cfg.rank,
+                )
+                hvd_logging.info(
+                    "jax.distributed initialized: process %s of %s via %s",
+                    cfg.rank, cfg.size, cfg.coordinator_addr)
+
+        self.process_rank = jax.process_index()
+        self.process_count = jax.process_count()
+
+        self.mesh = topology.build_mesh(cfg.mesh_shape)
+        self.size = topology.mesh_size(self.mesh)
+
+        local = jax.local_device_count()
+        self.local_size = local
+        self.local_rank = 0
+        self.rank = self.process_rank * local  # chip-rank of first local device
+        # homogeneity check mirrors MPIController::DoInitialization
+        # (mpi_controller.cc:26): all processes must drive equal chip counts
+        # for local/cross arithmetic to be meaningful.
+        self.is_homogeneous = (self.size == local * self.process_count)
+
+        # cross = slice/host-level (reference CROSS communicator,
+        # common.h:113-117)
+        self.cross_size = self.mesh.shape[topology.AXIS_DCN]
+        self.cross_rank = min(self.process_rank, self.cross_size - 1)
+        if cfg.cross_rank is not None:
+            self.cross_rank = cfg.cross_rank
+        if cfg.cross_size is not None:
+            self.cross_size = cfg.cross_size
+
+        if cfg.timeline_filename:
+            from horovod_tpu.utils.timeline import Timeline
+
+            self.timeline = Timeline(cfg.timeline_filename,
+                                     mark_cycles=cfg.timeline_mark_cycles)
+        if cfg.stall_check_enabled:
+            from horovod_tpu.utils.stall import StallInspector
+
+            self.stall_inspector = StallInspector(
+                warning_time_s=cfg.stall_warning_time_seconds,
+                shutdown_time_s=cfg.stall_shutdown_time_seconds)
+        if cfg.autotune:
+            from horovod_tpu.utils.autotune import ParameterManager
+
+            self.parameter_manager = ParameterManager(
+                self.config, log_path=cfg.autotune_log)
+
+        self.initialization_done = True
+        hvd_logging.info(
+            "horovod_tpu initialized: %d chips (%d process(es) x %d local), "
+            "mesh dcn=%d ici=%d",
+            self.size, self.process_count, local,
+            self.mesh.shape[topology.AXIS_DCN],
+            self.mesh.shape[topology.AXIS_ICI])
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self.shut_down:
+                return
+            if self.timeline is not None:
+                self.timeline.close()
+            if self.stall_inspector is not None:
+                self.stall_inspector.stop()
+            self.shut_down = True
+            self.initialization_done = False
+
+
+_state: Optional[GlobalState] = None
+_state_lock = threading.Lock()
+
+
+def init(ranks: Optional[list] = None, config: Optional[Config] = None) -> GlobalState:
+    """Create (or return) the singleton; idempotent like ``horovod_init``
+    (reference ``operations.cc:620`` InitializeHorovodOnce)."""
+    global _state
+    with _state_lock:
+        if _state is not None and _state.initialization_done:
+            return _state
+        cfg = config or Config.from_env()
+        st = GlobalState(cfg)
+        st.initialize(ranks)
+        _state = st
+        atexit.register(st.shutdown)
+        return st
+
+
+def shutdown() -> None:
+    global _state
+    with _state_lock:
+        if _state is not None:
+            _state.shutdown()
+            _state = None
+
+
+def is_initialized() -> bool:
+    return _state is not None and _state.initialization_done
+
+
+def global_state() -> GlobalState:
+    if _state is None or not _state.initialization_done:
+        raise NotInitializedError()
+    return _state

@@ -1,0 +1,96 @@
+"""Device-mesh topology: the TPU-native replacement for communicator splits.
+
+The reference models topology as three MPI communicators — GLOBAL, LOCAL
+(shared-memory node, ``MPI_Comm_split_type`` in ``mpi/mpi_context.cc:147``)
+and CROSS (one rank per node, ``:156``; enum in ``common.h:113-117``) — and
+routes hierarchical collectives NCCL-inside × MPI-across
+(``ops/nccl_operations.cc:191-341``).
+
+On TPU the same structure is a 2-D ``jax.sharding.Mesh``:
+
+* ``ici`` axis — chips within a slice, connected by the inter-chip
+  interconnect (the LOCAL communicator analogue; collectives here are
+  cheapest and ride the torus).
+* ``dcn`` axis — across slices/hosts over the data-center network (the CROSS
+  communicator analogue).
+
+A global collective is a reduction over both axes (``axis_name=("dcn",
+"ici")``); XLA lowers it to the hierarchical reduce-scatter/all-gather
+pattern the reference hand-codes, so ``NCCLHierarchicalAllreduce`` needs no
+manual equivalent.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis names.  GLOBAL/LOCAL/CROSS from the reference's
+# Communicator enum (common.h:113-117) map to:
+AXIS_DCN = "dcn"      # CROSS: across slices / hosts
+AXIS_ICI = "ici"      # LOCAL: chips within a slice
+GLOBAL_AXES = (AXIS_DCN, AXIS_ICI)   # GLOBAL: every chip
+
+
+def _detect_num_slices(devices: Sequence[jax.Device]) -> int:
+    """Count distinct TPU slices (falls back to process count off-TPU)."""
+    slice_ids = set()
+    for d in devices:
+        sid = getattr(d, "slice_index", None)
+        if sid is None:
+            sid = d.process_index
+        slice_ids.add(sid)
+    return max(1, len(slice_ids))
+
+
+def build_mesh(mesh_shape: Optional[str] = None,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the (dcn, ici) runtime mesh over all addressable-or-global devices.
+
+    ``mesh_shape`` (from ``HOROVOD_TPU_MESH_SHAPE``) may force the split:
+    ``"2,4"`` → 2 slices × 4 chips.  A single number means a flat ici mesh.
+    By default the dcn extent is the detected slice count.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+
+    if mesh_shape:
+        parts = [int(p) for p in mesh_shape.split(",") if p.strip()]
+        if len(parts) == 1:
+            dcn, ici = 1, parts[0]
+        elif len(parts) == 2:
+            dcn, ici = parts
+        else:
+            raise ValueError(
+                f"HOROVOD_TPU_MESH_SHAPE must be 'ici' or 'dcn,ici', got {mesh_shape!r}")
+        if dcn * ici != n:
+            raise ValueError(
+                f"mesh shape {dcn}x{ici} does not cover {n} devices")
+    else:
+        dcn = _detect_num_slices(devices)
+        if n % dcn != 0:
+            dcn = 1   # heterogeneous slice sizes: flatten
+        ici = n // dcn
+
+    if dcn > 1:
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                (ici,), (dcn,), devices=devices)
+            # hybrid mesh returns (dcn, ici)-shaped array already
+            dev_array = np.asarray(dev_array).reshape(dcn, ici)
+        except Exception:
+            dev_array = np.asarray(devices).reshape(dcn, ici)
+    else:
+        dev_array = np.asarray(devices).reshape(dcn, ici)
+
+    return Mesh(dev_array, GLOBAL_AXES)
+
+
+def mesh_size(mesh: Mesh) -> int:
+    return int(np.prod(list(mesh.shape.values())))

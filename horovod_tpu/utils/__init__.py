@@ -1,0 +1,1 @@
+"""Auxiliary subsystems: logging, timeline tracing, stall detection, autotune."""

@@ -1,0 +1,81 @@
+"""Stall / failure detection watchdog.
+
+The reference's ``StallInspector`` (``horovod/common/stall_inspector.{h,cc}``)
+watches the negotiation table for tensors some ranks submitted and others
+did not, warning after 60 s and optionally shutting down after
+``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS`` (``stall_inspector.h:73-81``).
+
+Under SPMD there is no negotiation table — a "stall" is a collective that
+was dispatched but never completes (a peer process died, or host code
+diverged so a peer never entered the collective).  This inspector tracks
+in-flight eager operations: each dispatched op registers here and clears on
+completion; a watcher thread warns when an op has been pending longer than
+the threshold and names it — the same observable behavior, re-rooted.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+from horovod_tpu.utils import logging as hvd_logging
+
+
+class StallInspector:
+    def __init__(self, warning_time_s: float = 60.0,
+                 shutdown_time_s: float = 0.0, poll_interval_s: float = 5.0):
+        self._warning_time_s = warning_time_s
+        self._shutdown_time_s = shutdown_time_s
+        self._poll_interval_s = poll_interval_s
+        self._pending: Dict[str, float] = {}
+        self._warned: set = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._watch, daemon=True,
+                                        name="hvd_tpu_stall_inspector")
+        self._thread.start()
+
+    def record_dispatch(self, name: str) -> None:
+        with self._lock:
+            self._pending[name] = time.monotonic()
+
+    def record_complete(self, name: str) -> None:
+        with self._lock:
+            self._pending.pop(name, None)
+            self._warned.discard(name)
+
+    def pending_ops(self):
+        with self._lock:
+            return dict(self._pending)
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self._poll_interval_s):
+            now = time.monotonic()
+            stalled, fatal = [], []
+            with self._lock:
+                for name, t0 in self._pending.items():
+                    age = now - t0
+                    if age > self._warning_time_s and name not in self._warned:
+                        stalled.append((name, age))
+                        self._warned.add(name)
+                    if self._shutdown_time_s > 0 and age > self._shutdown_time_s:
+                        fatal.append((name, age))
+            if stalled:
+                names = ", ".join(f"{n} ({a:.0f}s)" for n, a in stalled)
+                hvd_logging.warning(
+                    "One or more collectives submitted but not completed for "
+                    "over %.0fs: %s. A peer process may have failed or host "
+                    "control flow may have diverged across processes.",
+                    self._warning_time_s, names)
+            if fatal:
+                hvd_logging.error(
+                    "Collective(s) stalled beyond "
+                    "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS; aborting process.")
+                import os
+
+                os._exit(1)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)

@@ -1,0 +1,140 @@
+"""Chrome-tracing timeline, the reference's profiling subsystem rebuilt.
+
+The reference's ``Timeline`` (``horovod/common/timeline.{h,cc}``) writes
+Chrome ``chrome://tracing`` JSON from a dedicated writer thread fed by a
+lock-free SPSC queue (``timeline.h:47-75``); every tensor walks a
+NEGOTIATING → TOP_LEVEL → ACTIVITY state machine (``timeline.h:77``) with
+activity names from ``common.h:32-62`` (QUEUE, MEMCPY_IN_FUSION_BUFFER,
+NCCL_ALLREDUCE, ...).
+
+TPU version: negotiation does not exist, so the per-tensor states collapse
+to QUEUE (bucketed, waiting for flush) → COLLECTIVE (dispatched into XLA).
+Device-side timing comes from ``jax.profiler`` (Perfetto) — this timeline
+records the host-side orchestration view, which is what the reference's
+timeline showed too (GPU activities were event-drained estimates,
+``gpu_operations.h:110-119``).  Enabled by ``HOROVOD_TIMELINE=file.json``
+(``operations.cc:417-424``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
+# Activity names mirroring common.h:32-62
+QUEUE = "QUEUE"
+FUSE = "FUSE"                      # MEMCPY_IN_FUSION_BUFFER analogue
+COLLECTIVE = "COLLECTIVE"          # NCCL_ALLREDUCE etc. analogue
+XLA_ALLREDUCE = "XLA_ALLREDUCE"
+XLA_ALLGATHER = "XLA_ALLGATHER"
+XLA_BROADCAST = "XLA_BROADCAST"
+XLA_ALLTOALL = "XLA_ALLTOALL"
+XLA_REDUCESCATTER = "XLA_REDUCESCATTER"
+XLA_BARRIER = "XLA_BARRIER"
+COMPILE = "COMPILE"
+UNFUSE = "UNFUSE"                  # MEMCPY_OUT_FUSION_BUFFER analogue
+
+
+class Timeline:
+    """Asynchronous Chrome-trace writer (reference ``TimelineWriter``).
+
+    Events are pushed onto a thread-safe queue and serialized by a
+    dedicated writer thread, mirroring the SPSC design in
+    ``timeline.h:47-75`` without stalling collective dispatch.
+    """
+
+    def __init__(self, filename: str, mark_cycles: bool = False):
+        self._filename = filename
+        self._mark_cycles = mark_cycles
+        self._queue: "queue.Queue" = queue.Queue()
+        self._start_ns = time.monotonic_ns()
+        self._active: dict = {}
+        self._closed = False
+        self._pid = os.getpid()
+        self._file = open(filename, "w")
+        self._file.write("[\n")
+        self._first = True
+        self._writer = threading.Thread(target=self._write_loop, daemon=True,
+                                        name="hvd_tpu_timeline_writer")
+        self._writer.start()
+
+    # -- event API (mirrors Timeline::ActivityStart/End, MarkCycleStart) ----
+
+    def _ts_us(self) -> float:
+        return (time.monotonic_ns() - self._start_ns) / 1e3
+
+    def start_activity(self, tensor_name: str, activity: str) -> None:
+        self._queue.put({"ph": "B", "name": activity, "cat": activity,
+                         "tid": tensor_name, "pid": self._pid,
+                         "ts": self._ts_us()})
+
+    def end_activity(self, tensor_name: str) -> None:
+        self._queue.put({"ph": "E", "tid": tensor_name, "pid": self._pid,
+                         "ts": self._ts_us()})
+
+    def instant(self, name: str, args: Optional[dict] = None) -> None:
+        self._queue.put({"ph": "i", "name": name, "s": "p",
+                         "tid": "runtime", "pid": self._pid,
+                         "ts": self._ts_us(), "args": args or {}})
+
+    def mark_cycle_start(self) -> None:
+        """HOROVOD_TIMELINE_MARK_CYCLES analogue (operations.cc:428,578):
+        marks each eager-bucket flush cycle."""
+        if self._mark_cycles:
+            self.instant("CYCLE_START")
+
+    # -- writer thread ------------------------------------------------------
+
+    def _write_loop(self) -> None:
+        while True:
+            ev = self._queue.get()
+            if ev is None:
+                return
+            if not self._first:
+                self._file.write(",\n")
+            self._first = False
+            json.dump(ev, self._file)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(None)
+        self._writer.join(timeout=5)
+        self._file.write("\n]\n")
+        self._file.close()
+
+
+class NoOpTimeline:
+    """Used when HOROVOD_TIMELINE is unset — keeps call sites branch-free."""
+
+    def start_activity(self, *a, **k): pass
+    def end_activity(self, *a, **k): pass
+    def instant(self, *a, **k): pass
+    def mark_cycle_start(self): pass
+    def close(self): pass
+
+
+def activity(tensor_name: str, name: str):
+    """Context manager recording one activity on the runtime timeline."""
+    from horovod_tpu.runtime import state
+
+    class _Ctx:
+        def __enter__(self):
+            self.tl = None
+            if state.is_initialized():
+                self.tl = state.global_state().timeline
+            if self.tl is not None:
+                self.tl.start_activity(tensor_name, name)
+            return self
+
+        def __exit__(self, *exc):
+            if self.tl is not None:
+                self.tl.end_activity(tensor_name)
+            return False
+
+    return _Ctx()

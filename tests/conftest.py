@@ -1,0 +1,35 @@
+"""Test harness: force an 8-device virtual CPU platform.
+
+The reference's universal test trick is multi-process-on-localhost under
+``mpirun -np 2`` (SURVEY §4).  The TPU-native analogue: a virtual 8-device
+CPU mesh via ``--xla_force_host_platform_device_count=8`` so every in-mesh
+collective, sharding and shard_map path runs exactly as it would on an
+8-chip slice — no TPU hardware needed for the core suite.
+"""
+
+import os
+
+# must run before jax initializes its backends
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("HOROVOD_TPU_MESH_SHAPE", "2,4")
+
+import jax  # noqa: E402
+
+# this image routes the default backend to a tunneled TPU plugin; the test
+# suite must run on the virtual 8-device CPU platform regardless
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=False)
+def hvd_runtime():
+    """Initialized runtime with a fresh 2x4 (dcn, ici) mesh per test."""
+    import horovod_tpu as hvd
+
+    hvd.init()
+    yield hvd
+    hvd.shutdown()

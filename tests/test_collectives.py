@@ -1,0 +1,256 @@
+"""In-mesh collective numerics on a virtual 2x4 (dcn, ici) CPU mesh.
+
+Mirrors the reference's collective unit tests (``test/test_tensorflow.py``,
+``test/test_torch.py``): each "rank" (mesh shard) computes a tensor from
+its rank index and the test asserts the closed-form reduction result.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.ops import collectives as C
+from horovod_tpu.runtime.topology import AXIS_DCN, AXIS_ICI, GLOBAL_AXES
+
+
+def make_mesh():
+    devs = np.asarray(jax.devices("cpu")[:8]).reshape(2, 4)
+    return Mesh(devs, GLOBAL_AXES)
+
+
+def run_spmd(fn, mesh=None, out_specs=P(GLOBAL_AXES)):
+    """Run fn() per shard under shard_map; fn sees bound mesh axes."""
+    mesh = mesh or make_mesh()
+
+    def wrapper():
+        return fn()
+
+    return jax.jit(jax.shard_map(wrapper, mesh=mesh, in_specs=(),
+                                 out_specs=out_specs, check_vma=False))()
+
+
+N = 8  # world size
+
+
+def rank_tensor(shape=(4, 3), dtype=jnp.float32):
+    """Per-shard tensor: value = linearized rank (reference tests use
+    rank-derived tensors the same way)."""
+    r = C.axis_index(GLOBAL_AXES)
+    return jnp.full(shape, r + 1, dtype)
+
+
+class TestAllreduce:
+    def test_sum(self):
+        def f():
+            x = rank_tensor()
+            return C.allreduce(x, op=C.Sum)[None]
+
+        out = np.asarray(run_spmd(f, out_specs=P(GLOBAL_AXES)))
+        expected = sum(range(1, N + 1))
+        assert out.shape == (N, 4, 3)
+        np.testing.assert_allclose(out, expected)
+
+    def test_average(self):
+        def f():
+            return C.allreduce(rank_tensor(), op=C.Average)[None]
+
+        out = np.asarray(run_spmd(f))
+        np.testing.assert_allclose(out, (N + 1) / 2)
+
+    def test_min_max(self):
+        def f():
+            x = rank_tensor()
+            return C.allreduce(x, op=C.ReduceOp.MIN)[None], \
+                C.allreduce(x, op=C.ReduceOp.MAX)[None]
+
+        mn, mx = run_spmd(f, out_specs=(P(GLOBAL_AXES), P(GLOBAL_AXES)))
+        np.testing.assert_allclose(np.asarray(mn), 1)
+        np.testing.assert_allclose(np.asarray(mx), N)
+
+    def test_prescale_postscale(self):
+        def f():
+            x = rank_tensor()
+            return C.allreduce(x, op=C.Sum, prescale_factor=2.0,
+                               postscale_factor=0.5)[None]
+
+        out = np.asarray(run_spmd(f))
+        np.testing.assert_allclose(out, sum(range(1, N + 1)))
+
+    def test_local_axis_only(self):
+        """Reduction over ici only: per-dcn-row sums (LOCAL communicator)."""
+        def f():
+            return C.allreduce(rank_tensor((2,)), op=C.Sum, axis=AXIS_ICI)[None]
+
+        out = np.asarray(run_spmd(f))
+        # ranks 1..4 in dcn row 0, 5..8 in row 1
+        row0, row1 = sum(range(1, 5)), sum(range(5, 9))
+        for i in range(N):
+            np.testing.assert_allclose(out[i], row0 if i < 4 else row1)
+
+    def test_grouped_matches_individual(self):
+        def f():
+            r = C.axis_index(GLOBAL_AXES)
+            xs = [jnp.full((5,), r + 1, jnp.float32),
+                  jnp.full((2, 2), (r + 1) * 10, jnp.float32),
+                  jnp.full((3,), r + 1, jnp.bfloat16)]
+            grouped = C.grouped_allreduce(xs, op=C.Sum)
+            single = [C.allreduce(x, op=C.Sum) for x in xs]
+            return tuple(g[None] for g in grouped), tuple(s[None] for s in single)
+
+        spec = (P(GLOBAL_AXES),) * 3
+        grouped, single = run_spmd(f, out_specs=(spec, spec))
+        for g, s in zip(grouped, single):
+            np.testing.assert_allclose(np.asarray(g, np.float32),
+                                       np.asarray(s, np.float32))
+
+    def test_bf16(self):
+        def f():
+            return C.allreduce(rank_tensor((8,), jnp.bfloat16), op=C.Average)[None]
+
+        out = np.asarray(run_spmd(f)).astype(np.float32)
+        np.testing.assert_allclose(out, (N + 1) / 2, rtol=1e-2)
+
+
+class TestAllgather:
+    def test_equal_shapes(self):
+        def f():
+            r = C.axis_index(GLOBAL_AXES)
+            x = jnp.full((2, 3), r, jnp.float32)
+            return C.allgather(x)[None]
+
+        out = np.asarray(run_spmd(f))
+        assert out.shape == (N, 2 * N, 3)
+        for r in range(N):
+            np.testing.assert_allclose(out[0, 2 * r:2 * r + 2], r)
+        # every shard sees the identical gathered tensor
+        for i in range(1, N):
+            np.testing.assert_allclose(out[i], out[0])
+
+    def test_variable_first_dim(self):
+        """allgather_v: rank r contributes r+1 rows (reference
+        variable-size allgather tests)."""
+        max_rows = N
+
+        def f():
+            r = C.axis_index(GLOBAL_AXES)
+            rows = jnp.arange(max_rows, dtype=jnp.float32)[:, None]
+            x = jnp.where(rows < (r + 1), rows + 100.0 * (r + 1),
+                          jnp.zeros_like(rows))
+            gathered, counts = C.allgather_v(
+                x, valid_count=r + 1, max_count=max_rows)
+            return gathered[None], counts[None]
+
+        g, counts = run_spmd(f, out_specs=(P(GLOBAL_AXES), P(GLOBAL_AXES)))
+        g, counts = np.asarray(g), np.asarray(counts)
+        assert counts.shape == (N, N)
+        np.testing.assert_array_equal(counts[0], np.arange(1, N + 1))
+        for src in range(N):
+            valid = g[0, src, :src + 1, 0]
+            np.testing.assert_allclose(
+                valid, np.arange(src + 1) + 100.0 * (src + 1))
+
+
+class TestBroadcast:
+    @pytest.mark.parametrize("root", [0, 3, 7])
+    def test_root(self, root):
+        def f():
+            r = C.axis_index(GLOBAL_AXES)
+            x = jnp.full((3, 2), r + 1, jnp.float32)
+            return C.broadcast(x, root_rank=root)[None]
+
+        out = np.asarray(run_spmd(f))
+        np.testing.assert_allclose(out, root + 1)
+
+
+class TestAlltoall:
+    def test_uniform(self):
+        """Flat 8-wide mesh alltoall: rank r sends slice d filled with
+        value r*10+d to rank d."""
+        devs = np.asarray(jax.devices("cpu")[:8])
+        mesh = Mesh(devs, ("ranks",))
+
+        def f():
+            r = jax.lax.axis_index("ranks")
+            x = (r * 10 + jnp.arange(8, dtype=jnp.int32))[:, None] * \
+                jnp.ones((1, 2), jnp.int32)
+            return C.alltoall(x, axis="ranks")[None]
+
+        out = np.asarray(jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(), out_specs=P("ranks"),
+            check_vma=False))())
+        assert out.shape == (8, 8, 2)
+        for d in range(8):
+            np.testing.assert_array_equal(
+                out[d, :, 0], np.arange(8) * 10 + d)
+
+    def test_variable_splits(self):
+        devs = np.asarray(jax.devices("cpu")[:4])
+        mesh = Mesh(devs, ("ranks",))
+        world, max_count = 4, 4
+
+        def f():
+            r = jax.lax.axis_index("ranks")
+            # rank r sends (d+1) rows of value 100*r+d to destination d
+            send_counts = jnp.arange(1, world + 1, dtype=jnp.int32)
+            rows = jnp.arange(max_count)[None, :, None]
+            dest = jnp.arange(world)[:, None, None]
+            slots = jnp.where(rows < (dest + 1),
+                              100.0 * r + dest, 0.0).astype(jnp.float32)
+            recv, counts = C.alltoall_v(slots, send_counts, max_count,
+                                        axis="ranks")
+            return recv[None], counts[None]
+
+        recv, counts = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(),
+            out_specs=(P("ranks"), P("ranks")), check_vma=False))()
+        recv, counts = np.asarray(recv), np.asarray(counts)
+        for me in range(world):
+            # I receive (me+1) rows from every source
+            np.testing.assert_array_equal(counts[me], me + 1)
+            for src in range(world):
+                np.testing.assert_allclose(
+                    recv[me, src, :me + 1, 0], 100.0 * src + me)
+
+
+class TestReduceScatter:
+    def test_psum_scatter(self):
+        devs = np.asarray(jax.devices("cpu")[:4])
+        mesh = Mesh(devs, ("ranks",))
+
+        def f():
+            r = jax.lax.axis_index("ranks")
+            x = jnp.arange(8, dtype=jnp.float32) + r
+            return C.reducescatter(x, op=C.Sum, axis="ranks")[None]
+
+        out = np.asarray(jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(), out_specs=P("ranks"),
+            check_vma=False))())
+        # sum over ranks of (arange(8)+r) = 4*arange(8) + 6; shard i owns rows 2i:2i+2
+        full = 4 * np.arange(8) + 6
+        for i in range(4):
+            np.testing.assert_allclose(out[i], full[2 * i:2 * i + 2])
+
+
+class TestControlPrimitives:
+    def test_barrier(self):
+        def f():
+            return C.barrier()[None]
+
+        out = np.asarray(run_spmd(f))
+        np.testing.assert_array_equal(out, N)
+
+    def test_bitwise_and_or(self):
+        """Bitvector agreement primitives (response-cache protocol)."""
+        def f():
+            r = C.axis_index(GLOBAL_AXES)
+            # bit 0 set by everyone, bit r+1 set only by rank r, bit 20 by none
+            x = jnp.asarray([1 | (1 << (r + 1))], jnp.int32)
+            return C.bitwise_and(x)[None], C.bitwise_or(x)[None]
+
+        band, bor = run_spmd(f, out_specs=(P(GLOBAL_AXES), P(GLOBAL_AXES)))
+        np.testing.assert_array_equal(np.asarray(band).ravel(), 1)
+        expected_or = 1 | sum(1 << (r + 1) for r in range(N))
+        np.testing.assert_array_equal(np.asarray(bor).ravel(), expected_or)

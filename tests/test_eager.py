@@ -1,0 +1,141 @@
+"""Eager host-level API: handles, fusion, naming, single-process semantics.
+
+The multi-process behavior of these ops is exercised by
+``tests/test_multiprocess.py`` (jax.distributed on localhost — the
+mpirun-pytest analogue); here we pin down the single-process semantics,
+the async-handle lifecycle and the duplicate-name protocol errors
+(reference ``test_torch.py`` duplicate-name test, DUPLICATE_NAME_ERROR in
+``common.h:163``).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.ops import eager
+from horovod_tpu.ops.bucketing import global_bucketer
+
+
+@pytest.fixture(autouse=True)
+def runtime():
+    hvd.init()
+    yield
+    global_bucketer().flush()
+
+
+class TestBasics:
+    def test_init_identity(self):
+        assert hvd.is_initialized()
+        assert hvd.size() == 8          # 8 virtual chips
+        assert hvd.process_count() == 1
+        assert hvd.process_rank() == 0
+        assert hvd.rank() == 0
+        assert hvd.local_size() == 8
+        assert hvd.is_homogeneous()
+        assert hvd.cross_size() == 2    # dcn axis of the 2x4 mesh
+        assert hvd.xla_built()
+        assert not hvd.mpi_built()
+        assert not hvd.nccl_built()
+
+    def test_mesh_shape(self):
+        m = hvd.mesh()
+        assert m.shape["dcn"] == 2 and m.shape["ici"] == 4
+
+
+class TestEagerCollectives:
+    def test_allreduce_single_process(self):
+        x = jnp.arange(6, dtype=jnp.float32).reshape(2, 3)
+        out = hvd.allreduce(x, name="t0")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+    def test_allreduce_scales(self):
+        x = jnp.ones((4,), jnp.float32)
+        out = hvd.allreduce(x, name="t1", op=hvd.Sum,
+                            prescale_factor=3.0, postscale_factor=0.5)
+        np.testing.assert_allclose(np.asarray(out), 1.5)
+
+    def test_async_handle_lifecycle(self):
+        x = jnp.ones((2,), jnp.float32)
+        h = hvd.allreduce_async(x, name="t2")
+        out = hvd.synchronize(h)
+        assert hvd.poll(h)
+        np.testing.assert_allclose(np.asarray(out), 1.0)
+
+    def test_duplicate_name_rejected(self):
+        h1 = hvd.allreduce_async(jnp.ones((2,)), name="dup")
+        with pytest.raises(hvd.HorovodInternalError, match="same name"):
+            hvd.allreduce_async(jnp.ones((2,)), name="dup")
+        hvd.synchronize(h1)
+        # after completion the name is free again
+        h2 = hvd.allreduce_async(jnp.ones((2,)), name="dup")
+        hvd.synchronize(h2)
+
+    def test_fusion_groups_many_tensors(self):
+        """Many small async submissions produce correct per-tensor results
+        through the fused path."""
+        handles = [hvd.allreduce_async(
+            jnp.full((3,), float(i)), name=f"fuse.{i}", op=hvd.Sum)
+            for i in range(20)]
+        for i, h in enumerate(handles):
+            np.testing.assert_allclose(np.asarray(hvd.synchronize(h)),
+                                       float(i))
+
+    def test_compression_roundtrip(self):
+        x = jnp.asarray([1.5, -2.25, 3.0], jnp.float32)
+        out = hvd.allreduce(x, name="comp",
+                            compression=hvd.Compression.fp16)
+        assert out.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+    def test_allgather_single(self):
+        x = jnp.arange(4).reshape(2, 2)
+        out = hvd.allgather(x, name="ag")
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    def test_broadcast_single(self):
+        x = jnp.arange(4.0)
+        out = hvd.broadcast(x, root_rank=0, name="bc")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+    def test_alltoall_single(self):
+        x = jnp.arange(6.0)
+        out = hvd.alltoall(x, name="a2a")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+    def test_alltoall_bad_splits(self):
+        with pytest.raises(ValueError, match="splits"):
+            hvd.alltoall(jnp.arange(6.0), splits=[2, 2], name="a2a_bad")
+
+    def test_join_single(self):
+        assert hvd.join() == 0
+
+    def test_barrier(self):
+        hvd.barrier()
+
+    def test_adasum_eager_single(self):
+        x = jnp.asarray([1.0, 2.0])
+        out = hvd.allreduce(x, name="ad", op=hvd.Adasum)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+class TestFunctions:
+    def test_broadcast_variables(self):
+        tree = {"w": jnp.ones((2, 2)), "b": jnp.zeros((2,))}
+        out = hvd.broadcast_variables(tree, root_rank=0)
+        np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+
+    def test_broadcast_object(self):
+        obj = {"epoch": 3, "name": "x"}
+        assert hvd.broadcast_object(obj, root_rank=0) == obj
+
+    def test_allgather_object(self):
+        assert hvd.allgather_object({"r": 0}) == [{"r": 0}]
+
+    def test_broadcast_optimizer_state(self):
+        import optax
+
+        opt = optax.adam(1e-3)
+        st = opt.init({"w": jnp.ones((3,))})
+        out = hvd.broadcast_optimizer_state(st, root_rank=0)
+        assert jnp.allclose(out[0].count, st[0].count)
