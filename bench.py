@@ -1,0 +1,113 @@
+"""Driver benchmark: ResNet-50 synthetic training throughput.
+
+TPU-native counterpart of the reference's headline benchmark
+(``examples/tensorflow2_synthetic_benchmark.py``, ResNet-50 synthetic
+data, img/sec — ``docs/benchmarks.rst:66-80``).  Trains
+:class:`horovod_tpu.models.resnet.ResNet50` with
+``DistributedTrainStep`` on whatever devices are present (one real TPU
+chip under the driver) and prints ONE JSON line::
+
+    {"metric": "resnet50_img_sec_per_chip", "value": N, "unit": "img/sec/chip",
+     "vs_baseline": N}
+
+``vs_baseline`` compares against the only absolute per-accelerator
+throughput the reference publishes: ResNet-101 at 1,656.82 img/sec on 16
+Pascal P100s (``docs/benchmarks.rst:43``) → 103.55 img/sec per GPU.
+(The reference's other numbers are scaling efficiencies; BASELINE.md.)
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+# Reference docs/benchmarks.rst:43 — 1656.82 img/sec on 16 GPUs.
+BASELINE_IMG_SEC_PER_ACCEL = 1656.82 / 16
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=128,
+                   help="per-chip batch size")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--num-iters", type=int, default=5)
+    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--num-warmup-batches", type=int, default=3)
+    p.add_argument("--dtype", default="bfloat16",
+                   choices=["bfloat16", "float32"])
+    args = p.parse_args()
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models.resnet import ResNet50
+
+    hvd.init()
+    n_chips = hvd.size()
+    platform = jax.devices()[0].platform
+    if platform == "cpu" and args.dtype == "bfloat16":
+        args.dtype = "float32"       # bf16 is emulated (slow) on host CPU
+        if args.image_size == 224:
+            args.image_size = 96     # keep the CPU smoke run tractable
+            args.batch_size = 16
+    log(f"bench: {n_chips} chip(s) on {platform}, "
+        f"batch {args.batch_size}/chip, {args.image_size}px, {args.dtype}")
+
+    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    model = ResNet50(num_classes=1000, dtype=compute_dtype)
+
+    def loss_fn(params, batch):
+        logits = model.apply(params, batch["x"], train=False)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    step = hvd.DistributedTrainStep(
+        loss_fn, optax.sgd(0.01 * n_chips, momentum=0.9))
+    x0 = jnp.zeros((1, args.image_size, args.image_size, 3), jnp.float32)
+    params, opt_state = step.init(
+        model.init(jax.random.PRNGKey(0), x0, train=False))
+
+    global_bs = args.batch_size * n_chips
+    rng = np.random.RandomState(0)
+    batch = step.shard_batch({
+        "x": jnp.asarray(
+            rng.rand(global_bs, args.image_size, args.image_size, 3),
+            jnp.float32),
+        "y": jnp.asarray(rng.randint(0, 1000, (global_bs,)), jnp.int32),
+    })
+
+    t0 = time.perf_counter()
+    for _ in range(args.num_warmup_batches):
+        params, opt_state, loss = step(params, opt_state, batch)
+    jax.block_until_ready(loss)
+    log(f"bench: warmup (incl. compile) {time.perf_counter() - t0:.1f}s, "
+        f"loss={float(loss):.3f}")
+
+    img_secs = []
+    for it in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            params, opt_state, loss = step(params, opt_state, batch)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        img_secs.append(global_bs * args.num_batches_per_iter / dt)
+        log(f"bench: iter {it}: {img_secs[-1]:.1f} img/sec total")
+
+    per_chip = float(np.mean(img_secs)) / n_chips
+    print(json.dumps({
+        "metric": "resnet50_img_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "img/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_SEC_PER_ACCEL, 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
