@@ -242,6 +242,15 @@ def _reset() -> None:
     from horovod_tpu.runtime import state as rt_state
 
     rt_state.shutdown()
+    # leave the old coordination-service world: without this,
+    # jax.distributed stays initialized, GlobalState.initialize skips the
+    # re-rendezvous, and the rebuilt mesh would still contain dead peers
+    try:
+        if getattr(jax.distributed, "is_initialized", lambda: False)():
+            jax.distributed.shutdown()
+    except Exception as e:  # pragma: no cover - backend-dependent teardown
+        hvd_logging.warning("elastic: jax.distributed.shutdown failed: %s", e)
     eager._reset_mesh_cache()
     eager._reducer_cache.clear()
+    jax.clear_caches()   # compiled programs hold the old mesh's devices
     rt_state.init()

@@ -88,11 +88,10 @@ def allgather_object(obj: Any, name: Optional[str] = None) -> list:
     if nproc == 1:
         return [obj]
     payload = _obj_to_bytes_tensor(obj)
-    gathered = eager.allgather(payload, name=name)       # concatenated bytes
-    sizes = eager.allgather(jnp.asarray([payload.size], jnp.int64),
-                            name=f"{name}.sizes")
+    # the gather negotiates per-process sizes internally; reuse them rather
+    # than running a second collective for the same numbers
+    gathered, sizes_np = eager.allgather_with_sizes(payload, name=name)
     out, off = [], 0
-    sizes_np = np.asarray(sizes)
     for p in range(nproc):
         n = int(sizes_np[p])
         out.append(_bytes_tensor_to_obj(gathered[off:off + n]))

@@ -283,37 +283,45 @@ def barrier(axis: AxisSpec = GLOBAL_AXES) -> jax.Array:
 
 
 def _bits(x: jax.Array, nbits: int) -> jax.Array:
-    """Unpack an int array into a (..., nbits) {0,1} array."""
+    """Unpack an int array into a (..., nbits) {0,1} array.  Arithmetic
+    right-shift + ``& 1`` reads every bit position incl. the sign bit."""
     shifts = jnp.arange(nbits, dtype=x.dtype)
     return (x[..., None] >> shifts) & 1
 
 
 def _pack(bits: jax.Array, dtype) -> jax.Array:
+    """Repack (..., nbits) {0,1} bits into ``dtype`` words.  Accumulates in
+    the unsigned counterpart so the top (sign) bit packs without overflow,
+    then reinterprets into the target dtype."""
     nbits = bits.shape[-1]
-    shifts = jnp.arange(nbits, dtype=jnp.int32)
-    return jnp.sum(bits.astype(jnp.int64) << shifts, axis=-1).astype(dtype) \
-        if nbits > 31 else \
-        jnp.sum(bits.astype(jnp.int32) << shifts, axis=-1).astype(dtype)
+    acc = jnp.uint64 if nbits > 32 else jnp.uint32
+    shifts = jnp.arange(nbits, dtype=acc)
+    packed = jnp.sum(bits.astype(acc) << shifts, axis=-1)
+    return lax.convert_element_type(packed, dtype)
 
 
 def bitwise_and(x: jax.Array, axis: AxisSpec = GLOBAL_AXES,
-                nbits: int = 31) -> jax.Array:
+                nbits: Optional[int] = None) -> jax.Array:
     """Cross-shard bitwise AND of int bitvectors (reference
     ``CrossRankBitwiseAnd``, ``mpi_controller.cc:88`` — the response-cache
     agreement primitive).  A bit survives iff every shard set it, i.e. its
-    psum equals the world size — bit-decompose, psum, repack."""
+    psum equals the world size — bit-decompose, psum, repack.  All bits of
+    the input dtype participate by default (reference operates on full
+    64-bit words); pass ``nbits`` to restrict to the low bits."""
     if x.dtype == jnp.bool_:
         return lax.psum(x.astype(jnp.int32), axis) == axis_size(axis)
+    nbits = nbits or jnp.iinfo(x.dtype).bits
     n = axis_size(axis)
     counts = lax.psum(_bits(x, nbits).astype(jnp.int32), axis)
     return _pack((counts == n).astype(jnp.int32), x.dtype)
 
 
 def bitwise_or(x: jax.Array, axis: AxisSpec = GLOBAL_AXES,
-               nbits: int = 31) -> jax.Array:
+               nbits: Optional[int] = None) -> jax.Array:
     """Cross-shard bitwise OR (reference ``CrossRankBitwiseOr``,
     ``mpi_controller.cc:97``): a bit is set iff any shard set it."""
     if x.dtype == jnp.bool_:
         return lax.psum(x.astype(jnp.int32), axis) > 0
+    nbits = nbits or jnp.iinfo(x.dtype).bits
     counts = lax.psum(_bits(x, nbits).astype(jnp.int32), axis)
     return _pack((counts > 0).astype(jnp.int32), x.dtype)

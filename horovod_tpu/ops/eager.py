@@ -150,9 +150,12 @@ def _adasum_tree(rows: list):
 
 def _reduce_impl(garr, *, op: ReduceOp, prescale, postscale, nproc: int,
                  segments: tuple = ()):
+    # 0.0 is a legal scale factor (reference accepts arbitrary doubles), so
+    # test against None, not truthiness
+    scaled = prescale is not None or postscale is not None
     x = garr.astype(jnp.float32) if garr.dtype in (jnp.float16, jnp.bfloat16) \
-        and (prescale or postscale) else garr
-    if prescale:
+        and scaled else garr
+    if prescale is not None:
         x = x * prescale
     if op == ReduceOp.ADASUM:
         if segments:
@@ -176,7 +179,7 @@ def _reduce_impl(garr, *, op: ReduceOp, prescale, postscale, nproc: int,
         y = jnp.prod(x, axis=0)
     else:
         raise ValueError(f"unsupported op {op}")
-    if postscale:
+    if postscale is not None:
         y = y * postscale
     return y.astype(garr.dtype)
 
@@ -336,19 +339,28 @@ def allgather(tensor, name: Optional[str] = None):
     """Gather tensors from all processes, concatenated on dim 0; first dims
     may differ per process (reference ``EnqueueTensorAllgather``
     ``operations.cc:903``, recvcounts in ``mpi_operations.cc:96``)."""
+    out, _ = allgather_with_sizes(tensor, name=name)
+    return out
+
+
+def allgather_with_sizes(tensor, name: Optional[str] = None):
+    """``allgather`` that also returns the negotiated per-process first-dim
+    sizes as a host ``np.ndarray`` — callers exchanging variable payloads
+    (``allgather_object``) reuse them instead of a second collective."""
     name = name or _next_name("allgather")
     tensor = jnp.asarray(tensor)
     mesh = process_mesh()
     nproc = mesh.devices.size
     if nproc == 1:
-        return tensor
+        return tensor, np.asarray([tensor.shape[0]], np.int64)
     handle = Handle(name)
     _register(name, handle)
+    sizes = None
     try:
         with tl.activity(name, tl.XLA_ALLGATHER):
             # negotiate first-dim sizes (the controller's recvcount exchange)
             sizes = _allgather_host_metadata(
-                np.asarray([tensor.shape[0]], np.int64))
+                np.asarray([tensor.shape[0]], np.int64)).reshape(nproc)
             max_rows = int(sizes.max())
             pad = jnp.zeros((max_rows,) + tensor.shape[1:], tensor.dtype)
             pad = pad.at[:tensor.shape[0]].set(tensor)
@@ -359,7 +371,7 @@ def allgather(tensor, name: Optional[str] = None):
             handle._fulfill(out)
     except Exception as err:
         handle._fail(HorovodInternalError(str(err)))
-    return synchronize(handle)
+    return synchronize(handle), sizes
 
 
 def broadcast(tensor, root_rank: int, name: Optional[str] = None):
@@ -434,14 +446,24 @@ def alltoall(tensor, splits=None, name: Optional[str] = None):
 def _allgather_host_metadata(arr: np.ndarray) -> np.ndarray:
     """Tiny fixed-shape host metadata allgather over processes — the
     control-plane exchange (recvcounts / splits negotiation,
-    ``mpi_controller.cc:164-231``)."""
+    ``mpi_controller.cc:164-231``).
+
+    int64 payloads are exchanged as int32 word pairs: without
+    ``jax_enable_x64`` jnp silently truncates int64 to int32, which would
+    corrupt any value ≥ 2^31 (e.g. nanosecond timestamps)."""
+    arr = np.ascontiguousarray(arr)
     mesh = process_mesh()
     nproc = mesh.devices.size
     if nproc == 1:
-        return np.asarray(arr)[None]
-    garr = _lift(jnp.asarray(arr))
+        return arr[None]
+    is64 = arr.dtype == np.int64
+    wire = arr.view(np.int32) if is64 else arr
+    garr = _lift(jnp.asarray(wire))
     rep = jax.jit(lambda g: g, out_shardings=_replicated(mesh))(garr)
-    return np.asarray(rep).reshape((nproc,) + arr.shape)
+    out = np.ascontiguousarray(np.asarray(rep))
+    if is64:
+        out = out.view(np.int64)
+    return out.reshape((nproc,) + arr.shape)
 
 
 def barrier(name: Optional[str] = None) -> None:
@@ -469,11 +491,13 @@ def join() -> int:
     me = jax.process_index()
     if nproc == 1:
         return 0
-    # order of arrival is not observable without a negotiation thread;
-    # reference returns the last rank to join — we return the max rank that
-    # reported the latest logical join counter.
+    # order of arrival is not observable without a negotiation thread; the
+    # reference returns the last rank to join.  Best cross-host signal:
+    # wall-clock ns at the moment each process entered join() — comparable
+    # across NTP-synced hosts (monotonic clocks have per-host epochs and
+    # would be meaningless here).  Exchanged losslessly as int64.
     import time
 
-    stamp = np.asarray([time.monotonic_ns(), me], np.int64)
+    stamp = np.asarray([time.time_ns(), me], np.int64)
     all_stamps = _allgather_host_metadata(stamp).reshape(nproc, 2)
     return int(all_stamps[np.argmax(all_stamps[:, 0]), 1])

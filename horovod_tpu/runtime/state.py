@@ -106,9 +106,17 @@ class GlobalState:
         self.is_homogeneous = (self.size == local * self.process_count)
 
         # cross = slice/host-level (reference CROSS communicator,
-        # common.h:113-117)
+        # common.h:113-117).  A process's CROSS identity is the slice its
+        # devices live on — NOT its process rank; slices may span several
+        # processes.
         self.cross_size = self.mesh.shape[topology.AXIS_DCN]
-        self.cross_rank = min(self.process_rank, self.cross_size - 1)
+        sid = getattr(jax.local_devices()[0], "slice_index", None)
+        if sid is None:
+            # off-TPU there is no slice topology; processes are laid out
+            # over the dcn axis in rank order
+            sid = (self.process_rank * self.cross_size) // max(
+                self.process_count, 1)
+        self.cross_rank = min(int(sid), self.cross_size - 1)
         if cfg.cross_rank is not None:
             self.cross_rank = cfg.cross_rank
         if cfg.cross_size is not None:
@@ -155,6 +163,15 @@ _state: Optional[GlobalState] = None
 _state_lock = threading.Lock()
 
 
+@atexit.register
+def _shutdown_at_exit() -> None:
+    # one process-wide hook, not one per init() — elastic resets re-init
+    # many times (reference registers its background-thread teardown once
+    # in InitializeHorovodOnce)
+    if _state is not None:
+        _state.shutdown()
+
+
 def init(ranks: Optional[list] = None, config: Optional[Config] = None) -> GlobalState:
     """Create (or return) the singleton; idempotent like ``horovod_init``
     (reference ``operations.cc:620`` InitializeHorovodOnce)."""
@@ -166,7 +183,6 @@ def init(ranks: Optional[list] = None, config: Optional[Config] = None) -> Globa
         st = GlobalState(cfg)
         st.initialize(ranks)
         _state = st
-        atexit.register(st.shutdown)
         return st
 
 

@@ -74,7 +74,7 @@ class ParameterManager:
 
     def _finish_point(self) -> None:
         elapsed = max(time.monotonic() - self._point_start, 1e-9)
-        score = self._bytes_this_point / elapsed  # bytes/sec, reference metric
+        score = self._score_across_processes(self._bytes_this_point, elapsed)
         point = self._points[self._point_idx]
         self._scores.append((score, point))
         self._log_rows.append({
@@ -108,6 +108,30 @@ class ParameterManager:
                 "autotune converged: fusion_threshold=%d cycle_time=%.1fms",
                 self._config.fusion_threshold_bytes, self._config.cycle_time_ms)
             self._write_log()
+
+    def _score_across_processes(self, nbytes: int, elapsed: float) -> float:
+        """Agree on one score for this sample point across all processes.
+
+        Locally-timed scores differ per process; applying per-process
+        winners would set divergent fusion thresholds and break the
+        bucketer's every-process-fuses-the-same-set invariant.  The
+        reference solves this by rank-0 tuning + parameter broadcast
+        (``controller.cc:34-48``); here every process derives the identical
+        score from a metadata allgather — total bytes over the slowest
+        process's elapsed time.  All processes reach this exchange at the
+        same flush index because flush decisions follow program order.
+        """
+        import numpy as np
+
+        from horovod_tpu.ops import eager
+
+        if eager.process_mesh().devices.size == 1:
+            return nbytes / elapsed
+        sample = np.asarray([nbytes, int(elapsed * 1e9)], np.int64)
+        gathered = eager._allgather_host_metadata(sample)
+        total_bytes = float(gathered[:, 0].sum())
+        slowest_s = max(float(gathered[:, 1].max()) / 1e9, 1e-9)
+        return total_bytes / slowest_s
 
     def _write_log(self) -> None:
         if not self._log_path or not self._log_rows:

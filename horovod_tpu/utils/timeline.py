@@ -109,16 +109,6 @@ class Timeline:
         self._file.close()
 
 
-class NoOpTimeline:
-    """Used when HOROVOD_TIMELINE is unset — keeps call sites branch-free."""
-
-    def start_activity(self, *a, **k): pass
-    def end_activity(self, *a, **k): pass
-    def instant(self, *a, **k): pass
-    def mark_cycle_start(self): pass
-    def close(self): pass
-
-
 def activity(tensor_name: str, name: str):
     """Context manager recording one activity on the runtime timeline."""
     from horovod_tpu.runtime import state

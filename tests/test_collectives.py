@@ -254,3 +254,20 @@ class TestControlPrimitives:
         np.testing.assert_array_equal(np.asarray(band).ravel(), 1)
         expected_or = 1 | sum(1 << (r + 1) for r in range(N))
         np.testing.assert_array_equal(np.asarray(bor).ravel(), expected_or)
+
+    def test_bitwise_high_bits(self):
+        """All 32 bits participate, incl. bit 30 and the sign bit (the
+        reference's CrossRankBitwiseOr operates on full machine words)."""
+        def f():
+            r = C.axis_index(GLOBAL_AXES)
+            hi = jnp.int32(np.int32(-2**31))  # sign bit
+            x = jnp.where(r == 0, jnp.asarray([1 << 30], jnp.int32) | hi,
+                          jnp.asarray([0], jnp.int32))
+            common = jnp.asarray([(1 << 30) | 5], jnp.int32) | hi
+            return C.bitwise_or(x)[None], C.bitwise_and(common)[None]
+
+        bor, band = run_spmd(f, out_specs=(P(GLOBAL_AXES), P(GLOBAL_AXES)))
+        expected_or = np.int32((1 << 30) | -2**31)
+        np.testing.assert_array_equal(np.asarray(bor).ravel(), expected_or)
+        expected_and = np.int32((1 << 30) | 5 | -2**31)
+        np.testing.assert_array_equal(np.asarray(band).ravel(), expected_and)

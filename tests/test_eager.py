@@ -55,6 +55,22 @@ class TestEagerCollectives:
                             prescale_factor=3.0, postscale_factor=0.5)
         np.testing.assert_allclose(np.asarray(out), 1.5)
 
+    def test_zero_scale_factor_applied(self):
+        """0.0 is a legal scale factor and must not be skipped (reference
+        accepts arbitrary double pre/postscale factors)."""
+        x = jnp.ones((4,), jnp.float32)
+        out = hvd.allreduce(x, name="t1z", op=hvd.Sum, prescale_factor=0.0)
+        np.testing.assert_allclose(np.asarray(out), 0.0)
+        out = hvd.allreduce(x, name="t1z2", op=hvd.Sum, postscale_factor=0.0)
+        np.testing.assert_allclose(np.asarray(out), 0.0)
+
+    def test_int64_metadata_roundtrip(self):
+        """Host metadata exchange must not truncate int64 (timestamps)."""
+        from horovod_tpu.ops.eager import _allgather_host_metadata
+        big = np.asarray([945563671418, -7, 2**40 + 3], np.int64)
+        out = _allgather_host_metadata(big)
+        np.testing.assert_array_equal(out[0], big)
+
     def test_async_handle_lifecycle(self):
         x = jnp.ones((2,), jnp.float32)
         h = hvd.allreduce_async(x, name="t2")
