@@ -1,0 +1,109 @@
+"""Ring attention: exact long-context attention over a sequence axis.
+
+Q stays put; K/V blocks rotate around the mesh axis with
+``lax.ppermute`` while each shard folds the visiting block into a
+numerically-stable online-softmax accumulator (the blockwise/flash
+recurrence).  After ``world`` steps every query has attended to the full
+global sequence, using only neighbor exchanges that ride the ICI torus —
+no shard ever materializes the full K/V or the (T, T) score matrix, so
+context length scales linearly with the number of chips.
+
+This is an extension beyond the reference (SURVEY §5.7: sequence
+parallelism is absent there; its ``alltoall`` primitive is the closest
+building block — see :mod:`~horovod_tpu.parallel.ulysses` for the
+alltoall formulation).
+
+Call inside ``shard_map`` with the sequence dimension sharded over
+``axis_name``.  Differentiable by construction: autodiff flows through
+the scan and ``ppermute`` (whose transpose is the inverse rotation), so
+the backward pass is itself a ring pass.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Exact attention with K/V ring-rotated over ``axis_name``.
+
+    Args:
+      q, k, v: per-shard blocks ``(batch, seq_local, heads, head_dim)``;
+        the global sequence is the concatenation of shards in axis order.
+      axis_name: mesh axis the sequence is sharded over.
+      causal: apply a causal mask in *global* sequence positions.
+      scale: score scale; default ``head_dim ** -0.5``.
+
+    Returns:
+      Attention output ``(batch, seq_local, heads, head_dim)``, the exact
+      softmax attention over the full global sequence.
+    """
+    world = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = d ** -0.5 if scale is None else scale
+
+    qf = q.astype(jnp.float32)
+    # send K/V to the next shard: after s steps we hold the block that
+    # started at shard (my_idx - s) % world
+    perm = [(i, (i + 1) % world) for i in range(world)]
+
+    q_pos = my_idx * tq + jnp.arange(tq)
+
+    def step(carry, s):
+        o, m, l, k_cur, v_cur = carry
+        kv_idx = (my_idx - s) % world
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                            k_cur.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = kv_idx * tk + jnp.arange(tk)
+            allowed = q_pos[:, None] >= k_pos[None, :]        # (tq, tk)
+            scores = jnp.where(allowed[None, None], scores, _NEG_INF)
+            allowed_f = allowed.astype(jnp.float32)[None, None]
+        else:
+            allowed_f = jnp.float32(1.0)
+        m_new = jnp.maximum(m, scores.max(axis=-1))           # (b, h, tq)
+        # multiply by the mask so fully-masked blocks contribute exactly 0
+        # even while m_new is still at the -inf sentinel
+        p = jnp.exp(scores - m_new[..., None]) * allowed_f    # (b, h, tq, tk)
+        corr = jnp.exp(m - m_new)                             # (b, h, tq)
+        l_new = l * corr + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
+        o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+        k_nxt, v_nxt = lax.ppermute((k_cur, v_cur), axis_name, perm)
+        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+
+    o0 = jnp.zeros((b, tq, h, d), jnp.float32)
+    m0 = jnp.full((b, h, tq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, tq), jnp.float32)
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v),
+                                  jnp.arange(world))
+    denom = jnp.maximum(l, jnp.float32(1e-30)).transpose(0, 2, 1)[..., None]
+    return (o / denom).astype(q.dtype)
+
+
+def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = False,
+                        scale: Optional[float] = None) -> jax.Array:
+    """Plain single-device softmax attention (the numerics oracle for
+    ring/ulysses tests, and the local attention inside Ulysses)."""
+    d = q.shape[-1]
+    scale = d ** -0.5 if scale is None else scale
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        allowed = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        scores = jnp.where(allowed[None, None], scores, _NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

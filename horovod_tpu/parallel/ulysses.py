@@ -1,0 +1,68 @@
+"""Ulysses-style sequence parallelism: all-to-all sequence↔head exchange.
+
+DeepSpeed-Ulysses observes that attention is embarrassingly parallel over
+*heads*: shards holding sequence slices all-to-all their Q/K/V so each
+shard holds the FULL sequence for a subset of heads, run ordinary (or
+flash) attention locally, then all-to-all back to sequence shards.  Two
+``all_to_all`` pairs per attention — the collective the reference added
+as a first-class op in this very version (``operations.cc:979``,
+``nccl_operations.cc:569``; SURVEY §5.7 names it as the primitive SP
+builds on).  On TPU the exchange is one XLA ``all_to_all`` riding ICI.
+
+Trade-off vs :mod:`~horovod_tpu.parallel.ring_attention`: Ulysses moves
+activations twice but runs one dense local attention (better MXU
+utilization, needs ``heads % world == 0``); ring keeps activations put
+and pipelines K/V around the torus (unbounded context, any head count).
+
+Call inside ``shard_map`` with the sequence dimension sharded over
+``axis_name``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+from jax import lax
+
+from horovod_tpu.parallel.ring_attention import reference_attention
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str, causal: bool = False,
+                      attn_fn: Optional[Callable] = None) -> jax.Array:
+    """Attention over the global sequence via head-sharded local attention.
+
+    Args:
+      q, k, v: per-shard blocks ``(batch, seq_local, heads, head_dim)``
+        with ``heads`` divisible by the axis size.
+      axis_name: mesh axis the sequence is sharded over.
+      causal: causal masking (positions are global after the exchange, so
+        the local mask is exact).
+      attn_fn: ``f(q, k, v, causal) -> out`` over full-sequence inputs;
+        defaults to dense softmax attention.
+
+    Returns:
+      ``(batch, seq_local, heads, head_dim)`` exact global attention.
+    """
+    world = lax.axis_size(axis_name)
+    heads = q.shape[2]
+    if heads % world != 0:
+        raise ValueError(
+            f"ulysses_attention needs heads ({heads}) divisible by the "
+            f"'{axis_name}' axis size ({world}); use ring_attention for "
+            f"arbitrary head counts")
+    attn_fn = attn_fn or (lambda q_, k_, v_, c: reference_attention(
+        q_, k_, v_, causal=c))
+
+    # (b, t_local, h, d) -> (b, t_global, h_local, d): scatter heads,
+    # gather sequence
+    def to_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    out = attn_fn(qh, kh, vh, causal)
+    # inverse exchange: back to sequence shards with all heads
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
