@@ -1,0 +1,133 @@
+"""Host discovery for elastic training.
+
+Reference: ``horovod/runner/elastic/discovery.py`` — ``HostDiscovery``
+interface, ``HostDiscoveryScript`` (user script printing ``host:slots``
+lines, re-run every second), ``FixedHosts`` (the built-in test fake), and
+``HostManager`` which diffs discoveries, applies the blacklist and keeps
+a stable host ordering for rank assignment.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+from typing import Dict, List, Optional
+
+from horovod_tpu.utils import logging as hvd_logging
+
+
+class HostUpdateResult:
+    """Bitmask of what changed in a discovery pass (reference enum)."""
+
+    no_update = 0
+    removed = 1
+    added = 2
+    mixed = removed | added
+
+
+class HostDiscovery:
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        """Return ``{hostname: slots}`` for every currently-usable host."""
+        raise NotImplementedError
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """Execute the user's discovery script; stdout lines are
+    ``hostname:slots`` (or bare hostnames with ``default_slots``)."""
+
+    def __init__(self, discovery_script: str, default_slots: int = 1):
+        self._script = discovery_script
+        self._default_slots = default_slots
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        out = subprocess.check_output(
+            self._script, shell=True, timeout=60).decode()
+        hosts: Dict[str, int] = {}
+        for line in out.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if ":" in line:
+                name, _, slots = line.rpartition(":")
+                hosts[name] = int(slots)
+            else:
+                hosts[line] = self._default_slots
+        return hosts
+
+
+class FixedHosts(HostDiscovery):
+    """Static (but settable) host set — the reference's test fake, also
+    used for ``-H``-style elastic runs."""
+
+    def __init__(self, available_hosts: Optional[Dict[str, int]] = None):
+        self._hosts = dict(available_hosts or {})
+
+    def set(self, available_hosts: Dict[str, int]) -> None:
+        self._hosts = dict(available_hosts)
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return dict(self._hosts)
+
+
+class HostManager:
+    """Tracks the discovered host set, the blacklist, and a stable
+    assignment order (reference ``HostManager``): surviving hosts keep
+    their position, new hosts append — the property that lets surviving
+    workers keep their ranks across resets."""
+
+    def __init__(self, discovery: HostDiscovery):
+        self._discovery = discovery
+        self._lock = threading.Lock()
+        self._available: Dict[str, int] = {}
+        self._order: List[str] = []
+        self._blacklist: set = set()
+
+    def update_available_hosts(self) -> int:
+        """Run one discovery pass; returns a :class:`HostUpdateResult`
+        bitmask describing the delta."""
+        found = self._discovery.find_available_hosts_and_slots()
+        with self._lock:
+            found = {h: s for h, s in found.items()
+                     if h not in self._blacklist}
+            prev = self._available
+            res = HostUpdateResult.no_update
+            if any(h not in found or found[h] < prev[h] for h in prev):
+                res |= HostUpdateResult.removed
+            if any(h not in prev or found[h] > prev[h] for h in found):
+                res |= HostUpdateResult.added
+            self._available = found
+            self._order = [h for h in self._order if h in found] + \
+                          [h for h in found if h not in self._order]
+            return res
+
+    @property
+    def current_hosts(self) -> Dict[str, int]:
+        with self._lock:
+            return {h: self._available[h] for h in self._order}
+
+    @property
+    def assignment_order(self) -> List[str]:
+        with self._lock:
+            return list(self._order)
+
+    def blacklist(self, host: str) -> bool:
+        """Exclude a host from all future assignments (reference
+        blacklisting of failing hosts).  Returns True if newly added."""
+        with self._lock:
+            if host in self._blacklist:
+                return False
+            hvd_logging.warning("elastic: blacklisting host %s", host)
+            self._blacklist.add(host)
+            self._available.pop(host, None)
+            if host in self._order:
+                self._order.remove(host)
+            return True
+
+    def is_blacklisted(self, host: str) -> bool:
+        with self._lock:
+            return host in self._blacklist
+
+    @property
+    def available_slots(self) -> int:
+        with self._lock:
+            return sum(self._available.values())

@@ -1,0 +1,305 @@
+"""The elastic driver: orchestrates a dynamic worker set.
+
+Reference: ``horovod/runner/elastic/driver.py`` — periodic host
+discovery (1 s), rank-stable reassignment on host changes, host
+blacklisting on worker failure, worker notification, and the rendezvous
+workers query for their new identity after a reset.  The TPU twist: each
+world generation gets a fresh ``jax.distributed`` coordinator address
+(XLA's world is static per generation), handed out through the same
+rendezvous RPC.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from horovod_tpu.elastic.discovery import HostManager, HostUpdateResult
+from horovod_tpu.elastic.registration import WorkerStateRegistry
+from horovod_tpu.runner.hosts import HostInfo, SlotInfo, get_host_assignments
+from horovod_tpu.runner.network import (
+    AckResponse,
+    BasicService,
+    RegisterWorkerRequest,
+    notify_hosts_updated,
+)
+from horovod_tpu.utils import logging as hvd_logging
+
+DISCOVER_INTERVAL_S = 1.0    # reference driver.py:30
+
+
+class GetRankAndSizeRequest:
+    """Worker → driver: my (host, local_rank); give me my current
+    assignment (reference ``ElasticRendezvousHandler`` GET rank_and_size)."""
+
+    def __init__(self, host: str, local_rank: int, generation: int = -1):
+        self.host = host
+        self.local_rank = local_rank
+        self.generation = generation
+
+
+class RankAndSizeResponse:
+    def __init__(self, slot: Optional[SlotInfo], coordinator_addr: str,
+                 generation: int):
+        self.slot = slot
+        self.coordinator_addr = coordinator_addr
+        self.generation = generation
+
+
+class ElasticDriver:
+    def __init__(self, discovery, min_np: int, max_np: Optional[int] = None,
+                 timeout: float = 600.0, reset_limit: int = 0,
+                 secret_key: Optional[str] = None):
+        self._host_manager = HostManager(discovery)
+        self._registry = WorkerStateRegistry(self, self._host_manager,
+                                             reset_limit=reset_limit)
+        self._min_np = min_np
+        self._max_np = max_np
+        self._timeout = timeout
+        self._secret_key = secret_key
+
+        self._lock = threading.RLock()
+        self._assignments: Dict[Tuple[str, int], SlotInfo] = {}
+        self._generation = 0
+        self._coordinator_addr = ""
+        self._worker_notify_addrs: Dict[int, Tuple[str, int]] = {}
+        self._create_worker_fn: Optional[Callable] = None
+        self._shutdown = threading.Event()
+        self._resume_lock = threading.Lock()   # serialize concurrent resumes
+        self._hosts_avail = threading.Event()
+        self._exit_code: Optional[int] = None
+        self._finished = threading.Event()
+
+        self._service = BasicService("elastic_driver", secret_key,
+                                     self._handle, host="0.0.0.0")
+        self._discovery_thread = threading.Thread(
+            target=self._discovery_loop, daemon=True,
+            name="hvd_tpu_elastic_discovery")
+
+    # -- service plumbing ---------------------------------------------------
+
+    @property
+    def registry(self) -> WorkerStateRegistry:
+        return self._registry
+
+    @property
+    def host_manager(self) -> HostManager:
+        return self._host_manager
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._service.address
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    def _handle(self, req):
+        if isinstance(req, RegisterWorkerRequest):
+            with self._lock:
+                self._worker_notify_addrs[req.rank] = tuple(req.address)
+            return AckResponse()
+        if isinstance(req, GetRankAndSizeRequest):
+            with self._lock:
+                slot = self._assignments.get((req.host, req.local_rank))
+                return RankAndSizeResponse(slot, self._coordinator_addr,
+                                           self._generation)
+        raise ValueError(f"unexpected request {type(req).__name__}")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, np: int, create_worker_fn: Callable) -> None:
+        """Wait for ``min(np, …)`` slots, compute assignments, spawn all
+        workers (reference ``driver.start``)."""
+        self._create_worker_fn = create_worker_fn
+        self._service.start()
+        self._discovery_thread.start()
+        self.wait_for_available_slots(self._min_np)
+        with self._lock:
+            self._update_host_assignments()
+        self._spawn_all()
+
+    def stop(self, exit_code: int = 1) -> None:
+        if not self._finished.is_set():
+            self._exit_code = exit_code
+            self._finished.set()
+        self._shutdown.set()
+
+    def finished(self) -> bool:
+        return self._finished.is_set()
+
+    def wait_for_completion(self) -> int:
+        self._finished.wait()
+        self._service.shutdown()
+        return self._exit_code if self._exit_code is not None else 0
+
+    def wait_for_available_slots(self, min_np: int) -> None:
+        """Block until discovery supplies ≥ min_np slots (reference
+        ``wait_for_available_slots:145``)."""
+        deadline = time.monotonic() + self._timeout
+        while not self._shutdown.is_set():
+            if self._host_manager.available_slots >= min_np:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"timed out waiting for {min_np} slots; discovered "
+                    f"{self._host_manager.available_slots}")
+            self._hosts_avail.wait(timeout=DISCOVER_INTERVAL_S)
+            self._hosts_avail.clear()
+
+    # -- discovery / reassignment ------------------------------------------
+
+    def _discovery_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                res = self._host_manager.update_available_hosts()
+            except Exception as e:
+                hvd_logging.warning("elastic: discovery failed: %s", e)
+                res = HostUpdateResult.no_update
+            if res != HostUpdateResult.no_update:
+                hvd_logging.info("elastic: host set changed (res=%d)", res)
+                self._hosts_avail.set()
+                with self._lock:
+                    started = bool(self._assignments)
+                if started:
+                    # recompute assignments + spawn added workers + notify
+                    # survivors; async so discovery keeps feeding
+                    # wait_for_available_slots during the resume
+                    threading.Thread(target=self.resume, daemon=True).start()
+            self._shutdown.wait(DISCOVER_INTERVAL_S)
+
+    def _notify_workers_host_changes(self, res: int) -> None:
+        """Ping every registered worker so rank 0's next commit raises
+        HostsUpdatedInterrupt (reference ``driver.py:197-225``)."""
+        timestamp = int(time.time() * 1e6)
+        with self._lock:
+            addrs = dict(self._worker_notify_addrs)
+        for rank, addr in addrs.items():
+            try:
+                notify_hosts_updated(addr, self._secret_key, timestamp, res)
+            except OSError as e:
+                hvd_logging.debug(
+                    "elastic: could not notify rank %d at %s: %s",
+                    rank, addr, e)
+
+    def _update_host_assignments(self) -> Dict[Tuple[str, int], SlotInfo]:
+        """Recompute SlotInfos with ranks stable for surviving workers
+        (reference ``_update_host_assignments:227``): hosts keep their
+        discovery order, so a surviving (host, local_rank) keeps its rank
+        unless an earlier host vanished; at least one previously-assigned
+        host must survive to carry the state forward."""
+        current = self._host_manager.current_hosts
+        prev = self._assignments
+        if prev:
+            surviving = {h for h, _ in prev} & set(current)
+            if not surviving:
+                raise RuntimeError(
+                    "elastic: no previously-assigned host survived — model "
+                    "state is lost (reference guarantee driver.py:236-242)")
+        hosts = [HostInfo(h, s) for h, s in current.items()]
+        assignments = get_host_assignments(
+            hosts, self._min_np,
+            self._max_np or sum(h.slots for h in hosts))
+        self._assignments = {(s.hostname, s.local_rank): s
+                             for s in assignments}
+        self._coordinator_addr = self._new_coordinator_addr(assignments)
+        self._generation += 1
+        return self._assignments
+
+    def _new_coordinator_addr(self, assignments: List[SlotInfo]) -> str:
+        """Fresh jax.distributed coordinator per generation, on rank 0's
+        host (the process that will bind it)."""
+        rank0_host = next(s.hostname for s in assignments if s.rank == 0)
+        if rank0_host in ("localhost", "127.0.0.1", socket.gethostname()):
+            rank0_host = "127.0.0.1"
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        return f"{rank0_host}:{port}"
+
+    # -- worker management --------------------------------------------------
+
+    def _spawn_all(self) -> None:
+        with self._lock:
+            slots = list(self._assignments.values())
+        for slot in slots:
+            self._spawn(slot)
+
+    def _spawn(self, slot: SlotInfo) -> None:
+        self._registry.record_ready(slot.hostname, slot.local_rank)
+        thread = threading.Thread(
+            target=self._run_worker, args=(slot,), daemon=True,
+            name=f"hvd_tpu_elastic_worker_{slot.rank}")
+        thread.start()
+
+    def _run_worker(self, slot: SlotInfo) -> None:
+        with self._lock:
+            coordinator = self._coordinator_addr
+            generation = self._generation
+        try:
+            exit_code = self._create_worker_fn(slot, coordinator, generation)
+        except Exception as e:
+            hvd_logging.warning("elastic: worker rank %d crashed in "
+                                "launcher: %s", slot.rank, e)
+            exit_code = 1
+        self.record_worker_exit(slot.hostname, slot.local_rank, exit_code)
+
+    def record_worker_exit(self, host: str, local_rank: int,
+                           exit_code: int) -> None:
+        """Reference ``_handle_worker_exit``: zero → success (job completes
+        when every assigned worker succeeded); non-zero → blacklist +
+        resume with survivors."""
+        if exit_code == 0:
+            self._registry.record_success(host, local_rank)
+            with self._lock:
+                all_done = all(
+                    self._registry.get_state(h, lr) == "SUCCESS"
+                    for (h, lr) in self._assignments)
+            if all_done:
+                self._exit_code = 0
+                self._finished.set()
+                self._shutdown.set()
+        else:
+            hvd_logging.warning(
+                "elastic: worker %s:%d exited with code %d",
+                host, local_rank, exit_code)
+            self._registry.record_failure(host, local_rank)
+
+    def resume(self) -> None:
+        """Failure/host-change recovery: recompute assignments, spawn
+        workers for newly-added slots, notify survivors (reference
+        ``driver.resume``)."""
+        if self._shutdown.is_set():
+            return
+        with self._resume_lock:
+            try:
+                self.wait_for_available_slots(self._min_np)
+            except TimeoutError as e:
+                hvd_logging.warning("elastic: %s", e)
+                self.stop(1)
+                return
+            with self._lock:
+                before = set(self._assignments)
+                try:
+                    self._update_host_assignments()
+                except RuntimeError as e:
+                    hvd_logging.warning("elastic: %s", e)
+                    self.stop(1)
+                    return
+                added = [s for k, s in self._assignments.items()
+                         if k not in before]
+            for slot in added:
+                self._spawn(slot)
+            self._notify_workers_host_changes(HostUpdateResult.mixed)
+
+    def get_slot_info(self, host: str, local_rank: int) -> Optional[SlotInfo]:
+        with self._lock:
+            return self._assignments.get((host, local_rank))
+
+    @property
+    def world_size(self) -> int:
+        with self._lock:
+            return len(self._assignments)

@@ -1,0 +1,75 @@
+"""Worker lifecycle registry for the elastic driver.
+
+Reference: ``horovod/runner/elastic/registration.py`` —
+``WorkerStateRegistry`` collects per-worker READY/SUCCESS/FAILURE
+records, acts as the barrier deciding when a generation is complete, and
+triggers ``driver.resume()`` when a failure requires re-assignment.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Tuple
+
+from horovod_tpu.utils import logging as hvd_logging
+
+READY = "READY"
+SUCCESS = "SUCCESS"
+FAILURE = "FAILURE"
+
+
+class WorkerStateRegistry:
+    def __init__(self, driver, host_manager, reset_limit: int = 0):
+        self._driver = driver
+        self._host_manager = host_manager
+        self._lock = threading.Lock()
+        self._states: Dict[Tuple[str, int], str] = {}
+        self._reset_limit = reset_limit      # 0 = unlimited resets
+        self._reset_count = 0
+        self._failure_count = 0
+
+    @property
+    def reset_count(self) -> int:
+        return self._reset_count
+
+    def get_state(self, host: str, local_rank: int) -> str:
+        with self._lock:
+            return self._states.get((host, local_rank), "")
+
+    def record_ready(self, host: str, local_rank: int) -> None:
+        with self._lock:
+            self._states[(host, local_rank)] = READY
+
+    def record_success(self, host: str, local_rank: int) -> None:
+        with self._lock:
+            self._states[(host, local_rank)] = SUCCESS
+
+    def record_failure(self, host: str, local_rank: int) -> None:
+        """A worker exited non-zero: blacklist its host once failures
+        exceed its slot count is NOT the reference rule — the reference
+        blacklists immediately on failure exit (``driver.py:291-307``) and
+        resumes with the survivors."""
+        with self._lock:
+            self._states[(host, local_rank)] = FAILURE
+            self._failure_count += 1
+        self._host_manager.blacklist(host)
+        self._maybe_resume()
+
+    def _maybe_resume(self) -> None:
+        with self._lock:
+            if self._reset_limit and self._reset_count >= self._reset_limit:
+                hvd_logging.warning(
+                    "elastic: reset limit %d reached — stopping job",
+                    self._reset_limit)
+                self._driver.stop()
+                return
+            self._reset_count += 1
+        self._driver.resume()
+
+    def count(self, state: str) -> int:
+        with self._lock:
+            return sum(1 for s in self._states.values() if s == state)
+
+    def reset(self, expected: int) -> None:
+        with self._lock:
+            self._states = {}

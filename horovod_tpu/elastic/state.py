@@ -238,10 +238,14 @@ def _reset() -> None:
     every jitted collective — accomplished by clearing the compiled-fn
     caches so first use recompiles against the new mesh.
     """
+    from horovod_tpu.elastic.worker import refresh_assignment_from_driver
     from horovod_tpu.ops import eager
     from horovod_tpu.runtime import state as rt_state
 
     rt_state.shutdown()
+    # under an elastic launcher: pull the new rank/size/coordinator from
+    # the driver's rendezvous before re-initializing
+    refresh_assignment_from_driver()
     # leave the old coordination-service world: without this,
     # jax.distributed stays initialized, GlobalState.initialize skips the
     # re-rendezvous, and the rebuilt mesh would still contain dead peers

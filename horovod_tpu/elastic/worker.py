@@ -73,6 +73,44 @@ class WorkerNotificationService:
         return self._server.address
 
 
+def refresh_assignment_from_driver(timeout_s: float = 60.0) -> bool:
+    """After a reset, fetch this worker's new identity from the elastic
+    driver's rendezvous RPC and export it into the env the runtime reads
+    (reference: workers re-read rank/size from the rendezvous on reset,
+    ``elastic/rendezvous.py``).  No-op (False) outside elastic runs."""
+    import socket
+    import time
+
+    driver_addr = os.environ.get("HOROVOD_ELASTIC_DRIVER_ADDR")
+    if not driver_addr:
+        return False
+    from horovod_tpu.elastic.driver import GetRankAndSizeRequest
+    from horovod_tpu.runner.network import BasicClient
+
+    key = os.environ.get("HOROVOD_SECRET_KEY")
+    hostname = os.environ.get("HOROVOD_HOSTNAME", socket.gethostname())
+    local_rank = int(os.environ.get("HOROVOD_LOCAL_RANK", "0"))
+    known_gen = int(os.environ.get("HOROVOD_ELASTIC_GENERATION", "-1"))
+    host, port = driver_addr.rsplit(":", 1)
+    client = BasicClient((host, int(port)), key)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        resp = client.request(
+            GetRankAndSizeRequest(hostname, local_rank, known_gen))
+        if resp.slot is not None and resp.generation >= known_gen:
+            os.environ.update(resp.slot.to_env())
+            os.environ["HOROVOD_COORDINATOR_ADDR"] = resp.coordinator_addr
+            os.environ["HOROVOD_ELASTIC_GENERATION"] = str(resp.generation)
+            hvd_logging.info(
+                "elastic: new assignment rank=%d/%d (generation %d)",
+                resp.slot.rank, resp.slot.size, resp.generation)
+            return True
+        time.sleep(0.5)
+    raise TimeoutError(
+        f"elastic: no assignment for ({hostname}, {local_rank}) from "
+        f"driver within {timeout_s}s — this worker may have been removed")
+
+
 _manager: Optional[WorkerNotificationManager] = None
 _manager_lock = threading.Lock()
 

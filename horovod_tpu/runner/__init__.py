@@ -1,5 +1,11 @@
 """Launcher: hvdrun CLI, host assignment, rendezvous, elastic driver plumbing.
 
 Reference: ``horovod/runner/`` (launch.py CLI, gloo_run/mpi_run, driver and
-task services, elastic driver).
+task services, elastic driver).  Programmatic entry:
+``horovod_tpu.runner.run(fn, np=4)`` (reference ``horovod.run``,
+``runner/__init__.py:90``).
 """
+
+from horovod_tpu.runner.api import run
+
+__all__ = ["run"]

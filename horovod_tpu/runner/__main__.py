@@ -1,0 +1,5 @@
+"""``python -m horovod_tpu.runner`` == ``hvdrun``."""
+
+from horovod_tpu.runner.launch import main
+
+main()

@@ -1,0 +1,68 @@
+"""CLI args / YAML config → ``HOROVOD_*`` env plumbing.
+
+Reference: ``horovod/runner/common/util/config_parser.py`` — a YAML
+``--config-file`` populates defaults for CLI args, and resolved args are
+exported as the env vars the core reads (three converging config layers,
+SURVEY §5.6).  Same contract here; the knob names match
+``runtime/config.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+# YAML section.key → (CLI arg attribute, env var)
+_PARAMS = [
+    ("fusion.threshold_mb", "fusion_threshold_mb", "HOROVOD_FUSION_THRESHOLD"),
+    ("fusion.cycle_time_ms", "cycle_time_ms", "HOROVOD_CYCLE_TIME"),
+    ("cache.capacity", "cache_capacity", "HOROVOD_CACHE_CAPACITY"),
+    ("autotune.enabled", "autotune", "HOROVOD_AUTOTUNE"),
+    ("autotune.log_file", "autotune_log_file", "HOROVOD_AUTOTUNE_LOG"),
+    ("timeline.filename", "timeline_filename", "HOROVOD_TIMELINE"),
+    ("timeline.mark_cycles", "timeline_mark_cycles",
+     "HOROVOD_TIMELINE_MARK_CYCLES"),
+    ("stall_check.disable", "no_stall_check", "HOROVOD_STALL_CHECK_DISABLE"),
+    ("stall_check.warning_time_seconds", "stall_warning_time_seconds",
+     "HOROVOD_STALL_CHECK_TIME_SECONDS"),
+    ("stall_check.shutdown_time_seconds", "stall_shutdown_time_seconds",
+     "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"),
+    ("library_options.mesh_shape", "mesh_shape", "HOROVOD_TPU_MESH_SHAPE"),
+    ("library_options.tpu_operations", "tpu_operations",
+     "HOROVOD_TPU_OPERATIONS"),
+]
+
+
+def load_config_file(path: str) -> Dict[str, Any]:
+    import yaml
+
+    with open(path) as f:
+        return yaml.safe_load(f) or {}
+
+
+def apply_config_defaults(args, config: Dict[str, Any]) -> None:
+    """Fill unset CLI args from the YAML config (CLI wins — reference
+    ``config_parser`` precedence)."""
+    for dotted, attr, _ in _PARAMS:
+        if getattr(args, attr, None) is not None:
+            continue
+        section, _, key = dotted.partition(".")
+        value = (config.get(section) or {}).get(key)
+        if value is not None:
+            setattr(args, attr, value)
+
+
+def set_env_from_args(env: Dict[str, str], args) -> Dict[str, str]:
+    """Export resolved args as the worker env contract (reference
+    ``set_env_from_args``)."""
+    for _, attr, env_var in _PARAMS:
+        value = getattr(args, attr, None)
+        if value is None:
+            continue
+        if isinstance(value, bool):
+            if value:
+                env[env_var] = "1"
+        elif attr == "fusion_threshold_mb":
+            env[env_var] = str(int(value) * 1024 * 1024)
+        else:
+            env[env_var] = str(value)
+    return env

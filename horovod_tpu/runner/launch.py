@@ -1,0 +1,235 @@
+"""``hvdrun`` — the launcher CLI (reference ``horovodrun``).
+
+Reference: ``horovod/runner/launch.py`` (``parse_args:212``,
+``_run_static:484``, ``run_commandline:715``).  Maps the same surface
+onto the TPU runtime: host/hostfile parsing, config-file → env plumbing,
+per-slot env contract (``gloo_context.cc:47-55``), process fan-out with
+fail-fast teardown, and the ``jax.distributed`` coordinator address in
+place of the gloo rendezvous server.
+
+Usage::
+
+    python -m horovod_tpu.runner.launch -np 4 python train.py
+    python -m horovod_tpu.runner.launch -np 4 -H h1:2,h2:2 python train.py
+    python -m horovod_tpu.runner.launch -np 2 --min-np 2 --max-np 4 \
+        --host-discovery-script ./discover.sh python train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import socket
+import sys
+import threading
+from typing import Dict, List, Optional
+
+from horovod_tpu.runner import config_parser, safe_shell_exec
+from horovod_tpu.runner.hosts import (
+    HostInfo,
+    SlotInfo,
+    get_host_assignments,
+    parse_hostfile,
+    parse_hosts,
+)
+
+_LOCAL_NAMES = ("localhost", "127.0.0.1", "::1")
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu distributed job.")
+    p.add_argument("-v", "--version", action="store_true")
+    p.add_argument("-np", "--num-proc", type=int, dest="np",
+                   help="total number of worker processes")
+    p.add_argument("-H", "--hosts", dest="hosts",
+                   help='host list "h1:slots,h2:slots"; default localhost')
+    p.add_argument("--hostfile", dest="hostfile",
+                   help="file with one 'host slots=N' per line")
+    p.add_argument("--ssh-port", type=int, default=None)
+    p.add_argument("--start-timeout", type=int, default=30)
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--output-filename", dest="output_filename",
+                   help="per-rank stdout/stderr directory")
+    p.add_argument("--config-file", dest="config_file")
+    p.add_argument("--check-build", action="store_true",
+                   help="print capability report and exit")
+
+    # elastic (reference --min-np/--max-np/--host-discovery-script)
+    p.add_argument("--min-np", type=int, dest="min_np")
+    p.add_argument("--max-np", type=int, dest="max_np")
+    p.add_argument("--host-discovery-script", dest="host_discovery_script")
+    p.add_argument("--elastic-timeout", type=int, default=600)
+
+    # knobs → env (reference config_parser flag set)
+    p.add_argument("--fusion-threshold-mb", type=int,
+                   dest="fusion_threshold_mb")
+    p.add_argument("--cycle-time-ms", type=float, dest="cycle_time_ms")
+    p.add_argument("--cache-capacity", type=int, dest="cache_capacity")
+    p.add_argument("--autotune", action="store_const", const=True,
+                   dest="autotune")
+    p.add_argument("--autotune-log-file", dest="autotune_log_file")
+    p.add_argument("--timeline-filename", dest="timeline_filename")
+    p.add_argument("--timeline-mark-cycles", action="store_const", const=True,
+                   dest="timeline_mark_cycles")
+    p.add_argument("--no-stall-check", action="store_const", const=True,
+                   dest="no_stall_check")
+    p.add_argument("--stall-warning-time-seconds", type=float,
+                   dest="stall_warning_time_seconds")
+    p.add_argument("--stall-shutdown-time-seconds", type=float,
+                   dest="stall_shutdown_time_seconds")
+    p.add_argument("--mesh-shape", dest="mesh_shape",
+                   help='TPU mesh override "dcn,ici"')
+    p.add_argument("--tpu-operations", dest="tpu_operations")
+
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="training command")
+    return p.parse_args(argv)
+
+
+def _resolve_hosts(args) -> List[HostInfo]:
+    if args.hosts and args.hostfile:
+        raise ValueError("specify --hosts or --hostfile, not both")
+    if args.hostfile:
+        return parse_hostfile(args.hostfile)
+    if args.hosts:
+        return parse_hosts(args.hosts)
+    return [HostInfo("localhost", args.np)]
+
+
+def _is_local(hostname: str) -> bool:
+    return hostname in _LOCAL_NAMES or hostname == socket.gethostname()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _coordinator_addr(hosts: List[HostInfo]) -> str:
+    """jax.distributed coordinator on rank 0's host (the rendezvous-server
+    analogue, reference ``gloo_run.py:213``)."""
+    head = hosts[0].hostname
+    if _is_local(head):
+        head = "127.0.0.1"
+    return f"{head}:{_free_port()}"
+
+
+def build_worker_command(slot: SlotInfo, command: List[str],
+                         ssh_port: Optional[int] = None) -> List[str]:
+    """Local slots exec directly; remote slots go through ssh (reference
+    ``gloo_run.py:113-180`` ssh/exec split)."""
+    if _is_local(slot.hostname):
+        return list(command)
+    ssh = ["ssh", "-o", "StrictHostKeyChecking=no", slot.hostname]
+    if ssh_port:
+        ssh += ["-p", str(ssh_port)]
+    quoted = " ".join(f"'{c}'" for c in command)
+    return ssh + [quoted]
+
+
+def build_worker_env(slot: SlotInfo, base_env: Dict[str, str],
+                     coordinator_addr: str) -> Dict[str, str]:
+    env = dict(base_env)
+    env.update(slot.to_env())
+    env["HOROVOD_COORDINATOR_ADDR"] = coordinator_addr
+    # HOROVOD_RANK/SIZE name the *process* world for jax.distributed
+    env["HOROVOD_CONTROLLER"] = "jax"
+    return env
+
+
+def _run_static(args) -> int:
+    hosts = _resolve_hosts(args)
+    assignments = get_host_assignments(hosts, args.np, args.np)
+    coordinator = _coordinator_addr(hosts)
+    base_env = config_parser.set_env_from_args(dict(os.environ), args)
+
+    if args.verbose:
+        for s in assignments:
+            print(f"[launcher] rank {s.rank} -> {s.hostname} "
+                  f"(local {s.local_rank}/{s.local_size})", file=sys.stderr)
+
+    failures: List[int] = []
+    abort = threading.Event()
+    threads = []
+    out_dir = args.output_filename
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+
+    def run_slot(slot: SlotInfo):
+        cmd = build_worker_command(slot, args.command, args.ssh_port)
+        env = build_worker_env(slot, base_env, coordinator)
+        stdout = stderr = None
+        if out_dir:
+            stdout = open(os.path.join(out_dir, f"rank.{slot.rank}.out"), "wb")
+            stderr = open(os.path.join(out_dir, f"rank.{slot.rank}.err"), "wb")
+        try:
+            rc = safe_shell_exec.execute(cmd, env=env, stdout=stdout,
+                                         stderr=stderr, events=[abort])
+        finally:
+            for f in (stdout, stderr):
+                if f:
+                    f.close()
+        if rc != 0:
+            failures.append(rc)
+            abort.set()   # fail fast: kill the whole job (reference
+            #               gloo_run kills all on any failure)
+
+    for slot in assignments:
+        t = threading.Thread(target=run_slot, args=(slot,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    return failures[0] if failures else 0
+
+
+def _check_build() -> int:
+    import horovod_tpu as hvd
+
+    print("horovod_tpu v" + hvd.__version__)
+    print("Available backends:")
+    print(f"    [{'X' if hvd.xla_built() else ' '}] XLA")
+    print(f"    [{'X' if hvd.tpu_available() else ' '}] TPU")
+    print(f"    [{'X' if hvd.mpi_built() else ' '}] MPI")
+    print(f"    [{'X' if hvd.gloo_built() else ' '}] Gloo")
+    print(f"    [{'X' if hvd.nccl_built() else ' '}] NCCL")
+    return 0
+
+
+def run_commandline(argv: Optional[List[str]] = None) -> int:
+    args = parse_args(argv)
+    if args.version:
+        import horovod_tpu as hvd
+
+        print(hvd.__version__)
+        return 0
+    if args.check_build:
+        return _check_build()
+    if args.config_file:
+        config_parser.apply_config_defaults(
+            args, config_parser.load_config_file(args.config_file))
+    if not args.command:
+        raise SystemExit("no training command given")
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    if args.np is None and not args.host_discovery_script:
+        raise SystemExit("-np is required")
+
+    elastic = bool(args.host_discovery_script or args.min_np or args.max_np)
+    if elastic:
+        from horovod_tpu.elastic.launch import run_elastic
+
+        return run_elastic(args)
+    return _run_static(args)
+
+
+def main() -> None:
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

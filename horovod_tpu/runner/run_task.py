@@ -1,0 +1,7 @@
+"""Worker-side bootstrap for :func:`horovod_tpu.runner.run` (reference
+``horovod/runner/task_fn.py`` role)."""
+
+from horovod_tpu.runner.api import _task_main
+
+if __name__ == "__main__":
+    _task_main()

@@ -1,0 +1,97 @@
+"""Process execution with whole-tree cleanup.
+
+Reference: ``horovod/runner/common/util/safe_shell_exec.py`` — fork +
+``setsid`` so the child owns a process group, SIGTERM the group on
+termination with a grace period, then SIGKILL (``GRACEFUL_TERMINATION_TIME_S``).
+The reference adds a middleman process to survive launcher death; here the
+launcher is long-lived Python, so a killpg-on-exit registry is sufficient
+and keeps worker teardown one signal away.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, IO, List, Optional
+
+GRACEFUL_TERMINATION_TIME_S = 5.0
+
+_active_lock = threading.Lock()
+_active: List[subprocess.Popen] = []
+
+
+def _register(proc: subprocess.Popen) -> None:
+    with _active_lock:
+        _active.append(proc)
+
+
+def _unregister(proc: subprocess.Popen) -> None:
+    with _active_lock:
+        if proc in _active:
+            _active.remove(proc)
+
+
+def terminate(proc: subprocess.Popen,
+              grace_s: float = GRACEFUL_TERMINATION_TIME_S) -> None:
+    """SIGTERM the child's process group, escalate to SIGKILL after the
+    grace period (reference semantics)."""
+    if proc.poll() is not None:
+        return
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        return
+    deadline = time.monotonic() + grace_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return
+        time.sleep(0.05)
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+
+
+def terminate_all(grace_s: float = GRACEFUL_TERMINATION_TIME_S) -> None:
+    with _active_lock:
+        procs = list(_active)
+    for p in procs:
+        terminate(p, grace_s)
+
+
+def launch(command: List[str], env: Optional[Dict[str, str]] = None,
+           stdout: Optional[IO] = None,
+           stderr: Optional[IO] = None) -> subprocess.Popen:
+    """Start a command in its own process group (``setsid``), registered
+    for cleanup via :func:`terminate_all`."""
+    proc = subprocess.Popen(
+        command, env=env,
+        stdout=stdout if stdout is not None else sys.stdout,
+        stderr=stderr if stderr is not None else sys.stderr,
+        start_new_session=True)   # child leads its own process group
+    _register(proc)
+    return proc
+
+
+def execute(command: List[str], env: Optional[Dict[str, str]] = None,
+            stdout: Optional[IO] = None, stderr: Optional[IO] = None,
+            events: Optional[list] = None) -> int:
+    """Run to completion; on any event in ``events`` (``threading.Event``)
+    terminate the whole tree.  Returns the exit code."""
+    proc = launch(command, env=env, stdout=stdout, stderr=stderr)
+    try:
+        if not events:
+            return proc.wait()
+        while True:
+            try:
+                return proc.wait(timeout=0.25)
+            except subprocess.TimeoutExpired:
+                if any(e.is_set() for e in events):
+                    terminate(proc)
+                    return proc.wait()
+    finally:
+        _unregister(proc)

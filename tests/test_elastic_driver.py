@@ -1,0 +1,210 @@
+"""Elastic driver simulation — no cluster needed.
+
+Reference: ``test/test_elastic_driver.py`` — ``FixedHosts`` discovery, a
+real ``ElasticDriver`` with its threads, worker exits simulated by
+calling ``record_worker_exit`` directly; asserts rank stability,
+blacklisting and min/max-np behavior.
+"""
+
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.elastic.discovery import (
+    FixedHosts,
+    HostManager,
+    HostUpdateResult,
+)
+from horovod_tpu.elastic.driver import (
+    ElasticDriver,
+    GetRankAndSizeRequest,
+)
+
+
+def wait_until(pred, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def make_driver(hosts, min_np, max_np=None, **kw):
+    return ElasticDriver(FixedHosts(hosts), min_np, max_np,
+                         timeout=10.0, **kw)
+
+
+class _BlockingWorkers:
+    """create_worker_fn whose workers block until told to exit."""
+
+    def __init__(self):
+        self.started = {}
+        self.exit_codes = {}
+        self._events = {}
+        self._lock = threading.Lock()
+
+    def __call__(self, slot, coordinator, generation):
+        ev = threading.Event()
+        with self._lock:
+            self.started[(slot.hostname, slot.local_rank)] = slot
+            self._events[(slot.hostname, slot.local_rank)] = ev
+        ev.wait(timeout=30)
+        return self.exit_codes.get((slot.hostname, slot.local_rank), 0)
+
+    def finish(self, host, local_rank, exit_code=0):
+        self.exit_codes[(host, local_rank)] = exit_code
+        self._events[(host, local_rank)].set()
+
+    def finish_all(self, exit_code=0):
+        with self._lock:
+            keys = list(self._events)
+        for k in keys:
+            self.finish(*k, exit_code=exit_code)
+
+
+class TestHostManager:
+    def test_update_detects_changes(self):
+        disc = FixedHosts({"h1": 2})
+        hm = HostManager(disc)
+        assert hm.update_available_hosts() == HostUpdateResult.added
+        assert hm.update_available_hosts() == HostUpdateResult.no_update
+        disc.set({"h1": 2, "h2": 2})
+        assert hm.update_available_hosts() == HostUpdateResult.added
+        disc.set({"h2": 2})
+        assert hm.update_available_hosts() == HostUpdateResult.removed
+        assert hm.current_hosts == {"h2": 2}
+
+    def test_stable_order_preserved(self):
+        disc = FixedHosts({"h1": 1, "h2": 1})
+        hm = HostManager(disc)
+        hm.update_available_hosts()
+        order0 = hm.assignment_order
+        disc.set({"h2": 1, "h1": 1, "h3": 1})   # same set + new host
+        hm.update_available_hosts()
+        assert hm.assignment_order[:2] == order0
+        assert hm.assignment_order[2] == "h3"
+
+    def test_blacklist_excludes(self):
+        disc = FixedHosts({"h1": 2, "h2": 2})
+        hm = HostManager(disc)
+        hm.update_available_hosts()
+        hm.blacklist("h1")
+        hm.update_available_hosts()
+        assert hm.current_hosts == {"h2": 2}
+        assert hm.is_blacklisted("h1")
+        assert hm.available_slots == 2
+
+
+class TestElasticDriver:
+    def test_all_workers_succeed(self):
+        workers = _BlockingWorkers()
+        driver = make_driver({"h1": 2}, min_np=2)
+        driver.start(2, workers)
+        assert wait_until(lambda: len(workers.started) == 2)
+        workers.finish_all(0)
+        assert driver.wait_for_completion() == 0
+
+    def test_worker_failure_blacklists_and_resumes(self):
+        workers = _BlockingWorkers()
+        driver = make_driver({"h1": 1, "h2": 1}, min_np=1)
+        driver.start(2, workers)
+        assert wait_until(lambda: len(workers.started) == 2)
+        gen0 = driver.generation
+
+        workers.finish("h2", 0, exit_code=1)     # h2's worker dies
+        assert wait_until(
+            lambda: driver.host_manager.is_blacklisted("h2"))
+        assert wait_until(lambda: driver.generation > gen0)
+        # surviving h1 keeps rank 0; world shrank to 1
+        slot = driver.get_slot_info("h1", 0)
+        assert slot.rank == 0 and slot.size == 1
+
+        workers.finish("h1", 0, exit_code=0)
+        assert driver.wait_for_completion() == 0
+
+    def test_rank_stability_on_host_addition(self):
+        workers = _BlockingWorkers()
+        disc = FixedHosts({"h1": 1})
+        driver = ElasticDriver(disc, min_np=1, max_np=4, timeout=10.0)
+        driver.start(1, workers)
+        assert wait_until(lambda: len(workers.started) == 1)
+        assert driver.get_slot_info("h1", 0).rank == 0
+
+        disc.set({"h1": 1, "h2": 1})             # discovery adds a host
+        assert wait_until(lambda: ("h2", 0) in workers.started, timeout=15)
+        # surviving worker keeps its rank; new host appends
+        assert driver.get_slot_info("h1", 0).rank == 0
+        assert driver.get_slot_info("h2", 0).rank == 1
+        assert driver.get_slot_info("h1", 0).size == 2
+
+        workers.finish_all(0)
+        assert driver.wait_for_completion() == 0
+
+    def test_no_surviving_host_stops_job(self):
+        workers = _BlockingWorkers()
+        disc = FixedHosts({"h1": 1, "h2": 1})
+        driver = ElasticDriver(disc, min_np=1, timeout=2.0)
+        driver.start(2, workers)
+        assert wait_until(lambda: len(workers.started) == 2)
+        # both hosts fail -> no state carrier survives -> job stops != 0
+        workers.finish("h1", 0, exit_code=1)
+        workers.finish("h2", 0, exit_code=1)
+        assert driver.wait_for_completion() != 0
+
+    def test_min_np_waits_for_slots(self):
+        workers = _BlockingWorkers()
+        disc = FixedHosts({})                    # nothing discovered yet
+        driver = ElasticDriver(disc, min_np=2, timeout=10.0)
+        started = threading.Event()
+
+        def start():
+            driver.start(2, workers)
+            started.set()
+
+        t = threading.Thread(target=start, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        assert not started.is_set()              # still waiting
+        disc.set({"h1": 2})
+        assert started.wait(timeout=10)
+        assert wait_until(lambda: len(workers.started) == 2)
+        workers.finish_all(0)
+        assert driver.wait_for_completion() == 0
+
+    def test_rendezvous_rpc(self):
+        workers = _BlockingWorkers()
+        driver = make_driver({"h1": 2}, min_np=2)
+        driver.start(2, workers)
+        assert wait_until(lambda: len(workers.started) == 2)
+        resp = driver._handle(GetRankAndSizeRequest("h1", 1))
+        assert resp.slot.rank == 1 and resp.slot.size == 2
+        assert resp.coordinator_addr
+        assert resp.generation == driver.generation
+        workers.finish_all(0)
+        driver.wait_for_completion()
+
+
+class TestElasticEndToEnd:
+    def test_elastic_localhost_run(self, tmp_path):
+        """Real ``hvdrun`` elastic launch on localhost: the worker script
+        commits, observes generation env, and exits 0 (reference
+        ``test/integration/test_elastic_*`` shape, minus jax)."""
+        import os
+        import subprocess
+        import sys
+
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import os\n"
+            "print('rank', os.environ['HOROVOD_RANK'],\n"
+            "      'size', os.environ['HOROVOD_SIZE'])\n")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = "/root/repo:" + env.get("PYTHONPATH", "")
+        out = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner.launch",
+             "-np", "2", "--min-np", "2", "-H", "localhost:2",
+             "--", sys.executable, str(script)],
+            capture_output=True, text=True, timeout=60, env=env)
+        assert out.returncode == 0, out.stderr
