@@ -218,7 +218,9 @@ from horovod_tpu.optim import (  # noqa: E402
     DistributedGradientTape,
     DistributedOptimizer,
     DistributedTrainStep,
+    SyncBatchNorm,
 )
+from horovod_tpu import callbacks  # noqa: E402,F401
 from horovod_tpu import elastic  # noqa: E402,F401
 
 __all__ = [
@@ -242,6 +244,7 @@ __all__ = [
     "broadcast_optimizer_state", "allgather_object",
     # optimizer layer
     "DistributedOptimizer", "DistributedGradientTape", "DistributedTrainStep",
-    # elastic
-    "elastic",
+    "SyncBatchNorm",
+    # callbacks + elastic
+    "callbacks", "elastic",
 ]

@@ -1,0 +1,239 @@
+"""Training-loop callbacks (the Keras-layer parity surface).
+
+Reference: ``horovod/_keras/callbacks.py`` —
+``BroadcastGlobalVariablesCallback``, ``MetricAverageCallback``,
+``LearningRateWarmupCallback``, ``LearningRateScheduleCallback``
+(:22-187) — and ``horovod/_keras/elastic.py`` (``CommitStateCallback``,
+``UpdateBatchStateCallback``, ``UpdateEpochStateCallback``).
+
+JAX has no Keras Model owning the loop, so callbacks here operate on a
+duck-typed ``loop`` object (anything with ``params``/``opt_state``
+attributes, e.g. a small dataclass around ``DistributedTrainStep``) and
+a ``logs`` dict.  Learning-rate control is exposed two ways:
+
+* **optax schedules** (:func:`warmup_schedule`) — the idiomatic TPU form:
+  the schedule is part of the compiled optimizer, zero host round-trips;
+* the callback classes — for Keras-style loops that mutate an
+  ``optax.inject_hyperparams`` learning rate between steps.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+import optax
+
+import horovod_tpu.functions as F
+from horovod_tpu.ops import eager
+
+
+def warmup_schedule(base_lr: float, warmup_epochs: int,
+                    steps_per_epoch: int, size: Optional[int] = None,
+                    initial_lr_scale: Optional[float] = None):
+    """Gradual LR warmup for large-batch scaling (reference
+    ``LearningRateWarmupCallback``; Goyal et al. 2017): ramp from
+    ``base_lr`` (single-worker LR) to ``base_lr * size`` over
+    ``warmup_epochs``.  Returns an optax schedule."""
+    import horovod_tpu as hvd
+
+    size = size if size is not None else hvd.size()
+    init = base_lr * (initial_lr_scale if initial_lr_scale is not None
+                      else 1.0)
+    return optax.linear_schedule(
+        init_value=init, end_value=base_lr * size,
+        transition_steps=max(warmup_epochs * steps_per_epoch, 1))
+
+
+class Callback:
+    """Minimal lifecycle protocol (Keras callback shape)."""
+
+    def on_train_begin(self, loop, logs: Optional[Dict] = None): ...
+    def on_epoch_begin(self, epoch: int, loop, logs: Optional[Dict] = None): ...
+    def on_batch_begin(self, batch: int, loop, logs: Optional[Dict] = None): ...
+    def on_batch_end(self, batch: int, loop, logs: Optional[Dict] = None): ...
+    def on_epoch_end(self, epoch: int, loop, logs: Optional[Dict] = None): ...
+    def on_train_end(self, loop, logs: Optional[Dict] = None): ...
+
+
+class CallbackList:
+    def __init__(self, callbacks: List[Callback]):
+        self._callbacks = list(callbacks)
+
+    def __getattr__(self, name):
+        if not name.startswith("on_"):
+            raise AttributeError(name)
+
+        def fanout(*args, **kwargs):
+            for cb in self._callbacks:
+                getattr(cb, name)(*args, **kwargs)
+        return fanout
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast initial model/optimizer state from ``root_rank`` at train
+    start (reference ``callbacks.py:22``: the consistency step of the
+    5-line recipe)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, loop, logs=None):
+        loop.params = F.broadcast_variables(loop.params, self.root_rank)
+        if getattr(loop, "opt_state", None) is not None:
+            loop.opt_state = F.broadcast_variables(loop.opt_state,
+                                                   self.root_rank)
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch metrics over all workers (reference
+    ``callbacks.py:48-87``) so logged/checkpoint-selection metrics agree
+    everywhere."""
+
+    def on_epoch_end(self, epoch, loop, logs=None):
+        if not logs:
+            return
+        import jax.numpy as jnp
+
+        for k in sorted(logs):
+            v = logs[k]
+            if isinstance(v, (int, float, np.floating, np.integer)) or \
+                    hasattr(v, "shape"):
+                logs[k] = float(np.asarray(eager.allreduce(
+                    jnp.asarray(v, jnp.float32),
+                    name=f"metric.{k}", op=eager.Average)))
+
+
+class _LrCallback(Callback):
+    """Base for callbacks driving an ``optax.inject_hyperparams``
+    learning rate (``loop.opt_state.hyperparams['learning_rate']``)."""
+
+    @staticmethod
+    def _set_lr(loop, lr: float) -> None:
+        hp = getattr(loop.opt_state, "hyperparams", None)
+        if hp is None or "learning_rate" not in hp:
+            raise ValueError(
+                "LR callbacks need an optimizer built with "
+                "optax.inject_hyperparams(optax.sgd)(learning_rate=...) so "
+                "the rate is mutable between steps")
+        import jax.numpy as jnp
+
+        hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
+
+    @staticmethod
+    def _get_lr(loop) -> float:
+        return float(loop.opt_state.hyperparams["learning_rate"])
+
+
+class LearningRateWarmupCallback(_LrCallback):
+    """Epoch-fraction warmup ``initial → base_lr*size`` (reference
+    ``callbacks.py:104-187``)."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 steps_per_epoch: Optional[int] = None, verbose: bool = False):
+        import horovod_tpu as hvd
+
+        self.initial_lr = initial_lr
+        self.target_lr = initial_lr * hvd.size()
+        self.warmup_epochs = warmup_epochs
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+        self._epoch = 0
+
+    def on_epoch_begin(self, epoch, loop, logs=None):
+        self._epoch = epoch
+
+    def on_batch_begin(self, batch, loop, logs=None):
+        if self._epoch >= self.warmup_epochs:
+            return
+        if not self.steps_per_epoch:
+            raise ValueError("steps_per_epoch required for warmup")
+        progress = (self._epoch * self.steps_per_epoch + batch + 1) / \
+            (self.warmup_epochs * self.steps_per_epoch)
+        lr = self.initial_lr + (self.target_lr - self.initial_lr) * \
+            min(progress, 1.0)
+        self._set_lr(loop, lr)
+
+    def on_epoch_end(self, epoch, loop, logs=None):
+        if epoch == self.warmup_epochs - 1 and self.verbose:
+            print(f"Epoch {epoch}: finished gradual learning rate warmup "
+                  f"to {self.target_lr}.")
+
+
+class LearningRateScheduleCallback(_LrCallback):
+    """Multiplier schedule against the (scaled) base LR (reference
+    ``callbacks.py:104-160``): ``multiplier`` is a float or
+    ``f(epoch) -> float``; with ``staircase`` the epoch is floored."""
+
+    def __init__(self, initial_lr: float,
+                 multiplier: Callable[[float], float],
+                 start_epoch: int = 0, end_epoch: Optional[int] = None,
+                 staircase: bool = True,
+                 steps_per_epoch: Optional[int] = None):
+        self.initial_lr = initial_lr
+        self.multiplier = multiplier if callable(multiplier) \
+            else (lambda _e, _m=multiplier: _m)
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self._epoch = 0
+
+    def _adjust(self, loop, epoch: float) -> None:
+        if epoch < self.start_epoch or \
+                (self.end_epoch is not None and epoch >= self.end_epoch):
+            return
+        self._set_lr(loop, self.initial_lr * self.multiplier(epoch))
+
+    def on_epoch_begin(self, epoch, loop, logs=None):
+        self._epoch = epoch
+        if self.staircase:
+            self._adjust(loop, epoch)
+
+    def on_batch_begin(self, batch, loop, logs=None):
+        if not self.staircase:
+            if not self.steps_per_epoch:
+                raise ValueError("steps_per_epoch required for smooth "
+                                 "schedules")
+            self._adjust(loop, self._epoch + batch / self.steps_per_epoch)
+
+
+# -- elastic callbacks (reference horovod/_keras/elastic.py) ----------------
+
+class CommitStateCallback(Callback):
+    """``state.commit()`` every ``batches_per_commit`` batches (reference
+    ``CommitStateCallback``)."""
+
+    def __init__(self, state, batches_per_commit: int = 1):
+        self.state = state
+        self.batches_per_commit = batches_per_commit
+
+    def on_batch_end(self, batch, loop, logs=None):
+        if (batch + 1) % self.batches_per_commit == 0:
+            self.state.commit()
+
+
+class UpdateBatchStateCallback(Callback):
+    """Track ``state.batch``; resuming mid-epoch skips finished batches
+    (reference ``UpdateBatchStateCallback``)."""
+
+    def __init__(self, state):
+        self.state = state
+
+    def on_batch_end(self, batch, loop, logs=None):
+        self.state.batch = batch + 1
+
+    def on_epoch_end(self, epoch, loop, logs=None):
+        self.state.batch = 0
+
+
+class UpdateEpochStateCallback(Callback):
+    """Track ``state.epoch`` across resets (reference
+    ``UpdateEpochStateCallback``)."""
+
+    def __init__(self, state):
+        self.state = state
+
+    def on_epoch_end(self, epoch, loop, logs=None):
+        self.state.epoch = epoch + 1

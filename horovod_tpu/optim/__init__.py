@@ -12,6 +12,7 @@ from horovod_tpu.optim.optimizer import (
     DistributedOptimizer,
     distributed_gradients,
 )
+from horovod_tpu.optim.sync_batch_norm import SyncBatchNorm, sync_batch_stats
 from horovod_tpu.optim.train_step import DistributedTrainStep, join_step
 
 __all__ = [
@@ -20,4 +21,6 @@ __all__ = [
     "distributed_gradients",
     "DistributedTrainStep",
     "join_step",
+    "SyncBatchNorm",
+    "sync_batch_stats",
 ]

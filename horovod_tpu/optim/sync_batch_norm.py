@@ -1,0 +1,110 @@
+"""Cross-replica synchronized batch normalization.
+
+Reference: ``horovod/torch/sync_batch_norm.py`` (199 LoC: allgather of
+per-rank mean/var + custom autograd) and
+``horovod/tensorflow/sync_batch_norm.py`` (:65).  On TPU the custom
+autograd disappears: batch statistics are synchronized with a ``pmean``
+inside the compiled step and XLA differentiates through it, fusing the
+two reductions (mean, mean-of-squares) into one collective.
+
+Two entry points:
+
+* :class:`SyncBatchNorm` — drop-in flax module for ``shard_map``/``pmap``
+  style per-shard code (``axis_name`` bound);
+* :func:`sync_batch_stats` — functional statistics sync for hand-rolled
+  normalization or unequal per-shard batch sizes (the case the reference
+  handles by allgathering counts).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.runtime.topology import GLOBAL_AXES
+
+AxisSpec = Union[str, Sequence[str]]
+
+
+def sync_batch_stats(x: jax.Array, axis: AxisSpec = GLOBAL_AXES,
+                     reduction_dims: Optional[Tuple[int, ...]] = None,
+                     counts: Optional[jax.Array] = None
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """Global (mean, var) of ``x`` over local reduction dims and the mesh
+    axis.  With ``counts`` (per-shard element count), shards with unequal
+    batches weight correctly — the reference's count-allgather concern
+    (``torch/sync_batch_norm.py``) reduces to a weighted psum."""
+    if reduction_dims is None:
+        reduction_dims = tuple(range(x.ndim - 1))
+    x32 = x.astype(jnp.float32)
+    if counts is None:
+        local_n = 1
+        for d in reduction_dims:
+            local_n *= x.shape[d]
+        counts = jnp.float32(local_n)
+    s = lax.psum(jnp.sum(x32, axis=reduction_dims), axis)
+    sq = lax.psum(jnp.sum(x32 * x32, axis=reduction_dims), axis)
+    n = lax.psum(counts, axis)
+    mean = s / n
+    var = sq / n - mean * mean
+    return mean, jnp.maximum(var, 0.0)
+
+
+class SyncBatchNorm(nn.Module):
+    """BatchNorm whose batch statistics are exact over the global batch.
+
+    Use inside ``shard_map`` (or any context binding ``axis_name``)::
+
+        y = SyncBatchNorm(use_running_average=not train)(x)
+
+    Running averages live in the ``batch_stats`` collection like
+    ``nn.BatchNorm``; since the synced statistics are identical on every
+    shard, the updated running stats stay replicated with no extra sync —
+    the property the reference needs ``broadcast_parameters`` for.
+    """
+
+    use_running_average: bool = False
+    axis: AxisSpec = GLOBAL_AXES
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Optional[Any] = None
+    use_bias: bool = True
+    use_scale: bool = True
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        use_running_average = nn.merge_param(
+            "use_running_average", self.use_running_average,
+            use_running_average)
+        features = x.shape[-1]
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros(features, jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones(features, jnp.float32))
+
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        elif self.is_initializing():
+            # init() runs outside the mesh: local stats, no collective
+            x32 = x.astype(jnp.float32)
+            dims = tuple(range(x.ndim - 1))
+            mean, var = x32.mean(dims), x32.var(dims)
+        else:
+            mean, var = sync_batch_stats(x, axis=self.axis)
+            ra_mean.value = (self.momentum * ra_mean.value
+                             + (1 - self.momentum) * mean)
+            ra_var.value = (self.momentum * ra_var.value
+                            + (1 - self.momentum) * var)
+
+        y = (x.astype(jnp.float32) - mean) * lax.rsqrt(var + self.epsilon)
+        if self.use_scale:
+            y = y * self.param("scale", nn.initializers.ones_init(),
+                               (features,))
+        if self.use_bias:
+            y = y + self.param("bias", nn.initializers.zeros_init(),
+                               (features,))
+        return y.astype(self.dtype or x.dtype)

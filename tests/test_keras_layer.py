@@ -1,0 +1,157 @@
+"""SyncBatchNorm numerics + callback behavior (reference
+``test_keras.py`` / sync-BN tests in ``test_torch.py:test_horovod_sync_batch_norm``)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import callbacks as cb
+from horovod_tpu.optim import SyncBatchNorm, sync_batch_stats
+from horovod_tpu.runtime.topology import GLOBAL_AXES
+
+
+def make_mesh():
+    from jax.sharding import Mesh
+
+    devs = np.asarray(jax.devices("cpu")[:8]).reshape(2, 4)
+    return Mesh(devs, GLOBAL_AXES)
+
+
+class TestSyncBatchNorm:
+    def test_stats_match_global_batch(self):
+        """Per-shard synced stats equal the stats of the concatenated
+        global batch (the defining property; reference sync-BN test)."""
+        mesh = make_mesh()
+        x = jax.random.normal(jax.random.PRNGKey(0), (16, 4), jnp.float32)
+
+        def f(x_local):
+            mean, var = sync_batch_stats(x_local)
+            return mean[None], var[None]
+
+        mean, var = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(GLOBAL_AXES, None),),
+            out_specs=(P(GLOBAL_AXES), P(GLOBAL_AXES)),
+            check_vma=False))(x)
+        np.testing.assert_allclose(np.asarray(mean)[0], x.mean(0),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(var)[0], x.var(0),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_module_normalizes_globally(self):
+        mesh = make_mesh()
+        # distinct per-shard distributions: local BN would differ wildly
+        x = jnp.concatenate([
+            jnp.full((2, 3), float(i)) for i in range(8)])
+        bn = SyncBatchNorm(use_running_average=False)
+        variables = bn.init(jax.random.PRNGKey(0), x)
+
+        def f(x_local):
+            y, _ = bn.apply(variables, x_local, mutable=["batch_stats"])
+            return y
+
+        out = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(GLOBAL_AXES, None),),
+            out_specs=P(GLOBAL_AXES, None), check_vma=False))(x)
+        # global normalization: overall mean 0, var ~1
+        got = np.asarray(out)
+        np.testing.assert_allclose(got.mean(), 0.0, atol=1e-5)
+        np.testing.assert_allclose(got.std(), 1.0, atol=1e-2)
+
+
+@dataclasses.dataclass
+class Loop:
+    params: dict
+    opt_state: object = None
+
+
+class TestCallbacks:
+    def test_warmup_schedule_values(self):
+        hvd.init()
+        sched = cb.warmup_schedule(0.1, warmup_epochs=2, steps_per_epoch=5,
+                                   size=4)
+        assert float(sched(0)) == pytest.approx(0.1)
+        assert float(sched(10)) == pytest.approx(0.4)
+        assert float(sched(100)) == pytest.approx(0.4)
+
+    def test_lr_warmup_callback_mutates_injected_lr(self):
+        hvd.init()
+        opt = optax.inject_hyperparams(optax.sgd)(learning_rate=0.1)
+        params = {"w": jnp.zeros((2,))}
+        loop = Loop(params, opt.init(params))
+        warm = cb.LearningRateWarmupCallback(
+            initial_lr=0.1, warmup_epochs=2, steps_per_epoch=4)
+        warm.on_epoch_begin(0, loop)
+        warm.on_batch_begin(0, loop)
+        first = float(loop.opt_state.hyperparams["learning_rate"])
+        warm.on_epoch_begin(1, loop)
+        warm.on_batch_begin(3, loop)
+        last = float(loop.opt_state.hyperparams["learning_rate"])
+        target = 0.1 * hvd.size()
+        assert first < last <= target + 1e-6
+        assert last == pytest.approx(target)
+
+    def test_lr_schedule_callback_staircase(self):
+        opt = optax.inject_hyperparams(optax.sgd)(learning_rate=1.0)
+        params = {"w": jnp.zeros((2,))}
+        loop = Loop(params, opt.init(params))
+        sched = cb.LearningRateScheduleCallback(
+            initial_lr=1.0, multiplier=lambda e: 0.1 ** (e // 2))
+        for epoch, expected in [(0, 1.0), (1, 1.0), (2, 0.1), (4, 0.01)]:
+            sched.on_epoch_begin(epoch, loop)
+            assert float(loop.opt_state.hyperparams["learning_rate"]) == \
+                pytest.approx(expected)
+
+    def test_metric_average_single_process(self):
+        hvd.init()
+        logs = {"loss": 2.5, "acc": np.float32(0.5), "name": "skip-me"}
+        cb.MetricAverageCallback().on_epoch_end(0, Loop({}), logs)
+        assert logs["loss"] == pytest.approx(2.5)
+        assert logs["name"] == "skip-me"
+
+    def test_broadcast_callback_single_process(self):
+        hvd.init()
+        loop = Loop({"w": jnp.ones((2,))})
+        cb.BroadcastGlobalVariablesCallback(0).on_train_begin(loop)
+        np.testing.assert_allclose(np.asarray(loop.params["w"]), 1.0)
+
+    def test_elastic_state_callbacks(self):
+        class S:
+            committed = 0
+            batch = 0
+            epoch = 0
+
+            def commit(self):
+                self.committed += 1
+
+        s = S()
+        commit = cb.CommitStateCallback(s, batches_per_commit=2)
+        batch_cb = cb.UpdateBatchStateCallback(s)
+        epoch_cb = cb.UpdateEpochStateCallback(s)
+        loop = Loop({})
+        for b in range(4):
+            commit.on_batch_end(b, loop)
+            batch_cb.on_batch_end(b, loop)
+        assert s.committed == 2 and s.batch == 4
+        batch_cb.on_epoch_end(0, loop)
+        epoch_cb.on_epoch_end(0, loop)
+        assert s.batch == 0 and s.epoch == 1
+
+    def test_callback_list_fanout(self):
+        calls = []
+
+        class A(cb.Callback):
+            def on_epoch_end(self, epoch, loop, logs=None):
+                calls.append(("a", epoch))
+
+        class B(cb.Callback):
+            def on_epoch_end(self, epoch, loop, logs=None):
+                calls.append(("b", epoch))
+
+        cb.CallbackList([A(), B()]).on_epoch_end(3, Loop({}))
+        assert calls == [("a", 3), ("b", 3)]
