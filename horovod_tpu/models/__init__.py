@@ -7,5 +7,11 @@ used by ``examples/`` and ``bench.py``.
 """
 
 from horovod_tpu.models.resnet import ResNet50, ResNet101, ResNet152
+from horovod_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    lm_loss,
+)
 
-__all__ = ["ResNet50", "ResNet101", "ResNet152"]
+__all__ = ["ResNet50", "ResNet101", "ResNet152",
+           "TransformerLM", "TransformerConfig", "lm_loss"]

@@ -1,0 +1,194 @@
+"""Decoder-only transformer LM — the long-context flagship.
+
+TPU-first design choices:
+
+* matmul-heavy blocks sized for the MXU, bf16 compute / fp32 params;
+* RMSNorm + rotary embeddings (no learned position table to shard);
+* tensor parallelism by annotation:
+  :class:`~horovod_tpu.parallel.tensor_parallel.ColumnParallelDense` /
+  ``RowParallelDense`` carry kernel partition specs, so under ``jit``
+  over a mesh with a ``tp`` axis XLA places one reduction per block;
+* sequence parallelism by construction: ``attention_impl="ring"`` or
+  ``"ulysses"`` wraps the attention core in ``shard_map`` over the
+  ``sp`` axis (ring ppermute / all_to_all head exchange), enabling
+  contexts that exceed one chip's HBM;
+* ``remat`` applies ``jax.checkpoint`` per block — recompute activations
+  in backward instead of holding them in HBM.
+
+The reference has no model zoo beyond examples; this plays the role of
+its ResNet-50 benchmark flagship (``examples/tensorflow2_synthetic_benchmark.py``)
+for the long-context/LLM regime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.parallel.mesh import AXIS_SP, AXIS_TP
+from horovod_tpu.parallel.ring_attention import (
+    reference_attention,
+    ring_attention,
+)
+from horovod_tpu.parallel.tensor_parallel import (
+    ColumnParallelDense,
+    RowParallelDense,
+)
+from horovod_tpu.parallel.ulysses import ulysses_attention
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    vocab_size: int = 32_000
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "dense"       # dense | ring | ulysses
+    sp_axis: str = AXIS_SP
+    tp_axis: str = AXIS_TP
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+
+def rotary_embedding(x: jax.Array, positions: jax.Array,
+                     base: float = 10_000.0) -> jax.Array:
+    """Rotate pairs of head dims by position-dependent angles (RoPE).
+    ``x``: (b, t, h, d); ``positions``: (t,) global positions — under
+    sequence parallelism each shard passes its global offsets."""
+    d = x.shape[-1]
+    inv_freq = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, None].astype(jnp.float32) * inv_freq[None, :]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    epsilon: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones_init(),
+                           (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        y = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.epsilon)
+        return (y * scale).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        h, d = cfg.num_heads, cfg.head_dim
+        # fused QKV projection, column-parallel over tp (heads shard)
+        qkv = ColumnParallelDense(3 * cfg.d_model, axis=cfg.tp_axis,
+                                  use_bias=False, dtype=cfg.dtype,
+                                  name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = x.shape[:2] + (h, d)
+        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        q = rotary_embedding(q, positions)
+        k = rotary_embedding(k, positions)
+
+        if cfg.attention_impl == "dense":
+            o = reference_attention(q, k, v, causal=True)
+        elif cfg.attention_impl == "ring":
+            o = ring_attention(q, k, v, cfg.sp_axis, causal=True)
+        elif cfg.attention_impl == "ulysses":
+            o = ulysses_attention(q, k, v, cfg.sp_axis, causal=True)
+        else:
+            raise ValueError(
+                f"unknown attention_impl {cfg.attention_impl!r}")
+        o = o.reshape(x.shape[:2] + (cfg.d_model,))
+        # output projection, row-parallel: closes the block's tp reduction
+        return RowParallelDense(cfg.d_model, axis=cfg.tp_axis,
+                                use_bias=False, dtype=cfg.dtype,
+                                name="proj")(o)
+
+
+class MlpBlock(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = ColumnParallelDense(cfg.d_ff, axis=cfg.tp_axis, use_bias=False,
+                                dtype=cfg.dtype, name="wi")(x)
+        h = nn.gelu(h)
+        return RowParallelDense(cfg.d_model, axis=cfg.tp_axis,
+                                use_bias=False, dtype=cfg.dtype,
+                                name="wo")(h)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        x = x + Attention(self.cfg, name="attn")(
+            RMSNorm(name="ln1")(x), positions)
+        x = x + MlpBlock(self.cfg, name="mlp")(RMSNorm(name="ln2")(x))
+        return x
+
+
+class TransformerLM(nn.Module):
+    """``apply(variables, tokens, positions=None) -> logits``.
+
+    ``tokens``: (batch, seq_local) int32.  ``positions``: (seq_local,)
+    global positions; defaults to ``arange`` (correct without sequence
+    parallelism — under SP pass each shard's global offsets).
+
+    Execution modes: under plain ``jit`` over a mesh the tp-annotated
+    kernels shard automatically (GSPMD).  Under ``shard_map`` (required
+    for ``attention_impl="ring"``/``"ulysses"``) pass *unboxed* params —
+    ``flax.core.meta.unbox(variables)`` — since manual-mesh code can't
+    apply GSPMD sharding constraints.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions: Optional[jax.Array] = None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])
+        emb = nn.Embed(cfg.vocab_size, cfg.d_model,
+                       dtype=cfg.dtype,
+                       embedding_init=nn.initializers.normal(0.02),
+                       name="embed")
+        x = emb(tokens)
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=())
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"layer_{i}")(x, positions)
+        x = RMSNorm(name="ln_f")(x)
+        # tied output head: logits in fp32 for a stable softmax
+        return emb.attend(x.astype(jnp.float32))
+
+
+def lm_loss(variables, model: TransformerLM, tokens: jax.Array,
+            positions: Optional[jax.Array] = None) -> jax.Array:
+    """Next-token cross-entropy (mean over the local shard)."""
+    logits = model.apply(variables, tokens[:, :-1],
+                         positions[:-1] if positions is not None else None)
+    import optax
+
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, tokens[:, 1:]).mean()
