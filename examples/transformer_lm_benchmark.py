@@ -35,7 +35,7 @@ def parse_args():
                    help="global batch size")
     p.add_argument("--vocab-size", type=int, default=32000)
     p.add_argument("--attention", default="dense",
-                   choices=["dense", "ring", "ulysses"])
+                   choices=["dense", "flash", "ring", "ulysses"])
     p.add_argument("--sp", type=int, default=1, help="sequence-parallel degree")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel degree")
     p.add_argument("--num-iters", type=int, default=5)

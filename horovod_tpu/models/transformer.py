@@ -51,7 +51,7 @@ class TransformerConfig:
     d_ff: int = 3072
     max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16
-    attention_impl: str = "dense"       # dense | ring | ulysses
+    attention_impl: str = "dense"       # dense | flash | ring | ulysses
     sp_axis: str = AXIS_SP
     tp_axis: str = AXIS_TP
     remat: bool = False
@@ -108,6 +108,10 @@ class Attention(nn.Module):
 
         if cfg.attention_impl == "dense":
             o = reference_attention(q, k, v, causal=True)
+        elif cfg.attention_impl == "flash":
+            from horovod_tpu.ops.pallas_kernels import flash_attention
+
+            o = flash_attention(q, k, v, causal=True)
         elif cfg.attention_impl == "ring":
             o = ring_attention(q, k, v, cfg.sp_axis, causal=True)
         elif cfg.attention_impl == "ulysses":

@@ -1,0 +1,181 @@
+"""Pallas TPU kernels for the hot ops.
+
+Two kernels, mirroring where the reference spends native effort:
+
+* :func:`fused_scale` — the fusion-buffer scale kernel (reference
+  ``ops/cuda/cuda_kernels.cu`` ``scale_buffer_k``/``ScaleBufferCudaImpl``):
+  one pass over the fused gradient buffer applying the pre/postscale
+  factor with an optional wire-dtype cast, saturating VPU lanes instead
+  of paying two HBM round-trips for scale-then-cast.
+* :func:`flash_attention` — blocked causal attention (the MXU hot loop
+  of :mod:`~horovod_tpu.models.transformer`): Q blocks stream against
+  K/V blocks held in VMEM with the online-softmax recurrence, never
+  materializing the (T, T) score matrix in HBM.
+
+Both degrade gracefully: off-TPU (or for shapes that don't meet the
+tiling contract) they fall back to the identical jnp formulation, and
+tests run the kernels in interpreter mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# fused scale (+ cast)
+# ---------------------------------------------------------------------------
+
+def _scale_kernel(x_ref, o_ref, *, factor):
+    o_ref[:] = (x_ref[:].astype(jnp.float32) * factor).astype(o_ref.dtype)
+
+
+def fused_scale(x: jax.Array, factor: float,
+                out_dtype: Optional[jnp.dtype] = None,
+                interpret: bool = False) -> jax.Array:
+    """``x * factor`` cast to ``out_dtype`` in one fused pass (reference
+    ``ScaleBufferCudaImpl``, ``cuda_kernels.cu:77``; fp16 half2
+    vectorization there ≙ VPU lanes here)."""
+    out_dtype = jnp.dtype(out_dtype or x.dtype)
+    if not (interpret or _on_tpu()):
+        return (x.astype(jnp.float32) * factor).astype(out_dtype)
+    flat = x.reshape(-1)
+    # pad to a (8, 128) fp32 tile multiple
+    tile = 8 * 128
+    n = flat.size
+    pad = (-n) % tile
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    arr = flat.reshape(-1, 128)
+    out = pl.pallas_call(
+        functools.partial(_scale_kernel, factor=factor),
+        out_shape=jax.ShapeDtypeStruct(arr.shape, out_dtype),
+        interpret=interpret,
+    )(arr)
+    return out.reshape(-1)[:n].reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# flash attention (forward kernel; backward recomputes blockwise)
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                      causal: bool, scale: float):
+    # blocks: q (1, BQ, D); k/v (1, T, D); o (1, BQ, D)
+    q = q_ref[0].astype(jnp.float32)                  # (BQ, D)
+    block_q, d = q.shape
+    t = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(kb, carry):
+        o, m, l = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = q_pos >= k_pos
+            s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = o * corr[:, None] + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    num_k = t // block_k
+    if causal:
+        # skip blocks strictly above the diagonal (their mask is all-false)
+        num_k_live = (qi + 1) * block_q // block_k
+        num_k = jnp.minimum(num_k, jnp.maximum(num_k_live, 1))
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, num_k, body, (o0, m0, l0))
+    o_ref[0] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+    # (b, t, h, d) -> (b*h, t, d): one grid row per (batch, head)
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    grid = (b * h, t // block_q)
+    out = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, block_k=block_k,
+                          causal=causal, scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qb, kb, vb)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False) -> jax.Array:
+    """Blocked attention over ``(batch, seq, heads, head_dim)`` inputs.
+
+    Falls back to the dense jnp formulation off-TPU or when ``seq`` is not
+    divisible by the block sizes.  Differentiable: the backward pass is
+    the dense recomputation (a blockwise backward kernel is the natural
+    next optimization).
+    """
+    from horovod_tpu.parallel.ring_attention import reference_attention
+
+    b, t, h, d = q.shape
+    scale = d ** -0.5 if scale is None else scale
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    usable = (interpret or _on_tpu()) and \
+        t % block_q == 0 and t % block_k == 0 and \
+        (block_q % block_k == 0 or not causal)
+    if not usable:
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+
+    @jax.custom_vjp
+    def _attn(q, k, v):
+        return _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                          interpret)
+
+    def _fwd(q, k, v):
+        return _attn(q, k, v), (q, k, v)
+
+    def _bwd(res, g):
+        q, k, v = res
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: reference_attention(
+                q_, k_, v_, causal=causal, scale=scale), q, k, v)
+        return vjp(g)
+
+    _attn.defvjp(_fwd, _bwd)
+    return _attn(q, k, v)
