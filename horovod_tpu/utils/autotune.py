@@ -35,6 +35,13 @@ _WARMUP_GRID: List[Tuple[int, float]] = [
 ]
 
 
+#: Bayesian-refinement search box: log2(fusion bytes) in [1 MiB, 256 MiB],
+#: cycle time in [1, 20] ms (reference tunable ranges,
+#: parameter_manager.h:58-78)
+_BO_BOUNDS = [(20.0, 28.0), (1.0, 20.0)]
+_BO_SAMPLES = 8
+
+
 class ParameterManager:
     def __init__(self, config, log_path: Optional[str] = None):
         self._config = config
@@ -50,6 +57,8 @@ class ParameterManager:
         self._done = not self._tunable
         self._log_path = log_path
         self._log_rows: List[dict] = []
+        self._bo = None
+        self._bo_samples_left = _BO_SAMPLES
         if not self._done:
             self._apply(self._points[0])
 
@@ -85,29 +94,46 @@ class ParameterManager:
         self._point_idx += 1
         if self._point_idx < len(self._points):
             self._apply(self._points[self._point_idx])
-            self._bytes_this_point = 0
-            self._steps_this_point = 0
-            self._point_start = time.monotonic()
+            self._reset_window()
             return
 
-        # refinement: bracket the best warm-up point once, then stop
-        self._scores.sort(key=lambda s: -s[0])
-        best = self._scores[0][1]
-        if len(self._points) == len(_WARMUP_GRID):
-            lo = max(best[0] // 2, 1 * MiB)
-            hi = best[0] * 2 if best[0] else 4 * MiB
-            self._points.extend([(lo, best[1]), (hi, best[1])])
-            self._apply(self._points[self._point_idx])
-            self._bytes_this_point = 0
-            self._steps_this_point = 0
-            self._point_start = time.monotonic()
+        # Bayesian refinement after the categorical warm-up (reference
+        # parameter_manager.cc: grid warm-up, then GP+EI).  Deterministic
+        # seed + synced scores keep every process proposing the same point.
+        import math
+
+        from horovod_tpu.utils.bayesian import BayesianOptimizer
+
+        if self._bo is None:
+            self._bo = BayesianOptimizer(_BO_BOUNDS, seed=0)
+            for sc, (thr, cyc) in self._scores:
+                self._bo.observe(
+                    [math.log2(max(thr, 1 * MiB)), cyc], sc)
         else:
-            self._apply(best)
-            self._done = True
-            hvd_logging.info(
-                "autotune converged: fusion_threshold=%d cycle_time=%.1fms",
-                self._config.fusion_threshold_bytes, self._config.cycle_time_ms)
-            self._write_log()
+            self._bo.observe([math.log2(max(point[0], 1 * MiB)), point[1]],
+                             score)
+
+        if self._bo_samples_left > 0:
+            self._bo_samples_left -= 1
+            log_thr, cyc = self._bo.suggest()
+            nxt = (int(2 ** log_thr), round(float(cyc), 2))
+            self._points.append(nxt)
+            self._apply(nxt)
+            self._reset_window()
+            return
+
+        best = max(self._scores, key=lambda s: s[0])[1]
+        self._apply(best)
+        self._done = True
+        hvd_logging.info(
+            "autotune converged: fusion_threshold=%d cycle_time=%.1fms",
+            self._config.fusion_threshold_bytes, self._config.cycle_time_ms)
+        self._write_log()
+
+    def _reset_window(self) -> None:
+        self._bytes_this_point = 0
+        self._steps_this_point = 0
+        self._point_start = time.monotonic()
 
     def _score_across_processes(self, nbytes: int, elapsed: float) -> float:
         """Agree on one score for this sample point across all processes.
