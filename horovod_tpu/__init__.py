@@ -163,6 +163,14 @@ def tpu_available() -> bool:
         return False
 
 
+def native_built() -> bool:
+    """True when the C++ runtime components (timeline writer, rendezvous
+    KV store) compiled and loaded."""
+    from horovod_tpu import native
+
+    return native.native_built()
+
+
 def mpi_built() -> bool:
     return False
 
@@ -229,7 +237,7 @@ __all__ = [
     "local_size", "cross_rank", "cross_size", "process_rank", "process_count",
     "is_homogeneous", "mesh", "start_timeline", "stop_timeline",
     # probes
-    "xla_built", "tpu_available", "mpi_built", "mpi_enabled", "gloo_built",
+    "xla_built", "tpu_available", "native_built", "mpi_built", "mpi_enabled", "gloo_built",
     "gloo_enabled", "nccl_built", "ddl_built", "ccl_built", "cuda_built",
     "rocm_built", "mpi_threads_supported",
     # collectives

@@ -1,0 +1,203 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+Reference: the C++ core in ``horovod/common/`` — here the pieces where
+native code still earns its keep on TPU: the lock-free timeline writer
+(``timeline.{h,cc}``) and the rendezvous KV store
+(``gloo/http_store.{h,cc}`` + ``runner/http/http_server.py``).
+
+The shared library builds lazily with g++ on first use and caches next
+to the source; every consumer has a pure-Python fallback, so missing
+toolchains degrade gracefully (the reference's optional-extension
+pattern, ``setup.py`` capability probes).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from horovod_tpu.utils import logging as hvd_logging
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "hvd_native.cc")
+_LIB = os.path.join(_HERE, "libhvd_tpu_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", _LIB]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        hvd_logging.debug("native build failed (%s); using Python fallbacks",
+                          e)
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The shared library, building it on first call; None if unavailable."""
+    global _lib, _build_failed
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not os.path.exists(_LIB) or \
+                os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            if not _build():
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            hvd_logging.debug("native load failed: %s", e)
+            _build_failed = True
+            return None
+        lib.hvdtl_create.restype = ctypes.c_void_p
+        lib.hvdtl_create.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+        lib.hvdtl_intern.restype = ctypes.c_int32
+        lib.hvdtl_intern.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.hvdtl_event.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                    ctypes.c_int32, ctypes.c_char]
+        lib.hvdtl_dropped.restype = ctypes.c_uint64
+        lib.hvdtl_dropped.argtypes = [ctypes.c_void_p]
+        lib.hvdtl_close.argtypes = [ctypes.c_void_p]
+        lib.hvdkv_start.restype = ctypes.c_void_p
+        lib.hvdkv_start.argtypes = [ctypes.c_int]
+        lib.hvdkv_port.restype = ctypes.c_int
+        lib.hvdkv_port.argtypes = [ctypes.c_void_p]
+        lib.hvdkv_stop.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_built() -> bool:
+    """Capability probe (reference ``horovod_nccl_built`` style)."""
+    return load() is not None
+
+
+class NativeTimeline:
+    """ctypes wrapper matching :class:`horovod_tpu.utils.timeline.Timeline`'s
+    event API; producers pay one atomic + two stores per event."""
+
+    def __init__(self, filename: str, mark_cycles: bool = False,
+                 capacity: int = 65536):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._mark_cycles = mark_cycles
+        self._handle = lib.hvdtl_create(filename.encode(), capacity)
+        self._intern_cache: dict = {}
+        self._cycle_id = self._intern("CYCLE_START")
+        self._closed = False
+
+    def _intern(self, s: str) -> int:
+        i = self._intern_cache.get(s)
+        if i is None:
+            i = self._lib.hvdtl_intern(self._handle, s.encode())
+            self._intern_cache[s] = i
+        return i
+
+    def start_activity(self, tensor_name: str, activity: str) -> None:
+        self._lib.hvdtl_event(self._handle, self._intern(activity),
+                              self._intern(tensor_name), b"B")
+
+    def end_activity(self, tensor_name: str) -> None:
+        self._lib.hvdtl_event(self._handle, -1,
+                              self._intern(tensor_name), b"E")
+
+    def instant(self, name: str, args=None) -> None:
+        self._lib.hvdtl_event(self._handle, self._intern(name), -1, b"i")
+
+    def mark_cycle_start(self) -> None:
+        if self._mark_cycles:
+            self._lib.hvdtl_event(self._handle, self._cycle_id, -1, b"i")
+
+    @property
+    def dropped_events(self) -> int:
+        return int(self._lib.hvdtl_dropped(self._handle))
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._lib.hvdtl_close(self._handle)
+
+
+class KvStoreServer:
+    """Launcher-side rendezvous KV server (reference ``RendezvousServer``)."""
+
+    def __init__(self, port: int = 0):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._handle = lib.hvdkv_start(port)
+        if not self._handle:
+            raise OSError(f"could not bind KV store on port {port}")
+
+    @property
+    def port(self) -> int:
+        return self._lib.hvdkv_port(self._handle)
+
+    def stop(self) -> None:
+        if self._handle:
+            self._lib.hvdkv_stop(self._handle)
+            self._handle = None
+
+
+class KvStoreClient:
+    """Blocking client for :class:`KvStoreServer` (reference ``HTTPStore``
+    worker side, ``gloo/http_store.cc``): ``get`` waits until the key is
+    published — the rendezvous primitive."""
+
+    def __init__(self, host: str, port: int):
+        self._addr = (host, port)
+
+    def _roundtrip(self, payload: bytes, read_reply) -> bytes:
+        import socket
+
+        with socket.create_connection(self._addr, timeout=60) as s:
+            s.sendall(payload)
+            return read_reply(s)
+
+    @staticmethod
+    def _read_exact(sock, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise EOFError("kv connection closed")
+            buf += chunk
+        return buf
+
+    def set(self, key: str, value: bytes) -> None:
+        k = key.encode()
+        payload = (b"S" + len(k).to_bytes(4, "big") + k
+                   + len(value).to_bytes(4, "big") + value)
+        self._roundtrip(payload, lambda s: self._read_exact(s, 1))
+
+    def get(self, key: str, timeout_ms: int = 60000) -> Optional[bytes]:
+        k = key.encode()
+        payload = (b"G" + len(k).to_bytes(4, "big") + k
+                   + timeout_ms.to_bytes(4, "big"))
+
+        def read(sock):
+            vlen = int.from_bytes(self._read_exact(sock, 4), "big")
+            if vlen == 0xFFFFFFFF:
+                return None
+            return self._read_exact(sock, vlen)
+
+        return self._roundtrip(payload, read)
+
+    def num_keys(self) -> int:
+        payload = b"D" + (0).to_bytes(4, "big")
+        return int.from_bytes(
+            self._roundtrip(payload, lambda s: self._read_exact(s, 4)),
+            "big")

@@ -123,10 +123,7 @@ class GlobalState:
             self.cross_size = cfg.cross_size
 
         if cfg.timeline_filename:
-            from horovod_tpu.utils.timeline import Timeline
-
-            self.timeline = Timeline(cfg.timeline_filename,
-                                     mark_cycles=cfg.timeline_mark_cycles)
+            self.timeline = _make_timeline(cfg)
         if cfg.stall_check_enabled:
             from horovod_tpu.utils.stall import StallInspector
 
@@ -157,6 +154,23 @@ class GlobalState:
                 self.stall_inspector.stop()
             self.shut_down = True
             self.initialization_done = False
+
+
+def _make_timeline(cfg: Config):
+    """Prefer the native lock-free writer (reference timeline.{h,cc} is
+    C++); fall back to the Python writer when the toolchain is absent."""
+    if not os.environ.get("HOROVOD_TIMELINE_PYTHON"):
+        try:
+            from horovod_tpu.native import NativeTimeline
+
+            return NativeTimeline(cfg.timeline_filename,
+                                  mark_cycles=cfg.timeline_mark_cycles)
+        except (RuntimeError, OSError):
+            pass
+    from horovod_tpu.utils.timeline import Timeline
+
+    return Timeline(cfg.timeline_filename,
+                    mark_cycles=cfg.timeline_mark_cycles)
 
 
 _state: Optional[GlobalState] = None

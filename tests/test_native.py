@@ -1,0 +1,126 @@
+"""Native C++ components: build, timeline writer output, KV rendezvous."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from horovod_tpu import native
+
+
+pytestmark = pytest.mark.skipif(
+    not native.native_built(),
+    reason="g++ toolchain unavailable; Python fallbacks cover this surface")
+
+
+class TestNativeTimeline:
+    def test_writes_valid_chrome_trace(self, tmp_path):
+        path = tmp_path / "tl.json"
+        tl = native.NativeTimeline(str(path), mark_cycles=True)
+        tl.start_activity("tensor_a", "XLA_ALLREDUCE")
+        tl.end_activity("tensor_a")
+        tl.mark_cycle_start()
+        tl.instant("CHECKPOINT")
+        tl.close()
+        events = json.load(open(path))
+        assert len(events) == 4
+        begin = events[0]
+        assert begin["ph"] == "B" and begin["name"] == "XLA_ALLREDUCE"
+        assert begin["tid"] == "tensor_a"
+        assert events[1]["ph"] == "E"
+        assert {e["name"] for e in events[2:]} == \
+            {"CYCLE_START", "CHECKPOINT"}
+        # timestamps monotonic
+        ts = [e["ts"] for e in events]
+        assert ts == sorted(ts)
+
+    def test_many_events_from_threads(self, tmp_path):
+        """MPSC path: concurrent producers, no corruption, ordered drain."""
+        path = tmp_path / "tl.json"
+        tl = native.NativeTimeline(str(path), capacity=1 << 14)
+
+        def produce(tid):
+            for i in range(500):
+                tl.start_activity(f"t{tid}", "QUEUE")
+                tl.end_activity(f"t{tid}")
+
+        threads = [threading.Thread(target=produce, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        tl.close()
+        events = json.load(open(path))
+        assert len(events) == 4 * 500 * 2
+        assert tl.dropped_events == 0
+
+
+class TestKvStore:
+    def test_set_get_roundtrip(self):
+        server = native.KvStoreServer()
+        try:
+            client = native.KvStoreClient("127.0.0.1", server.port)
+            client.set("global_/rank0", b"addr:1234")
+            assert client.get("global_/rank0") == b"addr:1234"
+            assert client.num_keys() == 1
+        finally:
+            server.stop()
+
+    def test_get_blocks_until_set(self):
+        """The rendezvous primitive: GET waits for the key to appear
+        (reference HTTPStore wait, gloo_context.cc:71-91)."""
+        server = native.KvStoreServer()
+        try:
+            client = native.KvStoreClient("127.0.0.1", server.port)
+            result = {}
+
+            def getter():
+                result["v"] = client.get("late_key", timeout_ms=10000)
+
+            t = threading.Thread(target=getter)
+            t.start()
+            time.sleep(0.3)
+            assert "v" not in result       # still blocked
+            client.set("late_key", b"worker7:999")
+            t.join(timeout=10)
+            assert result["v"] == b"worker7:999"
+        finally:
+            server.stop()
+
+    def test_get_timeout_returns_none(self):
+        server = native.KvStoreServer()
+        try:
+            client = native.KvStoreClient("127.0.0.1", server.port)
+            t0 = time.monotonic()
+            assert client.get("never", timeout_ms=300) is None
+            assert 0.2 < time.monotonic() - t0 < 5
+        finally:
+            server.stop()
+
+    def test_many_clients(self):
+        server = native.KvStoreServer()
+        try:
+            def worker(i):
+                c = native.KvStoreClient("127.0.0.1", server.port)
+                c.set(f"k{i}", str(i).encode() * 10)
+                assert c.get(f"k{i}") == str(i).encode() * 10
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(16)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            c = native.KvStoreClient("127.0.0.1", server.port)
+            assert c.num_keys() == 16
+        finally:
+            server.stop()
+
+
+class TestProbe:
+    def test_probe_reports_built(self):
+        import horovod_tpu as hvd
+
+        assert hvd.native_built() is True
