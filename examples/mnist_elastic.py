@@ -1,0 +1,98 @@
+"""Elastic MNIST — the reference's elastic training recipe.
+
+Counterpart of ``examples/elastic/tensorflow2_mnist_elastic.py``: wrap
+the training loop in ``@hvd.elastic.run``, keep everything that must
+survive a host change inside a ``TpuState``, ``commit()`` between
+batches.  Run under the elastic launcher::
+
+    python -m horovod_tpu.runner.launch -np 2 --min-np 1 --max-np 4 \
+        --host-discovery-script ./discover.sh -- python examples/mnist_elastic.py
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--batches-per-commit", type=int, default=10)
+    p.add_argument("--platform", default=None)
+    args = p.parse_args()
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import flax.linen as nn
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = x.reshape(x.shape[0], -1)
+            x = nn.relu(nn.Dense(128)(x))
+            return nn.Dense(10)(x)
+
+    model = Net()
+
+    def loss_fn(params, batch):
+        logits = model.apply(params, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    def make_step():
+        # rebuilt after every reset: the mesh (and so the compiled step)
+        # changes with the world
+        return hvd.DistributedTrainStep(
+            loss_fn, optax.adam(0.001 * hvd.size()))
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(4096, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, (4096,)).astype(np.int32)
+
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 28, 28), jnp.float32))
+    state = hvd.elastic.TpuState(params=params, opt_state=None,
+                                 epoch=0, batch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        step = make_step()
+        params = state.params
+        opt_state = state.opt_state
+        if opt_state is None:
+            params, opt_state = step.init(params)
+        global_bs = args.batch_size * hvd.size()
+        nbatches = len(x) // global_bs
+        while state.epoch < args.epochs:
+            perm = np.random.RandomState(state.epoch).permutation(len(x))
+            while state.batch < nbatches:
+                b = state.batch
+                idx = perm[b * global_bs:(b + 1) * global_bs]
+                batch = step.shard_batch({"x": jnp.asarray(x[idx]),
+                                          "y": jnp.asarray(y[idx])})
+                params, opt_state, loss = step(params, opt_state, batch)
+                state.params = params
+                state.opt_state = opt_state
+                state.batch = b + 1
+                if (b + 1) % args.batches_per_commit == 0:
+                    state.commit()     # snapshot + host-update check
+            if hvd.rank() == 0:
+                print(f"epoch {state.epoch}: loss={float(loss):.4f} "
+                      f"on {hvd.size()} chips")
+            state.epoch += 1
+            state.batch = 0
+            state.commit()
+
+    train(state)
+
+
+if __name__ == "__main__":
+    main()

@@ -229,6 +229,7 @@ from horovod_tpu.optim import (  # noqa: E402
     SyncBatchNorm,
 )
 from horovod_tpu import callbacks  # noqa: E402,F401
+from horovod_tpu import checkpoint  # noqa: E402,F401
 from horovod_tpu import elastic  # noqa: E402,F401
 
 __all__ = [
@@ -253,6 +254,6 @@ __all__ = [
     # optimizer layer
     "DistributedOptimizer", "DistributedGradientTape", "DistributedTrainStep",
     "SyncBatchNorm",
-    # callbacks + elastic
-    "callbacks", "elastic",
+    # callbacks + checkpoint + elastic
+    "callbacks", "checkpoint", "elastic",
 ]

@@ -1,0 +1,48 @@
+"""Checkpointer: save/restore round-trip, retention, latest-step."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+
+def make_state(v=1.0):
+    return {"params": {"w": jnp.full((4, 4), v), "b": jnp.zeros((4,))},
+            "step": int(v)}
+
+
+class TestCheckpointer:
+    @pytest.mark.parametrize("use_orbax", [False, None])
+    def test_roundtrip(self, tmp_path, use_orbax):
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                           use_orbax=use_orbax)
+        state = make_state(3.0)
+        assert ckpt.save(0, state)
+        restored = ckpt.restore(make_state(0.0))
+        np.testing.assert_allclose(np.asarray(restored["params"]["w"]), 3.0)
+        assert restored["step"] == 3
+
+    def test_latest_and_retention(self, tmp_path):
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                           max_to_keep=2, use_orbax=False)
+        for s in range(5):
+            ckpt.save(s, make_state(float(s)))
+        assert ckpt.latest_step() == 4
+        assert sorted(ckpt.all_steps()) == [3, 4]
+        restored = ckpt.restore(make_state(0.0))
+        np.testing.assert_allclose(np.asarray(restored["params"]["w"]), 4.0)
+
+    def test_restore_and_broadcast_single_process(self, tmp_path):
+        hvd.init()
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                           use_orbax=False)
+        ckpt.save(7, make_state(7.0))
+        restored = ckpt.restore_and_broadcast(make_state(0.0))
+        np.testing.assert_allclose(np.asarray(restored["params"]["w"]), 7.0)
+
+    def test_missing_checkpoint_raises(self, tmp_path):
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "none"),
+                                           use_orbax=False)
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore(make_state())
