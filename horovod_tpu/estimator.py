@@ -1,0 +1,178 @@
+"""Estimator: the fit/transform high-level API.
+
+Reference: ``horovod/spark/common/estimator.py`` (``HorovodEstimator``
+fit/transform), ``spark/keras/estimator.py:105`` /
+``spark/torch/estimator.py:84`` and their ``remote.py`` training loops —
+the only place the reference owns a training loop.  Same shape here
+over pandas/numpy data (Spark DataFrames reduce to the same arrays via
+``toPandas`` on the caller's side): ``Estimator.fit(df) -> TpuModel``,
+``TpuModel.transform(df) -> df + prediction column``.
+
+The loop underneath is :class:`~horovod_tpu.optim.DistributedTrainStep`
+— sharded batches, compiled step, callbacks, optional checkpoint store.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def _extract(df, cols: Sequence[str]) -> np.ndarray:
+    """(n, len(cols)) float array from a DataFrame or dict of arrays;
+    columns holding arrays (images) are stacked along feature dims."""
+    parts = []
+    for c in cols:
+        col = np.asarray(list(df[c]) if not isinstance(df, dict) else df[c])
+        parts.append(col.reshape(len(col), -1).astype(np.float32))
+    return np.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+
+
+@dataclasses.dataclass
+class _Loop:
+    """Duck-typed loop object handed to callbacks."""
+
+    params: Any = None
+    opt_state: Any = None
+
+
+class TpuModel:
+    """Fitted model (reference ``HorovodModel`` Transformer)."""
+
+    def __init__(self, apply_fn: Callable, params: Any,
+                 feature_cols: Sequence[str], output_col: str = "prediction",
+                 batch_size: int = 1024):
+        self._apply = apply_fn
+        self.params = params
+        self._feature_cols = list(feature_cols)
+        self._output_col = output_col
+        self._batch_size = batch_size
+
+    def transform(self, df):
+        """Return ``df`` with the model output column appended (reference
+        ``transform`` adds prediction columns to the DataFrame)."""
+        x = _extract(df, self._feature_cols)
+        outs = []
+        apply = jax.jit(self._apply)
+        for i in range(0, len(x), self._batch_size):
+            outs.append(np.asarray(
+                apply(self.params, jnp.asarray(x[i:i + self._batch_size]))))
+        preds = np.concatenate(outs, axis=0)
+        if isinstance(df, dict):
+            out = dict(df)
+            out[self._output_col] = preds
+            return out
+        out = df.copy()
+        out[self._output_col] = list(preds)
+        return out
+
+
+class Estimator:
+    """Fit a model to a DataFrame (reference ``HorovodEstimator``).
+
+    ``model`` is a flax module or an ``apply(params, x) -> out`` callable
+    paired with ``initial_params``.  ``loss`` maps (output, label batch)
+    to a scalar; defaults to softmax cross-entropy on integer labels.
+    """
+
+    def __init__(self, model, feature_cols: Sequence[str], label_col: str,
+                 optimizer: Optional[optax.GradientTransformation] = None,
+                 loss: Optional[Callable] = None,
+                 initial_params: Any = None,
+                 batch_size: int = 32, epochs: int = 1,
+                 callbacks: Optional[List] = None,
+                 store_dir: Optional[str] = None,
+                 validation_fraction: float = 0.0,
+                 seed: int = 0):
+        self._model = model
+        self._feature_cols = list(feature_cols)
+        self._label_col = label_col
+        self._optimizer = optimizer or optax.adam(1e-3)
+        self._loss = loss
+        self._initial_params = initial_params
+        self._batch_size = batch_size
+        self._epochs = epochs
+        self._callbacks = callbacks or []
+        self._store_dir = store_dir
+        self._validation_fraction = validation_fraction
+        self._seed = seed
+
+    def _apply_fn(self):
+        if hasattr(self._model, "apply"):
+            return lambda params, x: self._model.apply(params, x)
+        return self._model
+
+    def fit(self, df) -> TpuModel:
+        import horovod_tpu as hvd
+        from horovod_tpu.callbacks import CallbackList
+
+        hvd.init()
+        x = _extract(df, self._feature_cols)
+        y = np.asarray(df[self._label_col])
+        if y.dtype.kind == "f":
+            y = y.astype(np.float32)
+        else:
+            y = y.astype(np.int32)
+
+        n_val = int(len(x) * self._validation_fraction)
+        if n_val:
+            x, x_val = x[:-n_val], x[-n_val:]
+            y, y_val = y[:-n_val], y[-n_val:]
+
+        apply_fn = self._apply_fn()
+        loss = self._loss or (
+            lambda out, batch: optax.softmax_cross_entropy_with_integer_labels(
+                out, batch["y"]).mean())
+
+        def loss_fn(params, batch):
+            return loss(apply_fn(params, batch["x"]), batch)
+
+        step = hvd.DistributedTrainStep(loss_fn, self._optimizer)
+        params = self._initial_params
+        if params is None:
+            if not hasattr(self._model, "init"):
+                raise ValueError("pass initial_params for a bare apply fn")
+            params = self._model.init(jax.random.PRNGKey(self._seed),
+                                      jnp.asarray(x[:1]))
+        params = hvd.broadcast_variables(params, root_rank=0)
+        params, opt_state = step.init(params)
+
+        ckpt = hvd.checkpoint.Checkpointer(self._store_dir) \
+            if self._store_dir else None
+        loop = _Loop(params, opt_state)
+        cbs = CallbackList(self._callbacks)
+        cbs.on_train_begin(loop)
+
+        global_bs = self._batch_size * hvd.size()
+        nbatches = max(len(x) // global_bs, 1)
+        rng = np.random.RandomState(self._seed)
+        logs: dict = {}
+        for epoch in range(self._epochs):
+            cbs.on_epoch_begin(epoch, loop, logs)
+            perm = rng.permutation(len(x))
+            for b in range(nbatches):
+                cbs.on_batch_begin(b, loop, logs)
+                idx = perm[b * global_bs:(b + 1) * global_bs]
+                if len(idx) < global_bs:   # pad the ragged tail batch
+                    idx = np.concatenate([idx, perm[:global_bs - len(idx)]])
+                batch = step.shard_batch({"x": jnp.asarray(x[idx]),
+                                          "y": jnp.asarray(y[idx])})
+                loop.params, loop.opt_state, train_loss = step(
+                    loop.params, loop.opt_state, batch)
+                cbs.on_batch_end(b, loop, logs)
+            logs["loss"] = float(train_loss)
+            if n_val:
+                logs["val_loss"] = float(loss_fn(
+                    loop.params, {"x": jnp.asarray(x_val),
+                                  "y": jnp.asarray(y_val)}))
+            cbs.on_epoch_end(epoch, loop, logs)
+            if ckpt:
+                ckpt.save(epoch, {"params": loop.params,
+                                  "opt_state": loop.opt_state})
+        cbs.on_train_end(loop, logs)
+        return TpuModel(apply_fn, loop.params, self._feature_cols)

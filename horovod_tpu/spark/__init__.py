@@ -1,0 +1,17 @@
+"""Spark integration layer (reference ``horovod/spark/``).
+
+``horovod_tpu.spark.run(fn, ...)`` executes a training function on
+cluster executors; :class:`~horovod_tpu.estimator.Estimator` (re-exported
+here as the reference exposes estimators under ``horovod.spark.*``)
+offers the fit/transform Pipeline-style API.
+
+When pyspark is not installed, ``run`` falls back to the localhost
+launcher (same contract, same per-rank results) so the API surface works
+everywhere; the Spark path activates automatically when pyspark is
+importable.
+"""
+
+from horovod_tpu.estimator import Estimator, TpuModel
+from horovod_tpu.spark.runner import run, run_elastic
+
+__all__ = ["run", "run_elastic", "Estimator", "TpuModel"]

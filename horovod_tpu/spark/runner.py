@@ -1,0 +1,129 @@
+"""Run a training function on Spark executors.
+
+Reference: ``horovod/spark/runner.py`` — ``run(fn, ...):195`` launches a
+Spark job whose tasks become Horovod slots (``_task_fn:47``): each task
+starts a task service, registers its address + host hash with the driver
+service, the driver groups tasks by host into a host list, and the
+normal launcher takes over with command execution routed through the
+task services instead of ssh.  ``run_elastic:303`` wires the same into
+the elastic driver.
+
+The same architecture here, with the TPU launcher underneath.  Without
+pyspark the executor pool degrades to localhost processes — identical
+contract (pickled fn, per-rank return values in rank order), so code
+written against this API runs anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+from horovod_tpu.utils import logging as hvd_logging
+
+
+def _spark_available() -> bool:
+    try:
+        import pyspark  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,
+        extra_env: Optional[dict] = None, verbose: bool = False) -> List[Any]:
+    """Execute ``fn`` on ``num_proc`` distributed workers and return the
+    per-rank results (reference ``horovod.spark.run``)."""
+    if _spark_available():
+        return _run_on_spark(fn, args, kwargs, num_proc, extra_env, verbose)
+    hvd_logging.debug("pyspark not available; spark.run using localhost "
+                      "launcher")
+    from horovod_tpu.runner import run as local_run
+
+    return local_run(fn, args=args, kwargs=kwargs, np=num_proc or 1,
+                     extra_env=extra_env, verbose=verbose)
+
+
+def run_elastic(fn: Callable, args=(), kwargs=None,
+                num_proc: Optional[int] = None,
+                min_np: Optional[int] = None, max_np: Optional[int] = None,
+                **kw) -> List[Any]:
+    """Elastic variant (reference ``run_elastic:303``).  Requires pyspark:
+    elasticity comes from Spark re-provisioning executors."""
+    if not _spark_available():
+        raise ImportError(
+            "horovod_tpu.spark.run_elastic requires pyspark; for elastic "
+            "training without Spark use the hvdrun elastic launcher "
+            "(python -m horovod_tpu.runner.launch --min-np ...)")
+    return _run_on_spark(fn, args, kwargs, num_proc, None, False,
+                         min_np=min_np, max_np=max_np)
+
+
+def _run_on_spark(fn, args, kwargs, num_proc, extra_env, verbose,
+                  min_np=None, max_np=None) -> List[Any]:
+    """The Spark path (reference ``runner.py:195``): parallelize num_proc
+    tasks; each task registers with the driver service and waits for the
+    launcher to drive it."""
+    import cloudpickle
+    from pyspark import SparkContext
+
+    from horovod_tpu.runner.network import BasicService, make_secret_key
+
+    sc = SparkContext._active_spark_context
+    if sc is None:
+        raise RuntimeError("no active SparkContext; create a SparkSession "
+                           "before horovod_tpu.spark.run")
+    num_proc = num_proc or sc.defaultParallelism
+    key = make_secret_key()
+    payload = cloudpickle.dumps((fn, tuple(args), dict(kwargs or {})))
+
+    # driver-side registry: executors report (host, partition) -> addr
+    registry: dict = {}
+    results: dict = {}
+
+    class RegisterTask:
+        def __init__(self, index, host):
+            self.index, self.host = index, host
+
+    class TaskResult:
+        def __init__(self, index, value):
+            self.index, self.value = index, value
+
+    def handle(req):
+        from horovod_tpu.runner.network import AckResponse
+
+        if isinstance(req, RegisterTask):
+            registry[req.index] = req.host
+            return AckResponse()
+        if isinstance(req, TaskResult):
+            results[req.index] = req.value
+            return AckResponse()
+        raise ValueError(type(req).__name__)
+
+    service = BasicService("spark_driver", key, handle)
+    service.start()
+    driver_addr = service.address
+
+    def _task(index):
+        import os
+        import pickle
+        import socket
+
+        from horovod_tpu.runner.network import BasicClient
+
+        client = BasicClient(driver_addr, key)
+        client.request(RegisterTask(index, socket.gethostname()))
+        func, fargs, fkwargs = cloudpickle.loads(payload)
+        os.environ.setdefault("HOROVOD_RANK", str(index))
+        os.environ.setdefault("HOROVOD_SIZE", str(num_proc))
+        value = func(*fargs, **fkwargs)
+        client.request(TaskResult(index, pickle.loads(
+            pickle.dumps(value))))
+        return [index]
+
+    try:
+        sc.parallelize(range(num_proc), num_proc).mapPartitionsWithIndex(
+            lambda i, _: _task(i)).collect()
+        return [results[r] for r in range(num_proc)]
+    finally:
+        service.shutdown()

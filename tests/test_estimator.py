@@ -1,0 +1,89 @@
+"""Estimator fit/transform (reference ``test_spark_keras.py`` /
+``test_spark_torch.py`` shape: tiny DataFrames, local mode)."""
+
+import flax.linen as nn
+import numpy as np
+import pandas as pd
+import pytest
+
+from horovod_tpu.estimator import Estimator
+from horovod_tpu.spark import run as spark_run
+
+
+class Net(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(3)(x)
+
+
+def make_df(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 4).astype(np.float32)
+    # learnable rule: class = argmax of 3 fixed linear scores
+    w = rng.rand(4, 3)
+    y = (x @ w).argmax(axis=1).astype(np.int32)
+    return pd.DataFrame({
+        "f1": x[:, 0], "f2": x[:, 1], "f3": x[:, 2], "f4": x[:, 3],
+        "label": y,
+    })
+
+
+class TestEstimator:
+    def test_fit_transform_learns(self, tmp_path):
+        df = make_df()
+        est = Estimator(Net(), feature_cols=["f1", "f2", "f3", "f4"],
+                        label_col="label", batch_size=4, epochs=20,
+                        store_dir=str(tmp_path / "store"),
+                        validation_fraction=0.1)
+        model = est.fit(df)
+        out = model.transform(df)
+        preds = np.stack(out["prediction"]).argmax(axis=1)
+        acc = (preds == df["label"].to_numpy()).mean()
+        assert acc > 0.7, f"estimator failed to learn (acc={acc})"
+        # store received checkpoints
+        assert (tmp_path / "store").exists()
+
+    def test_dict_input(self):
+        rng = np.random.RandomState(0)
+        data = {"x": rng.rand(64, 4).astype(np.float32),
+                "label": rng.randint(0, 3, 64)}
+        est = Estimator(Net(), feature_cols=["x"], label_col="label",
+                        batch_size=4, epochs=1)
+        model = est.fit(data)
+        out = model.transform(data)
+        assert out["prediction"].shape == (64, 3)
+
+    def test_callbacks_invoked(self):
+        from horovod_tpu import callbacks as cb
+
+        seen = []
+
+        class Probe(cb.Callback):
+            def on_epoch_end(self, epoch, loop, logs=None):
+                seen.append((epoch, dict(logs or {})))
+
+        df = make_df(64)
+        Estimator(Net(), feature_cols=["f1", "f2", "f3", "f4"],
+                  label_col="label", batch_size=4, epochs=2,
+                  callbacks=[Probe()]).fit(df)
+        assert len(seen) == 2
+        assert "loss" in seen[-1][1]
+
+
+class TestSparkRun:
+    def test_falls_back_to_local(self):
+        """Without pyspark, spark.run uses the localhost launcher with the
+        same per-rank-results contract."""
+        import os
+
+        def fn():
+            return int(os.environ["HOROVOD_RANK"])
+
+        assert spark_run(fn, num_proc=2) == [0, 1]
+
+    def test_run_elastic_requires_spark(self):
+        with pytest.raises(ImportError, match="pyspark"):
+            from horovod_tpu.spark import run_elastic
+
+            run_elastic(lambda: None, num_proc=2)
