@@ -36,7 +36,7 @@ def log(msg):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--batch-size", type=int, default=128,
+    p.add_argument("--batch-size", type=int, default=256,
                    help="per-chip batch size")
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--num-iters", type=int, default=5)
