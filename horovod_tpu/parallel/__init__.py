@@ -26,6 +26,8 @@ from horovod_tpu.parallel.mesh import (
     AXIS_TP,
     make_parallel_mesh,
 )
+from horovod_tpu.parallel.expert import expert_parallel_ffn, top1_routing
+from horovod_tpu.parallel.pipeline import gpipe
 from horovod_tpu.parallel.ring_attention import ring_attention
 from horovod_tpu.parallel.ulysses import ulysses_attention
 from horovod_tpu.parallel.tensor_parallel import (
@@ -36,6 +38,7 @@ from horovod_tpu.parallel.tensor_parallel import (
 __all__ = [
     "make_parallel_mesh",
     "AXIS_DP", "AXIS_FSDP", "AXIS_PP", "AXIS_EP", "AXIS_SP", "AXIS_TP",
-    "ring_attention", "ulysses_attention",
+    "ring_attention", "ulysses_attention", "gpipe",
+    "expert_parallel_ffn", "top1_routing",
     "ColumnParallelDense", "RowParallelDense",
 ]

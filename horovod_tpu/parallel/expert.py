@@ -1,0 +1,106 @@
+"""Expert parallelism: top-1-routed MoE over the ``ep`` mesh axis.
+
+Extension beyond the reference (SURVEY §2.3: EP absent; the
+variable-split ``alltoall`` it ships — ``operations.cc:979`` — is
+precisely the dispatch primitive).  TPU-first formulation: static
+capacity buckets (no dynamic shapes under jit) — each shard scatters
+its tokens into an ``(experts, capacity, d)`` dispatch buffer, one
+``all_to_all`` moves expert slots to the shards that own them, expert
+FFNs run as one batched matmul (MXU-friendly), and the inverse
+``all_to_all`` brings results home for the gate-weighted combine.
+Tokens beyond an expert's capacity are dropped (contribute zero), the
+standard Switch-Transformer policy.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel.mesh import AXIS_EP
+
+
+def top1_routing(scores: jax.Array, capacity: int):
+    """Greedy top-1 assignment with per-expert capacity.
+
+    Args:
+      scores: (tokens, num_experts) gate logits.
+      capacity: max tokens per expert on this shard's batch.
+
+    Returns:
+      (expert_idx, slot, keep, gate): chosen expert, position inside its
+      capacity bucket, whether the token fit, and its softmax gate weight.
+    """
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)
+    gate = jnp.max(probs, axis=-1)
+    one_hot = jax.nn.one_hot(expert_idx, scores.shape[-1], dtype=jnp.int32)
+    slot = (jnp.cumsum(one_hot, axis=0) - 1)
+    slot = jnp.take_along_axis(slot, expert_idx[:, None], axis=1)[:, 0]
+    keep = slot < capacity
+    return expert_idx, slot, keep, gate
+
+
+def expert_parallel_ffn(x: jax.Array, gate_kernel: jax.Array,
+                        expert_fn: Callable, num_experts_total: int,
+                        capacity_factor: float = 1.25,
+                        axis: str = AXIS_EP):
+    """Mixture-of-experts FFN with experts sharded over ``axis``.
+
+    Call inside ``shard_map``.  Args:
+      x: (tokens_local, d) this shard's tokens.
+      gate_kernel: (d, num_experts_total) router weights (replicated).
+      expert_fn: ``f(local_expert_params_selector) -> (E_local, C_world,
+        d) -> (E_local, C_world, d)`` — actually invoked as
+        ``expert_fn(buffers)`` where ``buffers`` is (E_local, world*C, d);
+        must apply this shard's local experts batched over dim 0.
+      num_experts_total: E; must divide by the axis size.
+      capacity_factor: per-expert capacity = ceil(cf * tokens/E).
+
+    Returns:
+      (tokens_local, d) gate-weighted expert outputs (dropped tokens get
+      zeros) and the fraction of dropped tokens (scalar, for aux losses).
+    """
+    world = lax.axis_size(axis)
+    if num_experts_total % world != 0:
+        raise ValueError(
+            f"num_experts_total={num_experts_total} not divisible by "
+            f"'{axis}' size {world}")
+    e_local = num_experts_total // world
+    t, d = x.shape
+    capacity = int(max(1, -(-capacity_factor * t // num_experts_total)))
+
+    scores = x @ gate_kernel                       # (t, E)
+    expert_idx, slot, keep, gate = top1_routing(scores, capacity)
+
+    # scatter tokens into (E, C, d) dispatch buckets
+    dispatch = jnp.zeros((num_experts_total, capacity, d), x.dtype)
+    safe_slot = jnp.where(keep, slot, 0)
+    dispatch = dispatch.at[expert_idx, safe_slot].add(
+        jnp.where(keep[:, None], x, 0.0))
+
+    # (E, C, d) -> (world, E_local, C, d) -> alltoall over shards:
+    # afterwards dim 0 is the SOURCE shard, and our E_local experts' data
+    # from every shard is local
+    dispatch = dispatch.reshape(world, e_local, capacity, d)
+    received = lax.all_to_all(dispatch, axis, split_axis=0, concat_axis=0,
+                              tiled=False)        # (world, E_local, C, d)
+    buffers = received.transpose(1, 0, 2, 3).reshape(
+        e_local, world * capacity, d)
+
+    outputs = expert_fn(buffers)                  # (E_local, world*C, d)
+
+    outputs = outputs.reshape(e_local, world, capacity, d) \
+        .transpose(1, 0, 2, 3)                    # (world, E_local, C, d)
+    combined = lax.all_to_all(outputs, axis, split_axis=0, concat_axis=0,
+                              tiled=False)        # back at source shards
+    combined = combined.reshape(num_experts_total, capacity, d)
+
+    # gather each token's result from its (expert, slot) and weight by gate
+    y = combined[expert_idx, safe_slot]
+    y = jnp.where(keep[:, None], y * gate[:, None].astype(y.dtype), 0.0)
+    drop_fraction = 1.0 - jnp.mean(keep.astype(jnp.float32))
+    return y, drop_fraction

@@ -1,0 +1,83 @@
+"""GPipe-style pipeline parallelism over the ``pp`` mesh axis.
+
+Extension beyond the reference (SURVEY §2.3: no pipeline code exists
+there).  TPU-first formulation: every stage is one mesh shard holding
+its stage's parameters; activations advance stage-to-stage with
+``lax.ppermute`` (neighbor ICI hops) inside a ``lax.scan`` over
+pipeline ticks.  All shards execute the same program every tick —
+bubbles are masked computation, not control flow — which is exactly
+what SPMD compilation wants.  Autodiff through the scan + ppermute
+yields the reverse pipeline schedule for the backward pass.
+
+Call inside ``shard_map`` with stage parameters sharded over ``axis``
+(stacked on a leading stage dimension) and the input replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel.mesh import AXIS_PP
+
+
+def gpipe(stage_fn: Callable, stage_params, x: jax.Array,
+          num_microbatches: int, axis: str = AXIS_PP) -> jax.Array:
+    """Run ``x`` through ``world`` pipeline stages.
+
+    Args:
+      stage_fn: ``f(params, h) -> h`` — one stage; activation shapes must
+        be identical across stages (uniform pipelines only).
+      stage_params: this shard's stage parameters (shard the stacked
+        stage dimension over ``axis`` with ``P("pp", ...)`` specs and
+        index/squeeze it away in the caller, or pass per-stage trees).
+      x: ``(batch, ...)`` input, replicated across the axis; ``batch``
+        must divide by ``num_microbatches``.
+      num_microbatches: pipeline depth M; wall-clock is
+        ``M + world - 1`` ticks, bubble fraction ``(world-1)/(M+world-1)``.
+
+    Returns:
+      ``(batch, ...)`` output of the final stage, replicated across the
+      axis (masked psum — only the last stage contributes).
+    """
+    world = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    b = x.shape[0]
+    if b % num_microbatches != 0:
+        raise ValueError(
+            f"batch {b} not divisible by num_microbatches={num_microbatches}")
+    mb = b // num_microbatches
+    mbs = x.reshape((num_microbatches, mb) + x.shape[1:])
+
+    fwd_perm = [(i, (i + 1) % world) for i in range(world)]
+    ticks = num_microbatches + world - 1
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 injects microbatch t; later stages consume what arrived
+        inject = mbs[jnp.clip(t, 0, num_microbatches - 1)]
+        h_in = jnp.where(idx == 0, inject, state)
+        my_mb = t - idx                    # microbatch this stage works on
+        active = (my_mb >= 0) & (my_mb < num_microbatches)
+        h_out = stage_fn(stage_params, h_in)
+        h_out = jnp.where(active, h_out, jnp.zeros_like(h_out))
+        # last stage banks its finished microbatch into its output slot
+        done = active & (idx == world - 1)
+        slot = jnp.clip(my_mb, 0, num_microbatches - 1)
+        cur = lax.dynamic_slice_in_dim(outputs, slot, 1, axis=0)
+        outputs = lax.dynamic_update_slice_in_dim(
+            outputs, jnp.where(done, h_out[None], cur), slot, axis=0)
+        # advance the pipeline: my output becomes the next stage's input
+        state = lax.ppermute(h_out, axis, fwd_perm)
+        return (state, outputs), None
+
+    state0 = jnp.zeros((mb,) + mbs.shape[2:], x.dtype)
+    outputs0 = jnp.zeros_like(mbs)
+    (_, outputs), _ = lax.scan(tick, (state0, outputs0), jnp.arange(ticks))
+    # outputs are only valid on the last stage; fan them out
+    outputs = lax.psum(
+        jnp.where(idx == world - 1, outputs, jnp.zeros_like(outputs)), axis)
+    return outputs.reshape((b,) + x.shape[1:])

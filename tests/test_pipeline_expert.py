@@ -1,0 +1,159 @@
+"""Pipeline (gpipe) and expert-parallel (MoE) vs dense oracles."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.parallel import (
+    expert_parallel_ffn,
+    gpipe,
+    make_parallel_mesh,
+    top1_routing,
+)
+
+
+class TestGPipe:
+    def _run(self, num_microbatches=8):
+        world = 4
+        mesh = make_parallel_mesh(pp=world, dp=2,
+                                  devices=jax.devices("cpu")[:8])
+        key = jax.random.PRNGKey(0)
+        d = 16
+        # 4 stages, each y = gelu(x @ W_s)
+        ws = jax.random.normal(key, (world, d, d)) * (1.0 / np.sqrt(d))
+        x = jax.random.normal(jax.random.fold_in(key, 1), (16, d))
+
+        def stage_fn(w, h):
+            return jax.nn.gelu(h @ w)
+
+        def f(w_local, x_local):
+            return gpipe(stage_fn, w_local[0], x_local,
+                         num_microbatches=num_microbatches)
+
+        out = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P("pp"), P("dp")),
+            out_specs=P("dp"), check_vma=False))(ws, x)
+
+        expected = x
+        for s in range(world):
+            expected = jax.nn.gelu(expected @ ws[s])
+        return np.asarray(out), np.asarray(expected)
+
+    def test_matches_sequential(self):
+        out, expected = self._run()
+        np.testing.assert_allclose(out, expected, rtol=2e-5, atol=2e-5)
+
+    def test_grad_flows(self):
+        world = 4
+        mesh = make_parallel_mesh(pp=world, dp=2,
+                                  devices=jax.devices("cpu")[:8])
+        d = 8
+        key = jax.random.PRNGKey(2)
+        ws = jax.random.normal(key, (world, d, d)) * 0.3
+        x = jax.random.normal(jax.random.fold_in(key, 1), (8, d))
+
+        def stage_fn(w, h):
+            return jnp.tanh(h @ w)
+
+        def loss_pipe(ws, x):
+            def f(w_local, x_local):
+                y = gpipe(stage_fn, w_local[0], x_local, num_microbatches=4)
+                # sum over the full batch (psum over dp); pp replicas agree
+                return lax.pmean(lax.psum(jnp.sum(y ** 2), "dp"), "pp")[None]
+
+            return jax.shard_map(
+                f, mesh=mesh, in_specs=(P("pp"), P("dp")),
+                out_specs=P(), check_vma=False)(ws, x)[0]
+
+        def loss_dense(ws, x):
+            h = x
+            for s in range(world):
+                h = jnp.tanh(h @ ws[s])
+            return jnp.sum(h ** 2)
+
+        gp = jax.jit(jax.grad(loss_pipe))(ws, x)
+        gd = jax.grad(loss_dense)(ws, x)
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gd),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestTop1Routing:
+    def test_capacity_respected(self):
+        # all tokens prefer expert 0; capacity 2 keeps only the first 2
+        scores = jnp.asarray([[5.0, 0.0]] * 6)
+        idx, slot, keep, gate = top1_routing(scores, capacity=2)
+        np.testing.assert_array_equal(np.asarray(idx), 0)
+        assert np.asarray(keep).sum() == 2
+        np.testing.assert_array_equal(np.asarray(slot[:2]), [0, 1])
+
+
+class TestExpertParallel:
+    def test_matches_dense_routing(self):
+        """With generous capacity (no drops), the MoE output equals each
+        token's argmax expert applied densely."""
+        world = 8
+        mesh = make_parallel_mesh(ep=world, devices=jax.devices("cpu")[:8])
+        e_total, d, t = 16, 8, 32
+        e_local = e_total // world
+        key = jax.random.PRNGKey(0)
+        x = jax.random.normal(key, (t, d))
+        gate_w = jax.random.normal(jax.random.fold_in(key, 1), (d, e_total))
+        w1 = jax.random.normal(jax.random.fold_in(key, 2),
+                               (e_total, d, 2 * d)) * 0.3
+        w2 = jax.random.normal(jax.random.fold_in(key, 3),
+                               (e_total, 2 * d, d)) * 0.3
+
+        def f(x, gate_w, w1_local, w2_local):
+            def expert_fn(buffers):       # (E_local, S, d)
+                h = jnp.einsum("esd,edf->esf", buffers, w1_local)
+                return jnp.einsum("esf,efd->esd", jax.nn.gelu(h), w2_local)
+
+            y, dropped = expert_parallel_ffn(
+                x, gate_w, expert_fn, e_total, capacity_factor=float(e_total))
+            return y, dropped[None]
+
+        y, dropped = jax.jit(jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(P(), P(), P("ep"), P("ep")),
+            out_specs=(P(), P()), check_vma=False))(x, gate_w, w1, w2)
+        assert float(dropped[0]) == 0.0
+
+        # dense oracle: route every token through its argmax expert
+        probs = jax.nn.softmax(x @ gate_w, axis=-1)
+        idx = jnp.argmax(probs, axis=-1)
+        gate = jnp.max(probs, axis=-1)
+        h = jnp.einsum("td,tdf->tf", x, w1[idx])
+        dense = jnp.einsum("tf,tfd->td", jax.nn.gelu(h), w2[idx])
+        dense = dense * gate[:, None]
+        np.testing.assert_allclose(np.asarray(y), np.asarray(dense),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_dropping_with_tight_capacity(self):
+        world = 8
+        mesh = make_parallel_mesh(ep=world, devices=jax.devices("cpu")[:8])
+        e_total, d, t = 8, 4, 64
+        key = jax.random.PRNGKey(1)
+        # positive features + gate column 0 -> every token routes to
+        # expert 0 -> heavy dropping
+        x = jnp.abs(jax.random.normal(key, (t, d))) + 0.1
+        gate_w = jnp.zeros((d, e_total)).at[:, 0].set(10.0)
+
+        def f(x, gate_w):
+            def expert_fn(buffers):
+                return buffers * 2.0
+
+            y, dropped = expert_parallel_ffn(x, gate_w, expert_fn, e_total,
+                                             capacity_factor=1.0)
+            return y, dropped[None]
+
+        y, dropped = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), P()), out_specs=(P(), P()),
+            check_vma=False))(x, gate_w)
+        assert float(dropped[0]) > 0.5          # most tokens dropped
+        # dropped tokens produce zeros
+        nonzero_rows = (np.abs(np.asarray(y)).sum(axis=1) > 0).sum()
+        capacity = int(max(1, -(-1.0 * t // e_total)))
+        assert nonzero_rows <= capacity  # only expert 0's bucket survives
