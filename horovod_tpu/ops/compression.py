@@ -71,7 +71,13 @@ class BF16Compressor(Compressor):
 
 
 class Compression:
-    """Namespace matching the reference's ``Compression`` selector."""
+    """Namespace matching the reference's ``Compression`` selector.
+
+    Int8 wire compression is NOT a ``Compressor``: the reduction runs
+    *between* compress and decompress, and summing int8 payloads with
+    per-shard scales would overflow and mis-scale.  Use
+    :func:`horovod_tpu.ops.collectives.quantized_allreduce`, which
+    agrees on a shared scale first (EQuARX-style)."""
 
     none = NoneCompressor
     fp16 = FP16Compressor

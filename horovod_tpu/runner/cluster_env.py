@@ -1,0 +1,68 @@
+"""Cluster scheduler introspection: derive hosts from the environment.
+
+Reference: ``horovod/runner/util/lsf.py`` (``LSFUtils`` reads
+``LSB_MCPU_HOSTS``/``CSM_ALLOCATION_ID`` to build the host list for
+jsrun/LSF clusters) and ``js_run.py``.  TPU-native addition: GKE/GCE TPU
+pod environments publish ``TPU_WORKER_HOSTNAMES``/``TPU_WORKER_ID`` —
+the same introspection gives `hvdrun` a host list with zero flags on a
+pod.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from horovod_tpu.runner.hosts import HostInfo
+
+
+class LSFUtils:
+    """LSF batch-system introspection (reference ``LSFUtils``)."""
+
+    @staticmethod
+    def using_lsf() -> bool:
+        return "LSB_JOBID" in os.environ
+
+    @staticmethod
+    def get_compute_hosts() -> List[HostInfo]:
+        """Parse ``LSB_MCPU_HOSTS`` ("batch_host 1 host1 N host2 N ...");
+        the first entry is the launch/batch node and carries no compute
+        slots (reference ``lsf.py`` skips it)."""
+        raw = os.environ.get("LSB_MCPU_HOSTS", "").split()
+        pairs = list(zip(raw[0::2], raw[1::2]))
+        return [HostInfo(h, int(s)) for h, s in pairs[1:]]
+
+    @staticmethod
+    def get_num_processes() -> int:
+        return sum(h.slots for h in LSFUtils.get_compute_hosts())
+
+
+class TpuPodUtils:
+    """TPU pod slice introspection from the runtime-provided env."""
+
+    @staticmethod
+    def using_tpu_pod() -> bool:
+        return "TPU_WORKER_HOSTNAMES" in os.environ
+
+    @staticmethod
+    def get_compute_hosts(slots_per_host: int = 1) -> List[HostInfo]:
+        names = [h.strip() for h in
+                 os.environ["TPU_WORKER_HOSTNAMES"].split(",") if h.strip()]
+        return [HostInfo(h, slots_per_host) for h in names]
+
+    @staticmethod
+    def worker_id() -> Optional[int]:
+        wid = os.environ.get("TPU_WORKER_ID")
+        return int(wid) if wid is not None else None
+
+
+def detect_cluster_hosts() -> Optional[List[HostInfo]]:
+    """Host list from the ambient scheduler, or None outside any cluster
+    (the ``hvdrun`` no-flags path on LSF and TPU pods)."""
+    if LSFUtils.using_lsf():
+        hosts = LSFUtils.get_compute_hosts()
+        if hosts:
+            return hosts
+    if TpuPodUtils.using_tpu_pod():
+        return TpuPodUtils.get_compute_hosts()
+    return None

@@ -96,6 +96,11 @@ def _resolve_hosts(args) -> List[HostInfo]:
         return parse_hostfile(args.hostfile)
     if args.hosts:
         return parse_hosts(args.hosts)
+    from horovod_tpu.runner.cluster_env import detect_cluster_hosts
+
+    detected = detect_cluster_hosts()
+    if detected:   # LSF / TPU pod: host list with zero flags
+        return detected
     return [HostInfo("localhost", args.np)]
 
 

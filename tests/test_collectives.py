@@ -255,6 +255,39 @@ class TestControlPrimitives:
         expected_or = 1 | sum(1 << (r + 1) for r in range(N))
         np.testing.assert_array_equal(np.asarray(bor).ravel(), expected_or)
 
+    def test_quantized_allreduce(self):
+        """Shared-scale int8 wire reduction ≈ exact mean within one
+        rounding step of the shared scale."""
+        def f():
+            r = C.axis_index(GLOBAL_AXES).astype(jnp.float32)
+            x = jnp.asarray([1.0, -3.5, 0.25, 100.0]) * (r + 1)
+            return C.quantized_allreduce(x)[None]
+
+        out = np.asarray(run_spmd(f))[0]
+        expected = np.asarray([1.0, -3.5, 0.25, 100.0]) * np.mean(
+            np.arange(1, N + 1))
+        scale = np.abs(np.asarray([1.0, -3.5, 0.25, 100.0]) * N).max() / 127
+        np.testing.assert_allclose(out, expected, atol=scale)
+
+    def test_sparse_allreduce(self):
+        """IndexedSlices-style reduction: row-sparse grads from every
+        shard scatter-add into the dense result."""
+        def f():
+            r = C.axis_index(GLOBAL_AXES)
+            # every shard touches row 0 plus its own row r+1
+            values = jnp.stack([jnp.full((3,), 1.0),
+                                jnp.full((3,), (r + 1).astype(jnp.float32))])
+            indices = jnp.stack([jnp.int32(0), r + 1])
+            return C.sparse_allreduce(values, indices, dense_rows=16,
+                                      op=C.Sum)
+
+        out = np.asarray(run_spmd(f, out_specs=P()))   # replicated result
+        # row 0: every shard adds 1 -> N; row r+1: only shard r adds r+1
+        np.testing.assert_allclose(out[0], N)
+        for r in range(N):
+            np.testing.assert_allclose(out[r + 1], r + 1)
+        np.testing.assert_allclose(out[N + 1:], 0.0)
+
     def test_bitwise_high_bits(self):
         """All 32 bits participate, incl. bit 30 and the sign bit (the
         reference's CrossRankBitwiseOr operates on full machine words)."""

@@ -117,6 +117,33 @@ class TestLaunchCommand:
         assert args.timeline_filename == "/tmp/from_config.json"
 
 
+class TestClusterEnv:
+    def test_lsf_hosts(self, monkeypatch):
+        from horovod_tpu.runner.cluster_env import LSFUtils, detect_cluster_hosts
+
+        monkeypatch.setenv("LSB_JOBID", "1234")
+        monkeypatch.setenv("LSB_MCPU_HOSTS", "batch1 1 node1 4 node2 4")
+        assert LSFUtils.using_lsf()
+        hosts = detect_cluster_hosts()
+        assert [(h.hostname, h.slots) for h in hosts] == \
+            [("node1", 4), ("node2", 4)]
+
+    def test_tpu_pod_hosts(self, monkeypatch):
+        from horovod_tpu.runner.cluster_env import detect_cluster_hosts
+
+        monkeypatch.delenv("LSB_JOBID", raising=False)
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "t0,t1,t2,t3")
+        hosts = detect_cluster_hosts()
+        assert [h.hostname for h in hosts] == ["t0", "t1", "t2", "t3"]
+
+    def test_no_cluster(self, monkeypatch):
+        from horovod_tpu.runner.cluster_env import detect_cluster_hosts
+
+        monkeypatch.delenv("LSB_JOBID", raising=False)
+        monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+        assert detect_cluster_hosts() is None
+
+
 class TestRunApi:
     def test_run_fn_collects_per_rank_results(self):
         """Real localhost 2-process launch through the full CLI path
