@@ -64,5 +64,10 @@ def detect_cluster_hosts() -> Optional[List[HostInfo]]:
         if hosts:
             return hosts
     if TpuPodUtils.using_tpu_pod():
-        return TpuPodUtils.get_compute_hosts()
+        hosts = TpuPodUtils.get_compute_hosts()
+        # single-host "pods" (e.g. a tunneled dev chip exporting
+        # TPU_WORKER_HOSTNAMES=localhost) are not a cluster — let the
+        # launcher's localhost default size the slot count from -np
+        if len(hosts) > 1:
+            return hosts
     return None
