@@ -85,6 +85,54 @@ def process_mesh() -> Mesh:
 def _reset_mesh_cache() -> None:
     global _proc_mesh
     _proc_mesh = None
+    _validated_signatures.clear()
+
+
+_validated_signatures: set = set()
+
+
+def _validate_signature(kind: str, payload: str) -> None:
+    """Cross-process consistency check — controller-lite.
+
+    The reference's coordinator validates dtype/shape/op agreement across
+    ranks before executing and turns mismatches into descriptive error
+    responses (``ConstructResponse``, ``controller.cc:380``); without it,
+    a divergent shape would crash the transport layer mid-collective and
+    kill the job.  Here every process allgathers a digest of the
+    operation's signature (fixed-size, so this exchange itself can never
+    mismatch) and raises :class:`HorovodInternalError` everywhere on
+    disagreement.  Validated signatures are cached so each unique
+    signature costs one exchange — the response-cache fast path
+    (``response_cache.{h,cc}``) in miniature.
+    """
+    mesh = process_mesh()
+    nproc = mesh.devices.size
+    if nproc == 1:
+        return
+    key = (kind, payload)
+    if key in _validated_signatures:
+        st = state.global_state() if state.is_initialized() else None
+        if st:
+            st.cache_stats["hits"] += 1
+        return
+    import hashlib
+
+    digest = hashlib.sha256(f"{kind}|{payload}".encode()).digest()
+    mine = np.frombuffer(digest[:32], np.int32)
+    theirs = _allgather_host_metadata(mine)
+    if not (theirs == mine[None]).all():
+        bad = [p for p in range(nproc)
+               if not (theirs[p] == mine).all()]
+        raise HorovodInternalError(
+            f"Mismatched {kind} across processes: process "
+            f"{jax.process_index()} submitted [{payload}] but process(es) "
+            f"{bad} submitted a different name/dtype/shape/op for the same "
+            f"collective slot. All processes must issue identical "
+            f"collectives in identical order.")
+    _validated_signatures.add(key)
+    st = state.global_state() if state.is_initialized() else None
+    if st:
+        st.cache_stats["misses"] += 1
 
 
 def _lift(tensor: jax.Array) -> jax.Array:
@@ -274,6 +322,9 @@ def _dispatch_group(entries) -> None:
     nproc = process_mesh().devices.size
     with tl.activity(entries[0].name, tl.XLA_ALLREDUCE):
         try:
+            _validate_signature("allreduce group", "; ".join(
+                f"{e.name}:{e.tensor.dtype}:{tuple(e.tensor.shape)}:"
+                f"{e.op.name}:{e.prescale}:{e.postscale}" for e in entries))
             if len(entries) == 1:
                 e = entries[0]
                 garr = _lift(e.tensor)
@@ -358,6 +409,10 @@ def allgather_with_sizes(tensor, name: Optional[str] = None):
     sizes = None
     try:
         with tl.activity(name, tl.XLA_ALLGATHER):
+            # first dims may differ per process; everything else must agree
+            _validate_signature(
+                "allgather",
+                f"{name}:{tensor.dtype}:{tuple(tensor.shape[1:])}")
             # negotiate first-dim sizes (the controller's recvcount exchange)
             sizes = _allgather_host_metadata(
                 np.asarray([tensor.shape[0]], np.int64)).reshape(nproc)
@@ -387,6 +442,9 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
     _register(name, handle)
     try:
         with tl.activity(name, tl.XLA_BROADCAST):
+            _validate_signature(
+                "broadcast",
+                f"{name}:{tensor.dtype}:{tuple(tensor.shape)}:{root_rank}")
             garr = _lift(tensor)
             out = jax.jit(lambda g: g[root_rank],
                           out_shardings=_replicated(mesh))(garr)
@@ -419,6 +477,9 @@ def alltoall(tensor, splits=None, name: Optional[str] = None):
     _register(name, handle)
     try:
         with tl.activity(name, tl.XLA_ALLTOALL):
+            _validate_signature(
+                "alltoall",
+                f"{name}:{tensor.dtype}:{tuple(tensor.shape[1:])}")
             all_splits = _allgather_host_metadata(splits)  # (nproc, nproc)
             all_splits = all_splits.reshape(nproc, nproc)
             max_rows = int(all_splits.max())

@@ -103,6 +103,29 @@ class TestTwoProcessWorld:
         assert out.returncode == 0, out.stderr[-3000:]
         assert out.stdout.count("WORKER_OK") == 2
 
+    def test_cross_rank_shape_mismatch_errors(self, tmp_path):
+        """Rank-specific wrong shape must produce a catchable
+        HorovodInternalError, not a hang (reference cross-rank error
+        injection, test_tensorflow.py:601-671)."""
+        out = launch("""
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import jax.numpy as jnp
+            import horovod_tpu as hvd
+
+            hvd.init()
+            r = hvd.process_rank()
+            shape = (4,) if r == 0 else (5,)       # rank 1 diverges
+            try:
+                hvd.allreduce(jnp.ones(shape), name="bad")
+            except hvd.HorovodInternalError as e:
+                print("CAUGHT_OK", r)
+            else:
+                print("NO_ERROR", r)
+        """, tmp_path)
+        assert out.returncode == 0, out.stderr[-3000:]
+        assert out.stdout.count("CAUGHT_OK") == 2, out.stdout
+
     def test_worker_failure_fails_job(self, tmp_path):
         out = launch("""
             import os, sys
