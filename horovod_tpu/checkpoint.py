@@ -101,6 +101,15 @@ class Checkpointer:
             return list(self._manager.all_steps())
         if not os.path.isdir(self._dir):
             return []
+        if self._use_orbax:
+            # Non-root ranks have no CheckpointManager (orbax's manager
+            # coordinates saves across hosts; constructing it everywhere
+            # while only rank 0 saves would desynchronize its barriers).
+            # checkpoint_steps lists only *finalized* steps, so a non-root
+            # restore can never pick a step rank 0 is still writing.
+            from orbax.checkpoint import utils as ocp_utils
+
+            return [int(s) for s in ocp_utils.checkpoint_steps(self._dir)]
         return [int(d.split("_", 1)[1]) for d in os.listdir(self._dir)
                 if d.startswith("step_")]
 
@@ -114,14 +123,20 @@ class Checkpointer:
         step = step if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self._dir}")
-        if self._manager is not None:
+        if self._use_orbax:
             import orbax.checkpoint as ocp
 
             host_target = jax.tree_util.tree_map(
                 lambda x: np.asarray(x) if hasattr(x, "shape") else x,
                 target)
-            return self._manager.restore(
-                step, args=ocp.args.StandardRestore(host_target))
+            if self._manager is not None:
+                return self._manager.restore(
+                    step, args=ocp.args.StandardRestore(host_target))
+            # Non-root: plain per-host read of the shared directory; no
+            # cross-host coordination needed for a restore.  Layout is the
+            # manager's: <dir>/<step>/default.
+            return ocp.StandardCheckpointer().restore(
+                os.path.join(self._dir, str(step), "default"), host_target)
         with open(os.path.join(self._dir, f"step_{step}",
                                "state.pkl"), "rb") as f:
             return pickle.load(f)

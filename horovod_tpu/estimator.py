@@ -159,7 +159,10 @@ class Estimator:
                 cbs.on_batch_begin(b, loop, logs)
                 idx = perm[b * global_bs:(b + 1) * global_bs]
                 if len(idx) < global_bs:   # pad the ragged tail batch
-                    idx = np.concatenate([idx, perm[:global_bs - len(idx)]])
+                    # np.resize cycles perm, so even len(x) < global_bs/2
+                    # still yields a full, device-divisible batch
+                    idx = np.concatenate(
+                        [idx, np.resize(perm, global_bs - len(idx))])
                 batch = step.shard_batch({"x": jnp.asarray(x[idx]),
                                           "y": jnp.asarray(y[idx])})
                 loop.params, loop.opt_state, train_loss = step(

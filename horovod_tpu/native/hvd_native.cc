@@ -135,8 +135,17 @@ struct TimelineWriter {
     if (!file_) return;
     if (!first_) std::fputs(",\n", file_);
     first_ = false;
-    const char* name = e.name_id >= 0 ? names_[e.name_id].c_str() : "";
-    const char* tid = e.tid_id >= 0 ? names_[e.tid_id].c_str() : "runtime";
+    // Copy interned strings under intern_mu_: producers' Intern() may
+    // emplace_back and reallocate names_ concurrently with this drain
+    // thread, so an unlocked names_[id] read is a use-after-free race.
+    std::string name_s, tid_s("runtime");
+    {
+      std::lock_guard<std::mutex> lk(intern_mu_);
+      if (e.name_id >= 0) name_s = names_[e.name_id];
+      if (e.tid_id >= 0) tid_s = names_[e.tid_id];
+    }
+    const char* name = name_s.c_str();
+    const char* tid = tid_s.c_str();
     if (e.phase == 'E') {
       std::fprintf(file_, "{\"ph\":\"E\",\"tid\":\"%s\",\"pid\":1,"
                    "\"ts\":%lld}", tid, (long long)e.ts_us);

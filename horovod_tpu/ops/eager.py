@@ -109,7 +109,17 @@ def _validate_signature(kind: str, payload: str) -> None:
     nproc = mesh.devices.size
     if nproc == 1:
         return
+    # Keys are verbatim payloads.  Auto-generated names carry a per-call
+    # counter (``*.noname.N``), so auto-named collectives are permanent
+    # misses — deliberately: the counter IS the slot-order check (a rank
+    # issuing one extra same-shape collective drifts its counter, and the
+    # digest mismatch raises a descriptive error instead of pairing wrong
+    # slots silently).  Callers wanting the cached fast path pass stable
+    # names.  The set is bounded; in any correct execution all ranks issue
+    # identical sequences, so the clear fires at the same call everywhere.
     key = (kind, payload)
+    if len(_validated_signatures) > 8192:
+        _validated_signatures.clear()
     if key in _validated_signatures:
         st = state.global_state() if state.is_initialized() else None
         if st:

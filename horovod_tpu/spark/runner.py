@@ -21,6 +21,26 @@ from typing import Any, Callable, List, Optional
 from horovod_tpu.utils import logging as hvd_logging
 
 
+class RegisterTask:
+    """Executor → driver: announce (partition index, hostname).
+
+    Module-level (not nested in ``_run_on_spark``) so stdlib pickle — the
+    wire format of ``runner.network.Wire`` — can serialize instances by
+    reference on both ends, and driver-side ``isinstance`` checks match
+    the class executors actually instantiate.
+    """
+
+    def __init__(self, index, host):
+        self.index, self.host = index, host
+
+
+class TaskResult:
+    """Executor → driver: per-partition return value (see RegisterTask)."""
+
+    def __init__(self, index, value):
+        self.index, self.value = index, value
+
+
 def _spark_available() -> bool:
     try:
         import pyspark  # noqa: F401
@@ -80,14 +100,6 @@ def _run_on_spark(fn, args, kwargs, num_proc, extra_env, verbose,
     # driver-side registry: executors report (host, partition) -> addr
     registry: dict = {}
     results: dict = {}
-
-    class RegisterTask:
-        def __init__(self, index, host):
-            self.index, self.host = index, host
-
-    class TaskResult:
-        def __init__(self, index, value):
-            self.index, self.value = index, value
 
     def handle(req):
         from horovod_tpu.runner.network import AckResponse
