@@ -120,7 +120,20 @@ class Checkpointer:
     def restore(self, target: Any, step: Optional[int] = None) -> Any:
         """Load a checkpoint on this process (every rank reads — use
         :meth:`restore_and_broadcast` for the read-once pattern)."""
-        step = step if step is not None else self.latest_step()
+        if step is None:
+            # Resolve the step on root and broadcast: per-rank directory
+            # listings can lag on shared filesystems, and ranks silently
+            # restoring different steps is worse than any error.
+            if jax.process_count() > 1:
+                from horovod_tpu.ops import eager
+
+                mine = self.latest_step() if _is_root() else -1
+                step = int(eager.broadcast(
+                    np.asarray([-1 if mine is None else mine], np.int32),
+                    root_rank=0, name="ckpt_latest_step")[0])
+                step = None if step < 0 else step
+            else:
+                step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self._dir}")
         if self._use_orbax:

@@ -1,0 +1,45 @@
+"""Packaging contract: pyproject console scripts resolve to real callables.
+
+Reference: ``setup.py:1633-1635`` registers ``horovodrun`` as a
+console_script; the installable-entry-point contract is asserted here
+without needing a pip install (the reference's test_run.py likewise
+asserts command composition as strings).
+"""
+
+import importlib
+import os
+import tomllib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_pyproject():
+    with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+        return tomllib.load(f)
+
+
+def test_console_scripts_resolve():
+    proj = _load_pyproject()["project"]
+    scripts = proj["scripts"]
+    assert "hvdrun" in scripts and "horovodrun" in scripts
+    for target in scripts.values():
+        mod_name, func_name = target.split(":")
+        mod = importlib.import_module(mod_name)
+        assert callable(getattr(mod, func_name))
+
+
+def test_version_matches_package():
+    import horovod_tpu
+
+    assert _load_pyproject()["project"]["version"] == horovod_tpu.__version__
+
+
+def test_package_discovery_covers_all_subpackages():
+    proj = _load_pyproject()
+    include = proj["tool"]["setuptools"]["packages"]["find"]["include"]
+    assert include == ["horovod_tpu*"]
+    # every package dir importable under the include glob
+    for dirpath, _, filenames in os.walk(os.path.join(REPO, "horovod_tpu")):
+        if "__init__.py" in filenames:
+            rel = os.path.relpath(dirpath, REPO).replace(os.sep, ".")
+            importlib.import_module(rel)
