@@ -86,7 +86,10 @@ def main():
     t0 = time.perf_counter()
     for _ in range(args.num_warmup_batches):
         params, opt_state, loss = step(params, opt_state, batch)
-    jax.block_until_ready(loss)
+    # fence on a host fetch of the loss, not jax.block_until_ready: through
+    # remote-device tunnels block_until_ready can return before the step
+    # finishes, silently inflating rates; a scalar device_get cannot
+    float(loss)
     log(f"bench: warmup (incl. compile) {time.perf_counter() - t0:.1f}s, "
         f"loss={float(loss):.3f}")
 
@@ -95,17 +98,32 @@ def main():
         t0 = time.perf_counter()
         for _ in range(args.num_batches_per_iter):
             params, opt_state, loss = step(params, opt_state, batch)
-        jax.block_until_ready(loss)
+        float(loss)
         dt = time.perf_counter() - t0
         img_secs.append(global_bs * args.num_batches_per_iter / dt)
         log(f"bench: iter {it}: {img_secs[-1]:.1f} img/sec total")
 
     per_chip = float(np.mean(img_secs)) / n_chips
+    # MFU: fwd+bwd ≈ 3 × 4.1 GFLOP/img at 224px (scaled for other sizes).
+    # PERF_NOTES.md derives why the structural ceiling for this model on
+    # v5e is ≈26% MFU (HBM-bound).
+    flops_per_img = 3 * 4.1e9 * (args.image_size / 224.0) ** 2
+    mfu = None
+    if platform == "tpu":
+        kind = jax.devices()[0].device_kind.lower()
+        peaks = {"v5 lite": 197e12, "v5e": 197e12, "v4": 275e12,
+                 "v5p": 459e12, "v5": 459e12, "v6 lite": 918e12,
+                 "v6e": 918e12}
+        hw_peak = next((p for k, p in peaks.items() if k in kind), None)
+        if hw_peak:
+            mfu = per_chip * flops_per_img / hw_peak
     print(json.dumps({
         "metric": "resnet50_img_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "img/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMG_SEC_PER_ACCEL, 3),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "model_tflops_per_sec": round(per_chip * flops_per_img / 1e12, 1),
     }), flush=True)
 
 

@@ -126,7 +126,10 @@ def main():
 
     t0 = time.perf_counter()
     variables, opt_state, loss = step(variables, opt_state, inputs, labels)
-    jax.block_until_ready(loss)
+    # fence on a host fetch of the loss, not jax.block_until_ready: through
+    # remote-device tunnels block_until_ready can return before the step
+    # finishes, silently inflating rates; a scalar device_get cannot
+    float(loss)
     if hvd.rank() == 0:
         print(f"Warmup (incl. compile): {time.perf_counter() - t0:.1f}s, "
               f"loss={float(loss):.4f}")
@@ -137,7 +140,7 @@ def main():
         t0 = time.perf_counter()
         for _ in range(args.num_batches_per_iter):
             variables, opt_state, loss = step(variables, opt_state, inputs, labels)
-        jax.block_until_ready(loss)
+        float(loss)
         dt = time.perf_counter() - t0
         rates.append(tokens_per_batch * args.num_batches_per_iter / dt)
         if hvd.rank() == 0:

@@ -121,19 +121,7 @@ class Checkpointer:
         """Load a checkpoint on this process (every rank reads — use
         :meth:`restore_and_broadcast` for the read-once pattern)."""
         if step is None:
-            # Resolve the step on root and broadcast: per-rank directory
-            # listings can lag on shared filesystems, and ranks silently
-            # restoring different steps is worse than any error.
-            if jax.process_count() > 1:
-                from horovod_tpu.ops import eager
-
-                mine = self.latest_step() if _is_root() else -1
-                step = int(eager.broadcast(
-                    np.asarray([-1 if mine is None else mine], np.int32),
-                    root_rank=0, name="ckpt_latest_step")[0])
-                step = None if step < 0 else step
-            else:
-                step = self.latest_step()
+            step = self._resolve_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self._dir}")
         if self._use_orbax:
@@ -154,6 +142,24 @@ class Checkpointer:
                                "state.pkl"), "rb") as f:
             return pickle.load(f)
 
+    def _resolve_step(self) -> Optional[int]:
+        """Pick the latest step, agreed across ranks.
+
+        Collective when multi-process (every rank must call it): root's
+        directory listing is broadcast, because per-rank listings can lag
+        on shared filesystems and ranks silently restoring different steps
+        is worse than any error.
+        """
+        if jax.process_count() == 1:
+            return self.latest_step()
+        from horovod_tpu.ops import eager
+
+        mine = self.latest_step() if _is_root() else None
+        step = int(eager.broadcast(
+            np.asarray([-1 if mine is None else mine], np.int32),
+            root_rank=0, name="ckpt_latest_step")[0])
+        return None if step < 0 else step
+
     def restore_and_broadcast(self, target: Any,
                               step: Optional[int] = None,
                               root_rank: int = 0) -> Any:
@@ -162,6 +168,12 @@ class Checkpointer:
         read per job instead of N."""
         if jax.process_count() == 1:
             return self.restore(target, step)
+        # resolve the step on ALL ranks first: restore() below runs on root
+        # only, so its internal collective resolution must not trigger
+        if step is None:
+            step = self._resolve_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self._dir}")
         if _is_root():
             state = self.restore(target, step)
         else:
