@@ -1,0 +1,66 @@
+"""Eager-collective bandwidth microbenchmark over real worker processes.
+
+Companion to the O(data) data-movement contract in
+:mod:`horovod_tpu.ops.eager` (``_allgather_rows``/``_alltoall_rows``):
+launches ``--np`` localhost processes through the programmatic runner and
+reports per-collective effective bandwidth.  The reference benchmarks its
+wire ops the same way (synthetic tensors, localhost multi-process).
+
+Usage::
+
+    python examples/eager_bandwidth_bench.py --np 2 --mb 64
+"""
+
+import argparse
+import time
+
+
+def worker(nbytes: int, iters: int):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    n = nbytes // 4
+    x = jnp.asarray(np.random.RandomState(hvd.rank()).rand(n), jnp.float32)
+
+    out = {}
+
+    def timed(fn, label):
+        fn(x, name=f"{label}_warm")
+        t0 = time.perf_counter()
+        for i in range(iters):
+            fn(x, name=f"{label}_{i}")
+        return (time.perf_counter() - t0) / iters
+
+    out["allreduce_MBps"] = nbytes / timed(hvd.allreduce, "ar") / 1e6
+    out["allgather_MBps"] = (nbytes * hvd.size()
+                             / timed(hvd.allgather, "ag") / 1e6)
+    out["alltoall_MBps"] = nbytes / timed(hvd.alltoall, "a2a") / 1e6
+
+    hvd.shutdown()
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--np", type=int, default=2)
+    p.add_argument("--mb", type=int, default=16, help="payload megabytes")
+    p.add_argument("--iters", type=int, default=5)
+    args = p.parse_args()
+
+    from horovod_tpu.runner import run
+
+    results = run(worker, args=(args.mb * 1024 * 1024, args.iters),
+                  np=args.np)
+    r0 = results[0]
+    for k, v in r0.items():
+        print(f"{k}: {v:,.0f} MB/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -254,7 +254,6 @@ def _reset() -> None:
             jax.distributed.shutdown()
     except Exception as e:  # pragma: no cover - backend-dependent teardown
         hvd_logging.warning("elastic: jax.distributed.shutdown failed: %s", e)
-    eager._reset_mesh_cache()
-    eager._reducer_cache.clear()
+    eager._reset_mesh_cache()   # drops all mesh-capturing eager caches
     jax.clear_caches()   # compiled programs hold the old mesh's devices
     rt_state.init()

@@ -83,9 +83,13 @@ def process_mesh() -> Mesh:
 
 
 def _reset_mesh_cache() -> None:
+    """Drop every cache that captures the proc mesh — called on elastic
+    world resize; stale jitted fns would pin the old world's devices."""
     global _proc_mesh
     _proc_mesh = None
     _validated_signatures.clear()
+    _reducer_cache.clear()
+    _motion_cache.clear()
 
 
 _validated_signatures: set = set()
@@ -358,6 +362,20 @@ def _dispatch_group(entries) -> None:
                 e.handle._fail(HorovodInternalError(str(err)))
 
 
+def _fence(x):
+    """Completion fence that survives remote-device tunnels.
+
+    ``jax.block_until_ready`` can return before execution finishes when the
+    device is driven through a remote PJRT tunnel; a host fetch cannot, so
+    for non-empty arrays we pull one element (the tiny index program's
+    completion implies the array's).  Returns ``x`` itself.
+    """
+    if getattr(x, "size", 0):
+        np.asarray(jnp.ravel(x)[0])
+        return x
+    return jax.block_until_ready(x)
+
+
 def synchronize(handle: Handle):
     """Block until the handle's collective completed and return the result
     (reference ``torch/mpi_ops.py:606``)."""
@@ -372,7 +390,7 @@ def synchronize(handle: Handle):
     compression, ctx = getattr(handle, "_decompress", (None, None))
     if compression is not None:
         result = compression.decompress(result, ctx)
-    return jax.block_until_ready(result)
+    return _fence(result)
 
 
 def poll(handle: Handle) -> bool:
@@ -394,6 +412,65 @@ def poll(handle: Handle) -> bool:
         return bool(r.is_ready()) if hasattr(r, "is_ready") else True
     except Exception:
         return True
+
+
+_motion_cache: dict = {}
+
+
+def _allgather_rows(garr):
+    """O(data) data plane for eager allgather.
+
+    ``lax.all_gather`` inside a shard_map over the proc mesh: each process
+    wires out its own row once and receives the other ``nproc-1`` rows —
+    total bytes on the wire per process = size of the gathered result, the
+    same cost contract as the reference's ``MPI_Allgatherv``
+    (``mpi_operations.cc:96``).  (A replicated ``out_shardings`` identity
+    jit happens to lower to the same collective, but only by optimizer
+    grace; this shape is the explicit, guaranteed form.)
+    """
+    mesh = process_mesh()
+    key = ("ag", id(mesh))
+    fn = _motion_cache.get(key)
+    if fn is None:
+        def ag(x):          # local block: (1, rows, ...)
+            return jax.lax.all_gather(x, "proc", axis=0, tiled=True)
+
+        fn = jax.jit(jax.shard_map(
+            ag, mesh=mesh, in_specs=P("proc"), out_specs=P(),
+            check_vma=False))
+        _motion_cache[key] = fn
+    return fn(garr)
+
+
+def _alltoall_rows(garr):
+    """O(data) data plane for eager alltoall.
+
+    ``lax.all_to_all`` inside a shard_map over the proc mesh.  Input is the
+    slot-packed global array ``(nproc_sender, nproc_dest, max_rows, ...)``
+    sharded by sender; the collective routes slot ``d`` of each sender to
+    process ``d``.  Wire cost per process: send ``(nproc-1) × max_rows``
+    rows, receive the same — O(data), matching ``MPI_Alltoallv``
+    (``mpi_operations.cc:392``).  The round-1 implementation replicated the
+    whole slot tensor to every process (O(world²·max_rows) received per
+    process); this is the fix for that scaling bug.
+
+    Returns the global result ``(nproc_sender, nproc_dest, max_rows, ...)``
+    sharded over the *destination* axis; callers read their own column via
+    ``addressable_shards`` — no further cross-process movement.
+    """
+    mesh = process_mesh()
+    key = ("a2a", id(mesh))
+    fn = _motion_cache.get(key)
+    if fn is None:
+        def a2a(x):         # local block: (1, nproc, max_rows, ...)
+            return jax.lax.all_to_all(x, "proc", split_axis=1,
+                                      concat_axis=0)
+
+        fn = jax.jit(jax.shard_map(
+            a2a, mesh=mesh, in_specs=P("proc"),
+            out_specs=P(None, "proc"), check_vma=False))
+        _motion_cache[key] = fn
+    return fn(garr)
 
 
 def allgather(tensor, name: Optional[str] = None):
@@ -430,7 +507,7 @@ def allgather_with_sizes(tensor, name: Optional[str] = None):
             pad = jnp.zeros((max_rows,) + tensor.shape[1:], tensor.dtype)
             pad = pad.at[:tensor.shape[0]].set(tensor)
             garr = _lift(pad)   # (nproc, max_rows, ...)
-            rep = jax.jit(lambda g: g, out_shardings=_replicated(mesh))(garr)
+            rep = _allgather_rows(garr)
             parts = [rep[p, :int(sizes[p])] for p in range(nproc)]
             out = jnp.concatenate(parts, axis=0)
             handle._fulfill(out)
@@ -502,12 +579,14 @@ def alltoall(tensor, splits=None, name: Optional[str] = None):
                 if cnt:
                     slots = slots.at[d, :cnt].set(tensor[off:off + cnt])
                 off += cnt
-            garr = _lift(slots)  # (nproc, nproc, max_rows, ...)
-            rep = jax.jit(lambda g: g, out_shardings=_replicated(mesh))(garr)
+            garr = _lift(slots)  # (nproc_sender, nproc_dest, max_rows, ...)
+            routed = _alltoall_rows(garr)   # sharded by destination
             me = jax.process_index()
-            parts = [rep[src, me, :int(all_splits[src, me])]
+            # my column lives in my local shard: (nproc_sender, 1, ...)
+            local = np.asarray(routed.addressable_shards[0].data)
+            parts = [local[src, 0, :int(all_splits[src, me])]
                      for src in range(nproc)]
-            out = jnp.concatenate(parts, axis=0)
+            out = jnp.concatenate([jnp.asarray(p) for p in parts], axis=0)
             handle._fulfill(out)
     except Exception as err:
         handle._fail(HorovodInternalError(str(err)))
