@@ -25,7 +25,8 @@ def worker(nbytes: int, iters: int):
     import horovod_tpu as hvd
 
     hvd.init()
-    n = nbytes // 4
+    # round down to a world-size multiple so the split-less alltoall is legal
+    n = (nbytes // 4) // hvd.size() * hvd.size()
     x = jnp.asarray(np.random.RandomState(hvd.rank()).rand(n), jnp.float32)
 
     out = {}

@@ -58,6 +58,13 @@ _lock = threading.Lock()
 _in_flight: dict = {}
 _name_counter = 0
 _proc_mesh: Optional[Mesh] = None
+# Global negotiation-cycle counter.  Every eager collective performs exactly
+# one `_negotiate` round, and negotiation rounds are themselves collectives,
+# so the counter advances in lock-step on every process — it is the global
+# "tick" the reference's background loop provides implicitly.  join() records
+# the tick at which each process joined; the max identifies the exact last
+# joiner (the reference controller knows this from request arrival order).
+_cycle = 0
 
 
 def _next_name(prefix: str) -> str:
@@ -85,8 +92,9 @@ def process_mesh() -> Mesh:
 def _reset_mesh_cache() -> None:
     """Drop every cache that captures the proc mesh — called on elastic
     world resize; stale jitted fns would pin the old world's devices."""
-    global _proc_mesh
+    global _proc_mesh, _cycle
     _proc_mesh = None
+    _cycle = 0
     _validated_signatures.clear()
     _reducer_cache.clear()
     _motion_cache.clear()
@@ -94,59 +102,146 @@ def _reset_mesh_cache() -> None:
 
 _validated_signatures: set = set()
 
+# Reference join-incompatibility error texts (``controller.cc:487-497,569``).
+_JOIN_UNSUPPORTED = {
+    "allgather": "Allgather is not supported with Join at this time. "
+                 "Specify sparse_as_dense=True if using DistributedOptimizer",
+    "alltoall": "Alltoall is not supported with Join at this time.",
+    "broadcast": "Broadcast is not supported with Join at this time.",
+}
+# Allreduce sub-ops a joined rank can zero-fill.  Zeros are the identity for
+# SUM; AVERAGE is sum + postscale 1/world_size in the reference
+# (``operations.cc:851-854``) so joined zeros lower the mean exactly as they
+# do there; Adasum's pairwise combine is zero-safe (coefficients fall back to
+# 1 on zero norms, ``adasum.py:_combine``).  MIN/MAX/PRODUCT have no zero
+# identity — mirroring the reference's op whitelist they error under join.
+_JOIN_ZERO_OPS = (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.ADASUM)
 
-def _validate_signature(kind: str, payload: str) -> None:
-    """Cross-process consistency check — controller-lite.
 
-    The reference's coordinator validates dtype/shape/op agreement across
-    ranks before executing and turns mismatches into descriptive error
-    responses (``ConstructResponse``, ``controller.cc:380``); without it,
-    a divergent shape would crash the transport layer mid-collective and
-    kill the job.  Here every process allgathers a digest of the
-    operation's signature (fixed-size, so this exchange itself can never
-    mismatch) and raises :class:`HorovodInternalError` everywhere on
-    disagreement.  Validated signatures are cached so each unique
-    signature costs one exchange — the response-cache fast path
-    (``response_cache.{h,cc}``) in miniature.
+class _Negotiation:
+    """Outcome of one controller cycle."""
+
+    __slots__ = ("all_joined", "last_rank", "joined", "desc")
+
+    def __init__(self, all_joined, last_rank, joined, desc):
+        self.all_joined = all_joined
+        self.last_rank = last_rank
+        self.joined = joined      # process indices currently in join()
+        self.desc = desc          # agreed collective descriptor (dict)
+
+
+def _negotiate(desc: Optional[dict], join_cycle: int = -1) -> _Negotiation:
+    """One negotiation cycle — controller-lite with Join support.
+
+    The reference's coordinator gathers per-rank Requests each cycle,
+    validates dtype/shape/op agreement, counts JOIN requests, and turns
+    mismatches into descriptive error responses delivered on every rank
+    (``ComputeResponseList`` ``controller.cc:63``, ``ConstructResponse``
+    ``controller.cc:380``, JOIN counting ``controller.cc:220-223``).  The
+    SPMD replacement is a fixed-shape host-metadata allgather per cycle:
+
+      ``[is_join, join_cycle, payload_len, sha256(payload) as 4 words]``
+
+    * all processes joined → everyone leaves join(); the exact last rank
+      is the one with the highest join tick (ties → highest rank), the
+      same answer the reference reads off request arrival order.
+    * a mix of joined and active processes → one extra variable-size
+      payload exchange so joined ranks learn the collective's descriptor
+      and can contribute zero tensors (``tensor_queue.cc``
+      ``GetTensorEntriesFromResponse`` synthesizes zero entries;
+      ``controller.cc:263-274``).  Only allreduce-family ops support
+      this; others raise the reference's error text
+      (``controller.cc:487-497,569``).
+    * digest mismatch among active processes → HorovodInternalError on
+      all of them, naming the divergent processes.
+
+    The fixed head exchange runs unconditionally — a joined process
+    blocked in its service loop must observe every cycle, so there is no
+    skip-the-wire fast path (the reference pays the same: its cache-hit
+    path still does 2 bitwise-AND + 1 bitwise-OR cross-rank syncs,
+    ``controller.cc:133-164``).  The signature cache only tracks
+    hit/miss statistics (``response_cache.{h,cc}`` observability).
     """
+    global _cycle
     mesh = process_mesh()
     nproc = mesh.devices.size
-    if nproc == 1:
-        return
-    # Keys are verbatim payloads.  Auto-generated names carry a per-call
-    # counter (``*.noname.N``), so auto-named collectives are permanent
-    # misses — deliberately: the counter IS the slot-order check (a rank
-    # issuing one extra same-shape collective drifts its counter, and the
-    # digest mismatch raises a descriptive error instead of pairing wrong
-    # slots silently).  Callers wanting the cached fast path pass stable
-    # names.  The set is bounded; in any correct execution all ranks issue
-    # identical sequences, so the clear fires at the same call everywhere.
-    key = (kind, payload)
-    if len(_validated_signatures) > 8192:
-        _validated_signatures.clear()
-    if key in _validated_signatures:
-        st = state.global_state() if state.is_initialized() else None
-        if st:
-            st.cache_stats["hits"] += 1
-        return
+    _cycle += 1
     import hashlib
+    import pickle
 
-    digest = hashlib.sha256(f"{kind}|{payload}".encode()).digest()
-    mine = np.frombuffer(digest[:32], np.int32)
-    theirs = _allgather_host_metadata(mine)
-    if not (theirs == mine[None]).all():
-        bad = [p for p in range(nproc)
-               if not (theirs[p] == mine).all()]
+    if desc is None:
+        payload = b""
+        head = np.zeros((7,), np.int64)
+        head[0], head[1] = 1, join_cycle
+    else:
+        payload = pickle.dumps(desc, protocol=4)
+        digest = hashlib.sha256(payload).digest()
+        head = np.empty((7,), np.int64)
+        head[0], head[1], head[2] = 0, -1, len(payload)
+        head[3:] = np.frombuffer(digest, np.int64)[:4]
+
+    heads = _allgather_host_metadata(head)  # (nproc, 7)
+    joined = [p for p in range(nproc) if heads[p, 0]]
+    active = [p for p in range(nproc) if not heads[p, 0]]
+
+    if not active:
+        ticks = heads[:, 1]
+        last = max(range(nproc), key=lambda p: (int(ticks[p]), p))
+        return _Negotiation(True, int(last), joined, None)
+
+    # Payload exchange whenever joined ranks must learn what the active
+    # ranks are running.  The condition depends only on the shared heads,
+    # so every process takes the same branch — no collective misalignment.
+    shared_desc = desc
+    if joined:
+        maxlen = int(heads[:, 2].max())
+        wire_len = ((maxlen + 7) // 8) * 8
+        raw = np.zeros((wire_len,), np.uint8)
+        raw[:len(payload)] = np.frombuffer(payload, np.uint8)
+        allp = _allgather_host_metadata(raw.view(np.int64))
+        src = active[0]
+        shared_desc = pickle.loads(
+            allp[src].tobytes()[:int(heads[src, 2])])
+
+    ref = active[0]
+    bad = [p for p in active
+           if not (heads[p, 2:] == heads[ref, 2:]).all()]
+    if desc is None:
+        # Joined rank: when active ranks disagree they all raise and no
+        # collective runs — return no descriptor so the join service loop
+        # does not emulate a collective nobody will issue.
+        return _Negotiation(False, -1, joined,
+                            None if bad else shared_desc)
+    if bad:
         raise HorovodInternalError(
-            f"Mismatched {kind} across processes: process "
-            f"{jax.process_index()} submitted [{payload}] but process(es) "
-            f"{bad} submitted a different name/dtype/shape/op for the same "
-            f"collective slot. All processes must issue identical "
-            f"collectives in identical order.")
-    _validated_signatures.add(key)
+            f"Mismatched {desc.get('kind')} across processes: process "
+            f"{jax.process_index()} submitted [{desc.get('sig')}] but "
+            f"process(es) {bad} disagree with process {ref} on the "
+            f"name/dtype/shape/op for this collective slot. All processes "
+            f"must issue identical collectives in identical order.")
+
     st = state.global_state() if state.is_initialized() else None
     if st:
-        st.cache_stats["misses"] += 1
+        key = (desc.get("kind"), bytes(np.asarray(heads[ref, 3:])))
+        if len(_validated_signatures) > 8192:
+            _validated_signatures.clear()
+        if key in _validated_signatures:
+            st.cache_stats["hits"] += 1
+        else:
+            _validated_signatures.add(key)
+            st.cache_stats["misses"] += 1
+
+    if joined:
+        kind = desc.get("kind")
+        if kind in _JOIN_UNSUPPORTED:
+            raise HorovodInternalError(_JOIN_UNSUPPORTED[kind])
+        if kind == "allreduce" and \
+                ReduceOp[desc["op"]] not in _JOIN_ZERO_OPS:
+            raise HorovodInternalError(
+                f"Allreduce op {desc['op']} is not supported with Join: "
+                f"zero contributions from joined ranks have no identity "
+                f"under {desc['op']}.")
+    return _Negotiation(False, -1, joined, shared_desc)
 
 
 def _lift(tensor: jax.Array) -> jax.Array:
@@ -336,20 +431,34 @@ def _dispatch_group(entries) -> None:
     nproc = process_mesh().devices.size
     with tl.activity(entries[0].name, tl.XLA_ALLREDUCE):
         try:
-            _validate_signature("allreduce group", "; ".join(
-                f"{e.name}:{e.tensor.dtype}:{tuple(e.tensor.shape)}:"
-                f"{e.op.name}:{e.prescale}:{e.postscale}" for e in entries))
-            if len(entries) == 1:
-                e = entries[0]
-                garr = _lift(e.tensor)
-                out = _reduce_global(garr, e.op, e.prescale, e.postscale, nproc)
-                e.handle._fulfill(out)
-                return
-            flat = jnp.concatenate([jnp.ravel(e.tensor) for e in entries])
             e0 = entries[0]
-            garr = _lift(flat)
             segments = tuple(int(e.tensor.size) for e in entries) \
                 if e0.op == ReduceOp.ADASUM else ()
+            total = int(sum(e.tensor.size for e in entries))
+            if nproc > 1:
+                # Descriptor carries exactly what a joined rank needs to
+                # issue the identical jitted reduction with zero inputs:
+                # flat length, dtype, op, scales, segments.  ``sig`` is the
+                # human-readable slot signature for mismatch errors.
+                _negotiate({
+                    "kind": "allreduce",
+                    "n": total,
+                    "dtype": str(e0.tensor.dtype),
+                    "op": e0.op.name,
+                    "pre": e0.prescale,
+                    "post": e0.postscale,
+                    "segments": segments,
+                    "sig": "; ".join(
+                        f"{e.name}:{e.tensor.dtype}:{tuple(e.tensor.shape)}:"
+                        f"{e.op.name}:{e.prescale}:{e.postscale}"
+                        for e in entries),
+                })
+            # Always reduce the flattened concatenation — a single entry
+            # too — so the compiled program depends only on (n, dtype, op,
+            # scales, segments) and joined ranks can replay it exactly.
+            flat = jnp.concatenate([jnp.ravel(e.tensor) for e in entries]) \
+                if len(entries) > 1 else jnp.ravel(e0.tensor)
+            garr = _lift(flat)
             red = _reduce_global(garr, e0.op, e0.prescale, e0.postscale,
                                  nproc, segments)
             off = 0
@@ -497,9 +606,10 @@ def allgather_with_sizes(tensor, name: Optional[str] = None):
     try:
         with tl.activity(name, tl.XLA_ALLGATHER):
             # first dims may differ per process; everything else must agree
-            _validate_signature(
-                "allgather",
-                f"{name}:{tensor.dtype}:{tuple(tensor.shape[1:])}")
+            _negotiate({
+                "kind": "allgather",
+                "sig": f"{name}:{tensor.dtype}:{tuple(tensor.shape[1:])}",
+            })
             # negotiate first-dim sizes (the controller's recvcount exchange)
             sizes = _allgather_host_metadata(
                 np.asarray([tensor.shape[0]], np.int64)).reshape(nproc)
@@ -529,9 +639,11 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
     _register(name, handle)
     try:
         with tl.activity(name, tl.XLA_BROADCAST):
-            _validate_signature(
-                "broadcast",
-                f"{name}:{tensor.dtype}:{tuple(tensor.shape)}:{root_rank}")
+            _negotiate({
+                "kind": "broadcast",
+                "sig": f"{name}:{tensor.dtype}:{tuple(tensor.shape)}:"
+                       f"{root_rank}",
+            })
             garr = _lift(tensor)
             out = jax.jit(lambda g: g[root_rank],
                           out_shardings=_replicated(mesh))(garr)
@@ -564,9 +676,10 @@ def alltoall(tensor, splits=None, name: Optional[str] = None):
     _register(name, handle)
     try:
         with tl.activity(name, tl.XLA_ALLTOALL):
-            _validate_signature(
-                "alltoall",
-                f"{name}:{tensor.dtype}:{tuple(tensor.shape[1:])}")
+            _negotiate({
+                "kind": "alltoall",
+                "sig": f"{name}:{tensor.dtype}:{tuple(tensor.shape[1:])}",
+            })
             all_splits = _allgather_host_metadata(splits)  # (nproc, nproc)
             all_splits = all_splits.reshape(nproc, nproc)
             max_rows = int(all_splits.max())
@@ -582,11 +695,13 @@ def alltoall(tensor, splits=None, name: Optional[str] = None):
             garr = _lift(slots)  # (nproc_sender, nproc_dest, max_rows, ...)
             routed = _alltoall_rows(garr)   # sharded by destination
             me = jax.process_index()
-            # my column lives in my local shard: (nproc_sender, 1, ...)
-            local = np.asarray(routed.addressable_shards[0].data)
+            # my column lives in my local shard: (nproc_sender, 1, ...) —
+            # already a single-device jax.Array; slice and concatenate on
+            # device, no host round-trip on the data path
+            local = routed.addressable_shards[0].data
             parts = [local[src, 0, :int(all_splits[src, me])]
                      for src in range(nproc)]
-            out = jnp.concatenate([jnp.asarray(p) for p in parts], axis=0)
+            out = jnp.concatenate(parts, axis=0)
             handle._fulfill(out)
     except Exception as err:
         handle._fail(HorovodInternalError(str(err)))
@@ -618,36 +733,63 @@ def _allgather_host_metadata(arr: np.ndarray) -> np.ndarray:
 
 def barrier(name: Optional[str] = None) -> None:
     """Block until all processes arrive (reference
-    ``MPIController::Barrier``, ``mpi_controller.cc:225``)."""
-    _allgather_host_metadata(np.zeros((1,), np.int64))
+    ``MPIController::Barrier``, ``mpi_controller.cc:225``).
+
+    The negotiation head exchange IS the barrier; routing it through
+    ``_negotiate`` (rather than a bare metadata allgather) keeps the wire
+    aligned when some processes sit in a ``join()`` service loop — they
+    observe a ``barrier`` descriptor, contribute nothing, and keep
+    cycling."""
+    mesh = process_mesh()
+    if mesh.devices.size == 1:
+        return
+    _negotiate({"kind": "barrier", "sig": "barrier"})
 
 
 def join() -> int:
-    """Uneven-data termination barrier (reference ``EnqueueJoin``
-    ``operations.cc:1044``; joined ranks contribute zeros,
-    ``controller.cc:263-274``).
+    """Uneven-data termination: joined processes keep servicing other
+    ranks' collectives with zero contributions until every process joins
+    (reference ``EnqueueJoin`` ``operations.cc:1044``; zero synthesis
+    ``controller.cc:263-274`` + ``tensor_queue.cc
+    GetTensorEntriesFromResponse``).  Returns the exact rank of the last
+    process to join, from the globally-consistent negotiation tick at
+    which each process entered join (ties broken toward the higher rank)
+    — the answer the reference controller reads off request arrival
+    order.
 
-    Eager semantics under SPMD: ``join()`` is called by every process once
-    it runs out of data; it synchronizes outstanding work and returns the
-    rank of the last process to join.  Ragged *per-step* participation is
-    handled in-graph by zero-masking (see
-    ``horovod_tpu.optim.join_step``); this call is the final barrier.
+    While a process sits in this loop, other ranks may continue issuing
+    ``allreduce`` (SUM/AVERAGE/ADASUM — the joined process replays the
+    identical jitted reduction with a zero input, so AVERAGE still
+    divides by the full world size, exactly like the reference's
+    postscale-1/size) and ``barrier``.  ``allgather``/``broadcast``/
+    ``alltoall`` from non-joined ranks raise the reference's
+    "not supported with Join" errors on those ranks
+    (``controller.cc:487-497,569``).  Ragged *per-step* participation
+    inside a jitted train step is handled by zero-masking instead (see
+    ``horovod_tpu.optim.join_step``).
     """
     from horovod_tpu.ops.bucketing import global_bucketer
 
     global_bucketer().flush()
     mesh = process_mesh()
     nproc = mesh.devices.size
-    me = jax.process_index()
     if nproc == 1:
         return 0
-    # order of arrival is not observable without a negotiation thread; the
-    # reference returns the last rank to join.  Best cross-host signal:
-    # wall-clock ns at the moment each process entered join() — comparable
-    # across NTP-synced hosts (monotonic clocks have per-host epochs and
-    # would be meaningless here).  Exchanged losslessly as int64.
-    import time
-
-    stamp = np.asarray([time.time_ns(), me], np.int64)
-    all_stamps = _allgather_host_metadata(stamp).reshape(nproc, 2)
-    return int(all_stamps[np.argmax(all_stamps[:, 0]), 1])
+    my_tick = _cycle
+    while True:
+        neg = _negotiate(None, join_cycle=my_tick)
+        if neg.all_joined:
+            return neg.last_rank
+        d = neg.desc
+        if d is None:
+            continue  # active ranks errored; nothing will execute
+        if d.get("kind") == "allreduce":
+            op = ReduceOp[d["op"]]
+            if op not in _JOIN_ZERO_OPS:
+                continue  # active ranks raised; no collective runs
+            zeros = jnp.zeros((d["n"],), jnp.dtype(d["dtype"]))
+            garr = _lift(zeros)
+            _reduce_global(garr, op, d["pre"], d["post"], nproc,
+                           tuple(d["segments"]))
+        # barrier / unsupported kinds: the head exchange was the whole
+        # contribution; loop straight back into the next cycle.

@@ -103,6 +103,69 @@ class TestTwoProcessWorld:
         assert out.returncode == 0, out.stderr[-3000:]
         assert out.stdout.count("WORKER_OK") == 2
 
+    def test_join_allreduce_uneven_batches(self, tmp_path):
+        """Joined ranks contribute zeros to collectives other ranks still
+        issue; join() returns the exact last rank (reference
+        ``test_horovod_join_allreduce`` in test/test_torch.py;
+        zero synthesis ``controller.cc:263-274``)."""
+        out = launch("""
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import jax.numpy as jnp
+            import numpy as np
+            import horovod_tpu as hvd
+
+            hvd.init()
+            r = hvd.process_rank()
+            # rank 0 has 2 batches, rank 1 has 5: after rank 0 joins, its
+            # zero contribution must make SUM return rank 1's value alone
+            # and AVERAGE divide by the full world size.
+            n_batches = 2 if r == 0 else 5
+            for i in range(n_batches):
+                s = hvd.allreduce(jnp.full((3,), float(r + 1)),
+                                  op=hvd.Sum, name=f"j.{i}")
+                if i < 2:  # both ranks present
+                    np.testing.assert_allclose(np.asarray(s), 3.0)
+                else:      # rank 0 joined: zeros + 2.0
+                    np.testing.assert_allclose(np.asarray(s), 2.0)
+            if r == 1:
+                a = hvd.allreduce(jnp.full((3,), 2.0), op=hvd.Average,
+                                  name="j.avg")
+                # (0 + 2) / world_size=2, reference postscale-1/size rule
+                np.testing.assert_allclose(np.asarray(a), 1.0)
+            last = hvd.join()
+            assert last == 1, f"last joiner must be rank 1, got {last}"
+            print("WORKER_OK", r)
+        """, tmp_path)
+        assert out.returncode == 0, out.stderr[-3000:]
+        assert out.stdout.count("WORKER_OK") == 2
+
+    def test_join_allgather_unsupported(self, tmp_path):
+        """Allgather issued while another rank joined raises the
+        reference's error on the active rank (``controller.cc:487-497``)
+        and the joined rank still exits its join loop."""
+        out = launch("""
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import jax.numpy as jnp
+            import horovod_tpu as hvd
+
+            hvd.init()
+            r = hvd.process_rank()
+            if r == 1:
+                try:
+                    hvd.allgather(jnp.ones((2, 2)), name="ag.join")
+                except hvd.HorovodInternalError as e:
+                    assert "not supported with Join" in str(e), e
+                    print("CAUGHT_OK", r)
+            last = hvd.join()
+            assert last == 1
+            print("WORKER_OK", r)
+        """, tmp_path)
+        assert out.returncode == 0, out.stderr[-3000:]
+        assert out.stdout.count("WORKER_OK") == 2
+        assert out.stdout.count("CAUGHT_OK") == 1
+
     def test_cross_rank_shape_mismatch_errors(self, tmp_path):
         """Rank-specific wrong shape must produce a catchable
         HorovodInternalError, not a hang (reference cross-rank error
