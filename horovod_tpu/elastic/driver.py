@@ -11,6 +11,7 @@ rendezvous RPC.
 
 from __future__ import annotations
 
+import os
 import socket
 import threading
 import time
@@ -23,11 +24,13 @@ from horovod_tpu.runner.network import (
     AckResponse,
     BasicService,
     RegisterWorkerRequest,
+    WorkerReadyRequest,
     notify_hosts_updated,
 )
 from horovod_tpu.utils import logging as hvd_logging
 
 DISCOVER_INTERVAL_S = 1.0    # reference driver.py:30
+START_TIMEOUT_S = 120.0      # worker must report READY within this window
 
 
 class GetRankAndSizeRequest:
@@ -51,19 +54,33 @@ class RankAndSizeResponse:
 class ElasticDriver:
     def __init__(self, discovery, min_np: int, max_np: Optional[int] = None,
                  timeout: float = 600.0, reset_limit: int = 0,
-                 secret_key: Optional[str] = None):
+                 secret_key: Optional[str] = None,
+                 start_timeout: float = START_TIMEOUT_S):
         self._host_manager = HostManager(discovery)
         self._registry = WorkerStateRegistry(self, self._host_manager,
                                              reset_limit=reset_limit)
         self._min_np = min_np
         self._max_np = max_np
         self._timeout = timeout
+        self._start_timeout = start_timeout
         self._secret_key = secret_key
 
         self._lock = threading.RLock()
         self._assignments: Dict[Tuple[str, int], SlotInfo] = {}
+        self._abort_events: Dict[Tuple[str, int], threading.Event] = {}
+        # workers that asked for a generation newer than the current one
+        # (worker-initiated re-rendezvous, see _handle)
+        self._regen_requests: set = set()
         self._generation = 0
         self._coordinator_addr = ""
+        # Driver-hosted per-generation coordination services.  Old
+        # generations are retired, NOT shut down, until job completion: a
+        # coordination service dying while any worker's client is still
+        # attached terminates that worker from a C++ poll thread
+        # (jaxlib's missed-heartbeat path raises std::bad_cast before the
+        # Python callback can run), so every service must outlive the
+        # last client that may still detach from it.
+        self._coord_services: List = []
         self._worker_notify_addrs: Dict[int, Tuple[str, int]] = {}
         self._create_worker_fn: Optional[Callable] = None
         self._shutdown = threading.Event()
@@ -102,19 +119,56 @@ class ElasticDriver:
             with self._lock:
                 self._worker_notify_addrs[req.rank] = tuple(req.address)
             return AckResponse()
+        if isinstance(req, WorkerReadyRequest):
+            self._registry.record_ready(req.host, req.local_rank)
+            return AckResponse()
         if isinstance(req, GetRankAndSizeRequest):
             with self._lock:
                 slot = self._assignments.get((req.host, req.local_rank))
-                return RankAndSizeResponse(slot, self._coordinator_addr,
+                if slot is not None and req.generation >= self._generation:
+                    # Worker-initiated re-rendezvous: the worker already
+                    # has the current generation but needs a newer one —
+                    # its collectives failed without anything the driver
+                    # can observe (e.g. a cross-rank signature mismatch
+                    # raised on every rank at once).  When every assigned
+                    # worker asks, regenerate: new generation + fresh
+                    # coordinator, same assignments.  This is the
+                    # reference's rendezvous-round advance: workers
+                    # re-registering IS the signal for a new round.
+                    self._regen_requests.add((req.host, req.local_rank))
+                    if self._regen_requests >= set(self._assignments):
+                        hvd_logging.info(
+                            "elastic: all %d workers requested a new "
+                            "generation — re-rendezvousing",
+                            len(self._assignments))
+                        self._update_host_assignments()
+                    slot = self._assignments.get((req.host, req.local_rank))
+                resp = RankAndSizeResponse(slot, self._coordinator_addr,
                                            self._generation)
+            if slot is not None:
+                # a worker fetching its assignment has a live control loop
+                # — the reference records READY at the rendezvous GET
+                # (``elastic/rendezvous.py`` → driver.record_ready)
+                self._registry.record_ready(req.host, req.local_rank)
+            return resp
         raise ValueError(f"unexpected request {type(req).__name__}")
 
     # -- lifecycle ----------------------------------------------------------
 
     def start(self, np: int, create_worker_fn: Callable) -> None:
         """Wait for ``min(np, …)`` slots, compute assignments, spawn all
-        workers (reference ``driver.start``)."""
+        workers (reference ``driver.start``).  ``create_worker_fn`` takes
+        ``(slot, coordinator_addr, generation[, abort_event])``; when the
+        4th parameter is accepted, the driver sets the event to demand
+        the worker process tree be killed (hung startup, de-assignment)."""
         self._create_worker_fn = create_worker_fn
+        import inspect
+
+        try:
+            nparams = len(inspect.signature(create_worker_fn).parameters)
+        except (TypeError, ValueError):
+            nparams = 4
+        self._worker_fn_takes_abort = nparams >= 4
         self._service.start()
         self._discovery_thread.start()
         self.wait_for_available_slots(self._min_np)
@@ -127,6 +181,9 @@ class ElasticDriver:
             self._exit_code = exit_code
             self._finished.set()
         self._shutdown.set()
+        with self._lock:
+            keys = list(self._abort_events)
+        self._abort_workers(keys)
 
     def finished(self) -> bool:
         return self._finished.is_set()
@@ -134,6 +191,10 @@ class ElasticDriver:
     def wait_for_completion(self) -> int:
         self._finished.wait()
         self._service.shutdown()
+        with self._lock:
+            services, self._coord_services = self._coord_services, []
+        for svc in services:
+            svc.shutdown()
         return self._exit_code if self._exit_code is not None else 0
 
     def wait_for_available_slots(self, min_np: int) -> None:
@@ -205,20 +266,35 @@ class ElasticDriver:
             self._max_np or sum(h.slots for h in hosts))
         self._assignments = {(s.hostname, s.local_rank): s
                              for s in assignments}
+        self._registry.purge_unassigned(set(self._assignments))
         self._coordinator_addr = self._new_coordinator_addr(assignments)
         self._generation += 1
+        self._regen_requests.clear()
         return self._assignments
 
     def _new_coordinator_addr(self, assignments: List[SlotInfo]) -> str:
-        """Fresh jax.distributed coordinator per generation, on rank 0's
-        host (the process that will bind it)."""
-        rank0_host = next(s.hostname for s in assignments if s.rank == 0)
-        if rank0_host in ("localhost", "127.0.0.1", socket.gethostname()):
-            rank0_host = "127.0.0.1"
-        with socket.socket() as s:
-            s.bind(("", 0))
-            port = s.getsockname()[1]
-        return f"{rank0_host}:{port}"
+        """Fresh coordination service per generation, hosted HERE in the
+        driver process (see ``runtime/distributed.py``): a worker death —
+        including rank 0's — must not take the coordination plane down,
+        the same reason the reference's rendezvous server lives in the
+        launcher (``gloo_run.py:213``), never in a worker."""
+        from horovod_tpu.runtime import distributed as hvd_dist
+
+        with socket.socket() as sock:
+            sock.bind(("", 0))
+            port = sock.getsockname()[1]
+        nproc = len(assignments)
+        if nproc > 1:   # single-process generations never connect
+            heartbeat = int(os.environ.get(
+                "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT",
+                hvd_dist.DEFAULT_HEARTBEAT_TIMEOUT_S))
+            self._coord_services.append(hvd_dist.start_coordination_service(
+                port, nproc, heartbeat_timeout=heartbeat))
+        host = socket.gethostname()
+        if all(s.hostname in ("localhost", "127.0.0.1", host)
+               for s in assignments):
+            host = "127.0.0.1"
+        return f"{host}:{port}"
 
     # -- worker management --------------------------------------------------
 
@@ -229,29 +305,82 @@ class ElasticDriver:
             self._spawn(slot)
 
     def _spawn(self, slot: SlotInfo) -> None:
-        self._registry.record_ready(slot.hostname, slot.local_rank)
+        # SPAWNED, not READY: readiness is worker-reported (it arrives via
+        # WorkerReadyRequest / the rendezvous GET) so a worker hung in
+        # startup is observable — the round-1 design marked workers ready
+        # at spawn, making a wedged startup look healthy forever.
+        self._registry.record_spawned(slot.hostname, slot.local_rank)
+        abort = threading.Event()
+        with self._lock:
+            self._abort_events[(slot.hostname, slot.local_rank)] = abort
         thread = threading.Thread(
-            target=self._run_worker, args=(slot,), daemon=True,
+            target=self._run_worker, args=(slot, abort), daemon=True,
             name=f"hvd_tpu_elastic_worker_{slot.rank}")
         thread.start()
+        watchdog = threading.Timer(
+            self._start_timeout, self._check_started, args=(slot,))
+        watchdog.daemon = True
+        watchdog.start()
 
-    def _run_worker(self, slot: SlotInfo) -> None:
+    def _check_started(self, slot: SlotInfo) -> None:
+        """Startup watchdog: a worker that never reported READY within the
+        start timeout is treated as a startup failure (blacklist + resume),
+        the reference's start-timeout semantics
+        (``runner/elastic/settings.py`` elastic start timeout)."""
+        from horovod_tpu.elastic.registration import SPAWNED
+
+        if self._shutdown.is_set():
+            return
+        if self._registry.get_state(slot.hostname, slot.local_rank) == SPAWNED:
+            hvd_logging.warning(
+                "elastic: worker %s:%d never reported ready within %.0fs — "
+                "treating as startup failure",
+                slot.hostname, slot.local_rank, self._start_timeout)
+            self.record_worker_exit(slot.hostname, slot.local_rank, 1)
+
+    def _run_worker(self, slot: SlotInfo,
+                    abort: Optional[threading.Event] = None) -> None:
         with self._lock:
             coordinator = self._coordinator_addr
             generation = self._generation
         try:
-            exit_code = self._create_worker_fn(slot, coordinator, generation)
+            if self._worker_fn_takes_abort:
+                exit_code = self._create_worker_fn(slot, coordinator,
+                                                   generation, abort)
+            else:
+                exit_code = self._create_worker_fn(slot, coordinator,
+                                                   generation)
         except Exception as e:
             hvd_logging.warning("elastic: worker rank %d crashed in "
                                 "launcher: %s", slot.rank, e)
             exit_code = 1
         self.record_worker_exit(slot.hostname, slot.local_rank, exit_code)
 
+    def _abort_workers(self, keys) -> None:
+        """Fire abort events so the launcher kills the worker process
+        trees (reference: host events passed into create_worker_fn,
+        ``driver.py:276-283``) — a hung or de-assigned worker must not
+        keep holding its host's chips."""
+        with self._lock:
+            events = [self._abort_events[k] for k in keys
+                      if k in self._abort_events]
+        for ev in events:
+            ev.set()
+
     def record_worker_exit(self, host: str, local_rank: int,
                            exit_code: int) -> None:
         """Reference ``_handle_worker_exit``: zero → success (job completes
         when every assigned worker succeeded); non-zero → blacklist +
-        resume with survivors."""
+        resume with survivors.  Exits from workers without a current rank
+        assignment (scale-down removals, already-blacklisted hosts) are
+        ignored (reference ``driver.py:292-296``) — otherwise a gracefully
+        removed worker's exit would blacklist its still-healthy host."""
+        with self._lock:
+            if (host, local_rank) not in self._assignments:
+                hvd_logging.debug(
+                    "elastic: ignoring exit code %d from unassigned worker "
+                    "%s:%d", exit_code, host, local_rank)
+                return
         if exit_code == 0:
             self._registry.record_success(host, local_rank)
             with self._lock:
@@ -267,6 +396,10 @@ class ElasticDriver:
                 "elastic: worker %s:%d exited with code %d",
                 host, local_rank, exit_code)
             self._registry.record_failure(host, local_rank)
+            # the whole host is blacklisted: kill its other workers too
+            with self._lock:
+                siblings = [k for k in self._abort_events if k[0] == host]
+            self._abort_workers(siblings)
 
     def resume(self) -> None:
         """Failure/host-change recovery: recompute assignments, spawn
@@ -291,9 +424,19 @@ class ElasticDriver:
                     return
                 added = [s for k, s in self._assignments.items()
                          if k not in before]
+                removed = before - set(self._assignments)
             for slot in added:
                 self._spawn(slot)
             self._notify_workers_host_changes(HostUpdateResult.mixed)
+            # give de-assigned workers a grace window to self-retire via
+            # the rendezvous (clean exit 0), then force-kill stragglers
+            if removed:
+                def _reap():
+                    self._shutdown.wait(30.0)
+                    self._abort_workers(removed)
+
+                threading.Thread(target=_reap, daemon=True,
+                                 name="hvd_tpu_elastic_reaper").start()
 
     def get_slot_info(self, host: str, local_rank: int) -> Optional[SlotInfo]:
         with self._lock:

@@ -33,8 +33,13 @@ def run_elastic(args) -> int:
             "elastic mode needs --host-discovery-script or -H hosts")
 
     key = make_secret_key()
+    from horovod_tpu.elastic.driver import START_TIMEOUT_S
+
+    start_timeout = float(os.environ.get("HOROVOD_ELASTIC_START_TIMEOUT",
+                                         START_TIMEOUT_S))
     driver = ElasticDriver(discovery, min_np, args.max_np,
-                           timeout=args.elastic_timeout, secret_key=key)
+                           timeout=args.elastic_timeout, secret_key=key,
+                           start_timeout=start_timeout)
     base_env = config_parser.set_env_from_args(dict(os.environ), args)
     driver_host, driver_port = driver.address
     out_dir: Optional[str] = args.output_filename
@@ -42,7 +47,7 @@ def run_elastic(args) -> int:
         os.makedirs(out_dir, exist_ok=True)
 
     def create_worker_fn(slot: SlotInfo, coordinator: str,
-                         generation: int) -> int:
+                         generation: int, abort_event=None) -> int:
         env = dict(base_env)
         env.update(slot.to_env())
         env.update({
@@ -58,9 +63,10 @@ def run_elastic(args) -> int:
         if out_dir:
             stdout = open(os.path.join(out_dir, f"rank.{slot.rank}.out"), "ab")
             stderr = open(os.path.join(out_dir, f"rank.{slot.rank}.err"), "ab")
+        events = [abort_event] if abort_event is not None else None
         try:
             return safe_shell_exec.execute(cmd, env=env, stdout=stdout,
-                                           stderr=stderr)
+                                           stderr=stderr, events=events)
         finally:
             for f in (stdout, stderr):
                 if f:

@@ -13,7 +13,8 @@ from typing import Dict, Tuple
 
 from horovod_tpu.utils import logging as hvd_logging
 
-READY = "READY"
+SPAWNED = "SPAWNED"   # process launched, worker has not reported in yet
+READY = "READY"       # worker-reported: startup done, training loop entered
 SUCCESS = "SUCCESS"
 FAILURE = "FAILURE"
 
@@ -36,9 +37,18 @@ class WorkerStateRegistry:
         with self._lock:
             return self._states.get((host, local_rank), "")
 
+    def record_spawned(self, host: str, local_rank: int) -> None:
+        """Launcher-side: the process was exec'd; READY comes from the
+        worker itself (reference registration.py: READY is reported via
+        the rendezvous, not assumed at spawn)."""
+        with self._lock:
+            self._states.setdefault((host, local_rank), SPAWNED)
+
     def record_ready(self, host: str, local_rank: int) -> None:
         with self._lock:
-            self._states[(host, local_rank)] = READY
+            # never regress a terminal state (late READY after FAILURE)
+            if self._states.get((host, local_rank)) not in (SUCCESS, FAILURE):
+                self._states[(host, local_rank)] = READY
 
     def record_success(self, host: str, local_rank: int) -> None:
         with self._lock:
@@ -69,6 +79,15 @@ class WorkerStateRegistry:
     def count(self, state: str) -> int:
         with self._lock:
             return sum(1 for s in self._states.values() if s == state)
+
+    def purge_unassigned(self, assigned: set) -> None:
+        """Drop states for workers no longer in the assignment set —
+        otherwise a host removed and later re-added would inherit its old
+        worker's READY/SUCCESS state, blinding the startup watchdog and
+        the completion check for the re-spawned worker."""
+        with self._lock:
+            self._states = {k: v for k, v in self._states.items()
+                            if k in assigned}
 
     def reset(self, expected: int) -> None:
         with self._lock:

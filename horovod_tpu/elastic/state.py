@@ -76,16 +76,26 @@ class State:
 
     def _sync_host_updates(self, prev_ts, last_ts, update_res):
         from horovod_tpu.ops import eager
-        from horovod_tpu.ops.collectives import ReduceOp
 
         if eager.process_mesh().devices.size == 1:
             return prev_ts, last_ts, update_res
-        import jax.numpy as jnp
-
-        agreed = eager.allreduce(
-            jnp.asarray([last_ts, update_res], jnp.int64),
-            op=ReduceOp.MAX, name="elastic.host_updates")
-        return prev_ts, int(agreed[0]), int(agreed[1])
+        # Rank 0's (prev, last, res) triple is the global truth — the
+        # reference broadcasts all three (``elastic.py:84-88``) so the
+        # raise decision is all-or-none.  A max-allreduce of each rank's
+        # own view deadlocks a freshly-joined worker: its prev is 0 while
+        # a survivor's prev already covers the update, so only the new
+        # worker would interrupt and wait for a generation that never
+        # comes.  int64 goes through the int32-pair-safe metadata
+        # exchange (microsecond timestamps overflow int32).  The
+        # ``hostsync`` negotiation keeps the wire aligned when some
+        # process sits in a join() service loop — it emulates the
+        # follow-up 3-word exchange with zeros (and zeros from a joined
+        # rank 0 simply mean "no interrupt", which is right: a joined
+        # rank has left the training loop).
+        eager._negotiate({"kind": "hostsync", "sig": "hostsync"})
+        allv = eager._allgather_host_metadata(
+            np.asarray([prev_ts, last_ts, update_res], np.int64))
+        return int(allv[0, 0]), int(allv[0, 1]), int(allv[0, 2])
 
     # -- to implement -------------------------------------------------------
 
@@ -249,11 +259,29 @@ def _reset() -> None:
     # leave the old coordination-service world: without this,
     # jax.distributed stays initialized, GlobalState.initialize skips the
     # re-rendezvous, and the rebuilt mesh would still contain dead peers
+    from horovod_tpu.runtime import distributed as hvd_dist
+
+    if hvd_dist.elastic_client_active():
+        # driver-hosted service: detach without the shutdown barrier
+        # (dead peers would block it)
+        hvd_dist.disconnect_elastic_client()
+    else:
+        try:
+            if getattr(jax.distributed, "is_initialized", lambda: False)():
+                jax.distributed.shutdown()
+        except Exception as e:  # pragma: no cover - backend teardown
+            hvd_logging.warning(
+                "elastic: jax.distributed.shutdown failed: %s", e)
+    # The live PJRT client was built against the OLD distributed world (its
+    # cross-process collectives hold dead peer connections); re-initializing
+    # jax.distributed alone is not enough — the backend must be rebuilt so
+    # the new world's client is constructed on first use.
     try:
-        if getattr(jax.distributed, "is_initialized", lambda: False)():
-            jax.distributed.shutdown()
-    except Exception as e:  # pragma: no cover - backend-dependent teardown
-        hvd_logging.warning("elastic: jax.distributed.shutdown failed: %s", e)
+        import jax.extend.backend as _jeb
+
+        _jeb.clear_backends()
+    except Exception as e:  # pragma: no cover - version-dependent API
+        hvd_logging.warning("elastic: clear_backends failed: %s", e)
     eager._reset_mesh_cache()   # drops all mesh-capturing eager caches
     jax.clear_caches()   # compiled programs hold the old mesh's devices
     rt_state.init()

@@ -30,13 +30,24 @@ class WorkerNotificationManager:
         if addr:
             self._service = WorkerNotificationService(self, secret_key)
             self._service.start()
-            # register our address with the driver so it can notify us
+            # register our address with the driver so it can notify us,
+            # and report READY: startup finished, training loop entered
+            # (worker-reported readiness — reference registration.py)
             driver_addr = os.environ.get("HOROVOD_ELASTIC_DRIVER_ADDR")
             if driver_addr:
-                from horovod_tpu.runner.network import notify_worker_registered
+                import socket
+
+                from horovod_tpu.runner.network import (
+                    notify_worker_ready,
+                    notify_worker_registered,
+                )
 
                 notify_worker_registered(driver_addr, self._service.address,
                                          secret_key)
+                notify_worker_ready(
+                    driver_addr, secret_key,
+                    os.environ.get("HOROVOD_HOSTNAME", socket.gethostname()),
+                    int(os.environ.get("HOROVOD_LOCAL_RANK", "0")))
 
     def register_listener(self, listener) -> None:
         with self._lock:
@@ -77,8 +88,20 @@ def refresh_assignment_from_driver(timeout_s: float = 60.0) -> bool:
     """After a reset, fetch this worker's new identity from the elastic
     driver's rendezvous RPC and export it into the env the runtime reads
     (reference: workers re-read rank/size from the rendezvous on reset,
-    ``elastic/rendezvous.py``).  No-op (False) outside elastic runs."""
+    ``elastic/rendezvous.py``).  No-op (False) outside elastic runs.
+
+    Waits for a generation STRICTLY newer than the one this worker was
+    running: a reset is only ever triggered after something the driver
+    will also observe (a worker death → resume, a host change → resume
+    after reassignment), so re-initializing against the old generation's
+    coordinator would race the driver's reassignment and hang in
+    ``jax.distributed.initialize`` waiting for a world that will never
+    form again.  A worker whose (host, local_rank) has no slot in the new
+    generation was scaled away — it exits 0 (the reference driver stops
+    removed workers via the host event; here the worker retires itself).
+    """
     import socket
+    import sys
     import time
 
     driver_addr = os.environ.get("HOROVOD_ELASTIC_DRIVER_ADDR")
@@ -97,7 +120,13 @@ def refresh_assignment_from_driver(timeout_s: float = 60.0) -> bool:
     while time.monotonic() < deadline:
         resp = client.request(
             GetRankAndSizeRequest(hostname, local_rank, known_gen))
-        if resp.slot is not None and resp.generation >= known_gen:
+        if resp.generation > known_gen:
+            if resp.slot is None:
+                hvd_logging.info(
+                    "elastic: (%s, %d) has no slot in generation %d — "
+                    "worker removed by scale-down, exiting cleanly",
+                    hostname, local_rank, resp.generation)
+                sys.exit(0)
             os.environ.update(resp.slot.to_env())
             os.environ["HOROVOD_COORDINATOR_ADDR"] = resp.coordinator_addr
             os.environ["HOROVOD_ELASTIC_GENERATION"] = str(resp.generation)
@@ -107,8 +136,8 @@ def refresh_assignment_from_driver(timeout_s: float = 60.0) -> bool:
             return True
         time.sleep(0.5)
     raise TimeoutError(
-        f"elastic: no assignment for ({hostname}, {local_rank}) from "
-        f"driver within {timeout_s}s — this worker may have been removed")
+        f"elastic: no new-generation assignment for ({hostname}, "
+        f"{local_rank}) from driver within {timeout_s}s")
 
 
 _manager: Optional[WorkerNotificationManager] = None

@@ -96,11 +96,16 @@ def _reset_mesh_cache() -> None:
     _proc_mesh = None
     _cycle = 0
     _validated_signatures.clear()
+    _desc_cache.clear()
     _reducer_cache.clear()
     _motion_cache.clear()
 
 
 _validated_signatures: set = set()
+# digest → descriptor, populated at validation time on every process so a
+# later join() can replay previously-seen collectives without re-paying
+# the payload exchange (see _negotiate)
+_desc_cache: dict = {}
 
 # Reference join-incompatibility error texts (``controller.cc:487-497,569``).
 _JOIN_UNSUPPORTED = {
@@ -169,6 +174,14 @@ def _negotiate(desc: Optional[dict], join_cycle: int = -1) -> _Negotiation:
     import hashlib
     import pickle
 
+    # Bounded caches.  The length is identical on every process at any
+    # aligned cycle (all processes run identical collective sequences),
+    # so the clear fires at the same cycle everywhere — a prerequisite
+    # for using cache membership in wire-shape decisions below.
+    if len(_validated_signatures) > 8192:
+        _validated_signatures.clear()
+        _desc_cache.clear()
+
     if desc is None:
         payload = b""
         head = np.zeros((7,), np.int64)
@@ -189,27 +202,45 @@ def _negotiate(desc: Optional[dict], join_cycle: int = -1) -> _Negotiation:
         last = max(range(nproc), key=lambda p: (int(ticks[p]), p))
         return _Negotiation(True, int(last), joined, None)
 
-    # Payload exchange whenever joined ranks must learn what the active
-    # ranks are running.  The condition depends only on the shared heads,
-    # so every process takes the same branch — no collective misalignment.
+    ref = active[0]
+    ref_digest = heads[ref, 3:].tobytes()
+    seen = ref_digest in _validated_signatures
+
+    # Payload exchange only when a joined rank may be missing the
+    # descriptor.  Every process — active or joined — records
+    # digest→descriptor at validation time, and all processes execute
+    # identical collective sequences, so the caches are identical and
+    # the skip decision is computable everywhere from shared data (no
+    # collective misalignment).  A previously-validated descriptor thus
+    # costs only the fixed head exchange even mid-join.
+    need_payload = bool(joined) and not seen
     shared_desc = desc
-    if joined:
+    if need_payload:
         maxlen = int(heads[:, 2].max())
         wire_len = ((maxlen + 7) // 8) * 8
         raw = np.zeros((wire_len,), np.uint8)
         raw[:len(payload)] = np.frombuffer(payload, np.uint8)
         allp = _allgather_host_metadata(raw.view(np.int64))
-        src = active[0]
-        shared_desc = pickle.loads(
-            allp[src].tobytes()[:int(heads[src, 2])])
+        if desc is None:
+            shared_desc = pickle.loads(
+                allp[ref].tobytes()[:int(heads[ref, 2])])
+    elif desc is None:
+        shared_desc = _desc_cache.get(ref_digest)
+        if shared_desc is None:  # pragma: no cover - invariant violation
+            raise HorovodInternalError(
+                "internal: joined process has no cached descriptor for a "
+                "previously-validated collective — negotiation caches "
+                "desynchronized across processes.")
 
-    ref = active[0]
     bad = [p for p in active
            if not (heads[p, 2:] == heads[ref, 2:]).all()]
     if desc is None:
         # Joined rank: when active ranks disagree they all raise and no
         # collective runs — return no descriptor so the join service loop
         # does not emulate a collective nobody will issue.
+        if not bad and not seen:
+            _validated_signatures.add(ref_digest)
+            _desc_cache[ref_digest] = shared_desc
         return _Negotiation(False, -1, joined,
                             None if bad else shared_desc)
     if bad:
@@ -220,16 +251,12 @@ def _negotiate(desc: Optional[dict], join_cycle: int = -1) -> _Negotiation:
             f"name/dtype/shape/op for this collective slot. All processes "
             f"must issue identical collectives in identical order.")
 
+    if not seen:
+        _validated_signatures.add(ref_digest)
+        _desc_cache[ref_digest] = desc
     st = state.global_state() if state.is_initialized() else None
     if st:
-        key = (desc.get("kind"), bytes(np.asarray(heads[ref, 3:])))
-        if len(_validated_signatures) > 8192:
-            _validated_signatures.clear()
-        if key in _validated_signatures:
-            st.cache_stats["hits"] += 1
-        else:
-            _validated_signatures.add(key)
-            st.cache_stats["misses"] += 1
+        st.cache_stats["hits" if seen else "misses"] += 1
 
     if joined:
         kind = desc.get("kind")
@@ -791,5 +818,9 @@ def join() -> int:
             garr = _lift(zeros)
             _reduce_global(garr, op, d["pre"], d["post"], nproc,
                            tuple(d["segments"]))
+        elif d.get("kind") == "hostsync":
+            # elastic host-update sync: participate in the fixed 3-word
+            # exchange with zeros ("nothing to report")
+            _allgather_host_metadata(np.zeros((3,), np.int64))
         # barrier / unsupported kinds: the head exchange was the whole
         # contribution; loop straight back into the next cycle.

@@ -100,6 +100,17 @@ class RegisterWorkerRequest:
         self.address = address
 
 
+class WorkerReadyRequest:
+    """Worker → driver: this worker finished startup and entered the
+    elastic training loop (reference ``WorkerStateRegistry`` READY
+    barrier, ``runner/elastic/registration.py`` — worker-reported, so a
+    worker hung in startup is distinguishable from a healthy one)."""
+
+    def __init__(self, host: str, local_rank: int):
+        self.host = host
+        self.local_rank = local_rank
+
+
 class BasicService:
     """Threaded TCP server dispatching pickled requests to a handler
     (reference ``BasicService``, ``network.py:268``)."""
@@ -217,3 +228,11 @@ def notify_hosts_updated(worker_addr: Tuple[str, int], key: Optional[str],
     """Driver-side: ping one worker that the host set changed."""
     BasicClient(tuple(worker_addr), key).request(
         HostsUpdatedRequest(timestamp, res))
+
+
+def notify_worker_ready(driver_addr: str, key: Optional[str],
+                        host: str, local_rank: int) -> None:
+    """Worker-side: report READY to the elastic driver's registry."""
+    dhost, port = driver_addr.rsplit(":", 1)
+    BasicClient((dhost, int(port)), key).request(
+        WorkerReadyRequest(host, local_rank))

@@ -1,0 +1,167 @@
+"""Elastic-capable coordination-service management.
+
+Plain ``jax.distributed.initialize`` has two properties that are fatal
+for elastic training:
+
+1. the coordination service is hosted by worker ``process_id == 0`` — if
+   that worker dies, every other worker's error-poll RPC fails and
+2. the default missed-heartbeat handler terminates the process
+   (``LOG(QFATAL)`` in xla's ``client.h:80``) instead of raising.
+
+The net effect is that a single worker death kills the entire world,
+which is exactly what elastic mode exists to survive.  The reference has
+the same split for the same reason: its rendezvous server lives in the
+*launcher* (``gloo_run.py:213 RendezvousServer``), never in a worker,
+so worker death cannot take the control plane with it.
+
+This module mirrors that topology for the JAX runtime:
+
+* the elastic **driver** (launcher process) hosts one coordination
+  service per world generation (:func:`start_coordination_service`) at
+  the per-generation coordinator address it already hands out through
+  the rendezvous RPC;
+* **workers** connect with :func:`connect_elastic_client`, a distributed
+  runtime client whose missed-heartbeat callback logs-and-flags instead
+  of terminating — a dead peer then surfaces as a catchable collective
+  error (gloo "Connection closed by peer" → ``HorovodInternalError``)
+  and the elastic retry loop recovers;
+* :func:`disconnect_elastic_client` detaches the client on reset without
+  the default shutdown barrier (which would block on dead peers).
+
+Non-elastic runs keep the stock ``jax.distributed.initialize`` path
+(worker 0 hosts the service) — no behavior change.
+
+The implementation uses ``jax._src.distributed`` internals (the public
+API cannot host a service without also being process 0, nor install a
+heartbeat callback); pinned against the image's jax 0.9.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from horovod_tpu.utils import logging as hvd_logging
+
+# snappy failure detection for elastic worlds; stock default is 100 s
+DEFAULT_HEARTBEAT_TIMEOUT_S = 10
+
+
+class CoordinationService:
+    """Driver-side coordination service handle (one per generation)."""
+
+    def __init__(self, port: int, num_processes: int,
+                 heartbeat_timeout: int = DEFAULT_HEARTBEAT_TIMEOUT_S):
+        from jax._src import distributed as dist
+
+        self._service = dist._jax.get_distributed_runtime_service(
+            f"0.0.0.0:{port}", num_processes,
+            heartbeat_timeout=heartbeat_timeout)
+        self.port = port
+        self.num_processes = num_processes
+
+    def shutdown(self) -> None:
+        try:
+            self._service.shutdown()
+        except Exception as e:  # pragma: no cover - teardown best-effort
+            hvd_logging.debug("coordination service shutdown: %s", e)
+
+
+def start_coordination_service(
+        port: int, num_processes: int,
+        heartbeat_timeout: int = DEFAULT_HEARTBEAT_TIMEOUT_S,
+) -> CoordinationService:
+    return CoordinationService(port, num_processes, heartbeat_timeout)
+
+
+_client_lock = threading.Lock()
+_live_client = None
+_client_generation = 0
+
+
+def connect_elastic_client(coordinator_addr: str, num_processes: int,
+                           process_id: int,
+                           heartbeat_timeout: int =
+                           DEFAULT_HEARTBEAT_TIMEOUT_S,
+                           init_timeout: int = 120) -> None:
+    """Worker-side: join the driver-hosted coordination service.
+
+    Installs the client into ``jax._src.distributed.global_state`` so
+    backend creation (gloo KV exchange, ``jax.process_index``) sees a
+    normal distributed world.
+    """
+    global _live_client, _client_generation
+    from jax._src import distributed as dist
+
+    with _client_lock:
+        _client_generation += 1
+        my_gen = _client_generation
+
+    def on_missed_heartbeat(status, coordinator_reported_failure):
+        # runs on a gRPC thread: never raise, never terminate.  Stale
+        # callbacks from a replaced generation's client are silenced.
+        with _client_lock:
+            stale = my_gen != _client_generation
+        if not stale:
+            hvd_logging.warning(
+                "elastic: coordination service reports failure "
+                "(coordinator_reported=%s): %s — a peer likely died; the "
+                "next collective will raise and trigger recovery",
+                coordinator_reported_failure, status)
+
+    client = dist._jax.get_distributed_runtime_client(
+        coordinator_addr, process_id,
+        init_timeout=init_timeout,
+        heartbeat_timeout=heartbeat_timeout,
+        shutdown_timeout=5,
+        use_compression=True,
+        recoverable=True,
+        missed_heartbeat_callback=on_missed_heartbeat,
+        shutdown_on_destruction=False)
+    client.connect()
+
+    state = dist.global_state
+    state.client = client
+    state.process_id = process_id
+    state.num_processes = num_processes
+    state.coordinator_address = coordinator_addr
+    with _client_lock:
+        _live_client = client
+    hvd_logging.info(
+        "elastic: connected to driver-hosted coordination service %s as "
+        "process %d of %d", coordinator_addr, process_id, num_processes)
+
+
+def disconnect_elastic_client() -> None:
+    """Detach from the current generation's service.
+
+    ``client.shutdown()`` must run (a live client whose service died
+    later throws ``std::bad_cast`` from its poll thread → process
+    terminate), but it must not block the reset: the client is created
+    with ``shutdown_timeout=5`` and ``recoverable=True`` so the shutdown
+    barrier does not wait on dead peers; failures are swallowed."""
+    global _live_client
+    from jax._src import distributed as dist
+
+    with _client_lock:
+        client, _live_client = _live_client, None
+        # advance the generation so late heartbeat callbacks from the old
+        # client recognize themselves as stale
+        global _client_generation
+        _client_generation += 1
+    state = dist.global_state
+    state.client = None
+    state.process_id = 0
+    state.num_processes = 1
+    state.coordinator_address = None
+    state.service = None
+    if client is not None:
+        try:
+            client.shutdown()
+        except Exception as e:
+            hvd_logging.debug("elastic: client shutdown: %s", e)
+
+
+def elastic_client_active() -> bool:
+    with _client_lock:
+        return _live_client is not None

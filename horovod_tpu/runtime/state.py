@@ -78,9 +78,22 @@ class GlobalState:
         # Multi-process bootstrap: the coordination-service analogue of the
         # reference's gloo rendezvous (gloo_context.cc:71-91).  The launcher
         # sets HOROVOD_COORDINATOR_ADDR + HOROVOD_RANK/SIZE; jax.distributed
-        # then wires all processes into one SPMD world.
+        # then wires all processes into one SPMD world.  Elastic runs use
+        # the driver-hosted service + survivable client instead (see
+        # runtime/distributed.py: worker death must surface as a catchable
+        # error, not the stock client's process termination).
         if cfg.coordinator_addr and cfg.size and cfg.size > 1:
-            if not getattr(jax.distributed, "is_initialized", lambda: False)():
+            if cfg.elastic_enabled:
+                from horovod_tpu.runtime import distributed as hvd_dist
+
+                if not hvd_dist.elastic_client_active():
+                    hvd_dist.connect_elastic_client(
+                        cfg.coordinator_addr, cfg.size, cfg.rank,
+                        heartbeat_timeout=int(os.environ.get(
+                            "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT",
+                            hvd_dist.DEFAULT_HEARTBEAT_TIMEOUT_S)))
+            elif not getattr(jax.distributed, "is_initialized",
+                             lambda: False)():
                 jax.distributed.initialize(
                     coordinator_address=cfg.coordinator_addr,
                     num_processes=cfg.size,
