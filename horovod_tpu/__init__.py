@@ -211,6 +211,28 @@ def mpi_threads_supported() -> bool:
     return False
 
 
+def current_operations() -> str:
+    """Name of the eager data plane in use ("XLA" or "HOST"), selected by
+    ``HOROVOD_TPU_OPERATIONS`` / ``--tpu-operations`` — the introspection
+    probe for the op-manager priority chain (reference
+    ``HOROVOD_CPU_OPERATIONS`` + ``horovod_*_built`` probes,
+    ``operations.cc:784``)."""
+    from horovod_tpu.ops import op_manager
+
+    return op_manager.current_operations()
+
+
+def cache_stats() -> dict:
+    """Signature-cache hit/miss counters of the negotiation layer
+    (reference response-cache observability, ``response_cache.{h,cc}``).
+    Returns ``{"hits": int, "misses": int}``."""
+    from horovod_tpu.runtime import state as _state
+
+    if not _state.is_initialized():
+        return {"hits": 0, "misses": 0}
+    return dict(_state.global_state().cache_stats)
+
+
 # ---------------------------------------------------------------------------
 # higher-level API re-exports (populated by submodule imports)
 # ---------------------------------------------------------------------------
@@ -240,7 +262,8 @@ __all__ = [
     # probes
     "xla_built", "tpu_available", "native_built", "mpi_built", "mpi_enabled", "gloo_built",
     "gloo_enabled", "nccl_built", "ddl_built", "ccl_built", "cuda_built",
-    "rocm_built", "mpi_threads_supported",
+    "rocm_built", "mpi_threads_supported", "current_operations",
+    "cache_stats",
     # collectives
     "allreduce", "allreduce_async", "allgather", "alltoall", "barrier",
     "broadcast", "join", "poll", "synchronize",

@@ -41,16 +41,17 @@ from horovod_tpu.runtime.topology import AXIS_DCN, AXIS_ICI, GLOBAL_AXES
 AxisSpec = Union[str, Sequence[str]]
 
 
-def _combine(a: jax.Array, b: jax.Array) -> jax.Array:
+def _combine(a: jax.Array, b: jax.Array, xp=jnp) -> jax.Array:
     """One pairwise Adasum combine (reference ``adasum.h`` coefficient
-    computation inside ``FusedAllreduce``)."""
-    af = a.astype(jnp.float32)
-    bf = b.astype(jnp.float32)
-    dot = jnp.vdot(af, bf)
-    anormsq = jnp.vdot(af, af)
-    bnormsq = jnp.vdot(bf, bf)
-    acoeff = jnp.where(anormsq >= 1e-30, 1.0 - dot / (2.0 * anormsq + 1e-30), 1.0)
-    bcoeff = jnp.where(bnormsq >= 1e-30, 1.0 - dot / (2.0 * bnormsq + 1e-30), 1.0)
+    computation inside ``FusedAllreduce``).  ``xp``-generic (jnp or
+    numpy) so the eager HOST data plane shares these exact numerics."""
+    af = a.astype(xp.float32)
+    bf = b.astype(xp.float32)
+    dot = xp.vdot(af, bf)
+    anormsq = xp.vdot(af, af)
+    bnormsq = xp.vdot(bf, bf)
+    acoeff = xp.where(anormsq >= 1e-30, 1.0 - dot / (2.0 * anormsq + 1e-30), 1.0)
+    bcoeff = xp.where(bnormsq >= 1e-30, 1.0 - dot / (2.0 * bnormsq + 1e-30), 1.0)
     return (acoeff * af + bcoeff * bf).astype(a.dtype)
 
 

@@ -99,6 +99,11 @@ def _reset_mesh_cache() -> None:
     _desc_cache.clear()
     _reducer_cache.clear()
     _motion_cache.clear()
+    from horovod_tpu.ops import op_manager
+
+    # HOST-plane KV keys carry a per-call counter that must restart in
+    # lock-step with the new world (a fresh process starts at zero)
+    op_manager.reset_host_plane()
 
 
 _validated_signatures: set = set()
@@ -257,6 +262,14 @@ def _negotiate(desc: Optional[dict], join_cycle: int = -1) -> _Negotiation:
     st = state.global_state() if state.is_initialized() else None
     if st:
         st.cache_stats["hits" if seen else "misses"] += 1
+        # negotiation-phase observability: the reference timeline records
+        # NEGOTIATE_* phases per tensor (controller.cc:845-857); here one
+        # instant per cycle carrying the cache outcome and join count
+        if st.timeline is not None:
+            st.timeline.instant(
+                tl.NEGOTIATE, {"kind": desc.get("kind"),
+                               "cache": "hit" if seen else "miss",
+                               "cycle": _cycle, "joined": len(joined)})
 
     if joined:
         kind = desc.get("kind")
@@ -321,10 +334,12 @@ def _reduce_global(garr, op: ReduceOp, prescale, postscale, nproc: int,
     return fn(garr)
 
 
-def _adasum_tree(rows: list):
+def _adasum_tree(rows: list, xp=jnp):
+    """Pairwise Adasum reduction tree; one combine formula for both data
+    planes (``adasum_mod._combine`` is xp-generic)."""
     vals = list(rows)
     while len(vals) > 1:
-        nxt = [adasum_mod._combine(vals[i], vals[i + 1])
+        nxt = [adasum_mod._combine(vals[i], vals[i + 1], xp=xp)
                for i in range(0, len(vals) - 1, 2)]
         if len(vals) % 2:
             nxt.append(vals[-1])
@@ -332,13 +347,18 @@ def _adasum_tree(rows: list):
     return vals[0]
 
 
-def _reduce_impl(garr, *, op: ReduceOp, prescale, postscale, nproc: int,
-                 segments: tuple = ()):
+def _reduce_stacked(x, *, op: ReduceOp, prescale, postscale, nproc: int,
+                    segments: tuple = (), xp=jnp):
+    """Reduce a stacked ``(nproc, n)`` array of per-process rows — the
+    single source of truth for op/scale numerics, shared by the XLA
+    plane (``xp=jnp``, under jit) and the HOST plane (``xp=np``) so the
+    two planes cannot drift."""
     # 0.0 is a legal scale factor (reference accepts arbitrary doubles), so
     # test against None, not truthiness
     scaled = prescale is not None or postscale is not None
-    x = garr.astype(jnp.float32) if garr.dtype in (jnp.float16, jnp.bfloat16) \
-        and scaled else garr
+    dtype = x.dtype
+    if scaled and dtype.name in ("float16", "bfloat16"):
+        x = x.astype(xp.float32)
     if prescale is not None:
         x = x * prescale
     if op == ReduceOp.ADASUM:
@@ -346,26 +366,33 @@ def _reduce_impl(garr, *, op: ReduceOp, prescale, postscale, nproc: int,
             outs, off = [], 0
             for seg in segments:
                 rows = [x[i, off:off + seg] for i in range(nproc)]
-                outs.append(_adasum_tree(rows))
+                outs.append(_adasum_tree(rows, xp=xp))
                 off += seg
-            y = jnp.concatenate(outs) if len(outs) > 1 else outs[0]
+            y = xp.concatenate(outs) if len(outs) > 1 else outs[0]
         else:
-            y = _adasum_tree([x[i] for i in range(nproc)])
+            y = _adasum_tree([x[i] for i in range(nproc)], xp=xp)
     elif op == ReduceOp.AVERAGE:
-        y = jnp.mean(x, axis=0)
+        y = xp.mean(x, axis=0)
     elif op == ReduceOp.SUM:
-        y = jnp.sum(x, axis=0)
+        y = xp.sum(x, axis=0)
     elif op == ReduceOp.MIN:
-        y = jnp.min(x, axis=0)
+        y = xp.min(x, axis=0)
     elif op == ReduceOp.MAX:
-        y = jnp.max(x, axis=0)
+        y = xp.max(x, axis=0)
     elif op == ReduceOp.PRODUCT:
-        y = jnp.prod(x, axis=0)
+        y = xp.prod(x, axis=0)
     else:
         raise ValueError(f"unsupported op {op}")
     if postscale is not None:
         y = y * postscale
-    return y.astype(garr.dtype)
+    return y.astype(dtype)
+
+
+def _reduce_impl(garr, *, op: ReduceOp, prescale, postscale, nproc: int,
+                 segments: tuple = ()):
+    return _reduce_stacked(garr, op=op, prescale=prescale,
+                           postscale=postscale, nproc=nproc,
+                           segments=segments, xp=jnp)
 
 
 class Handle:
@@ -483,11 +510,15 @@ def _dispatch_group(entries) -> None:
             # Always reduce the flattened concatenation — a single entry
             # too — so the compiled program depends only on (n, dtype, op,
             # scales, segments) and joined ranks can replay it exactly.
-            flat = jnp.concatenate([jnp.ravel(e.tensor) for e in entries]) \
+            from horovod_tpu.ops import op_manager
+
+            flat = jnp.concatenate(
+                [jnp.ravel(e.tensor) for e in entries]) \
                 if len(entries) > 1 else jnp.ravel(e0.tensor)
-            garr = _lift(flat)
-            red = _reduce_global(garr, e0.op, e0.prescale, e0.postscale,
-                                 nproc, segments)
+            red = op_manager.active_op().reduce_rows(
+                flat, e0.op, e0.prescale, e0.postscale, segments,
+                nproc, jax.process_index())
+            red = jnp.asarray(red)
             off = 0
             for e in entries:
                 n = e.tensor.size
@@ -641,12 +672,15 @@ def allgather_with_sizes(tensor, name: Optional[str] = None):
             sizes = _allgather_host_metadata(
                 np.asarray([tensor.shape[0]], np.int64)).reshape(nproc)
             max_rows = int(sizes.max())
+            from horovod_tpu.ops import op_manager
+
             pad = jnp.zeros((max_rows,) + tensor.shape[1:], tensor.dtype)
             pad = pad.at[:tensor.shape[0]].set(tensor)
-            garr = _lift(pad)   # (nproc, max_rows, ...)
-            rep = _allgather_rows(garr)
-            parts = [rep[p, :int(sizes[p])] for p in range(nproc)]
-            out = jnp.concatenate(parts, axis=0)
+            rows = op_manager.active_op().allgather_padded(
+                pad, nproc, jax.process_index())
+            out = jnp.concatenate(
+                [jnp.asarray(rows[p])[:int(sizes[p])]
+                 for p in range(nproc)], axis=0)
             handle._fulfill(out)
     except Exception as err:
         handle._fail(HorovodInternalError(str(err)))
@@ -671,10 +705,11 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
                 "sig": f"{name}:{tensor.dtype}:{tuple(tensor.shape)}:"
                        f"{root_rank}",
             })
-            garr = _lift(tensor)
-            out = jax.jit(lambda g: g[root_rank],
-                          out_shardings=_replicated(mesh))(garr)
-            handle._fulfill(out)
+            from horovod_tpu.ops import op_manager
+
+            out = op_manager.active_op().bcast(
+                tensor, root_rank, nproc, jax.process_index())
+            handle._fulfill(jnp.asarray(out))
     except Exception as err:
         handle._fail(HorovodInternalError(str(err)))
     return synchronize(handle)
@@ -710,6 +745,9 @@ def alltoall(tensor, splits=None, name: Optional[str] = None):
             all_splits = _allgather_host_metadata(splits)  # (nproc, nproc)
             all_splits = all_splits.reshape(nproc, nproc)
             max_rows = int(all_splits.max())
+            me = jax.process_index()
+            from horovod_tpu.ops import op_manager
+
             # slot-pack: slot d holds rows destined to process d
             slots = jnp.zeros((nproc, max_rows) + tensor.shape[1:],
                               tensor.dtype)
@@ -719,16 +757,10 @@ def alltoall(tensor, splits=None, name: Optional[str] = None):
                 if cnt:
                     slots = slots.at[d, :cnt].set(tensor[off:off + cnt])
                 off += cnt
-            garr = _lift(slots)  # (nproc_sender, nproc_dest, max_rows, ...)
-            routed = _alltoall_rows(garr)   # sharded by destination
-            me = jax.process_index()
-            # my column lives in my local shard: (nproc_sender, 1, ...) —
-            # already a single-device jax.Array; slice and concatenate on
-            # device, no host round-trip on the data path
-            local = routed.addressable_shards[0].data
-            parts = [local[src, 0, :int(all_splits[src, me])]
-                     for src in range(nproc)]
-            out = jnp.concatenate(parts, axis=0)
+            cols = op_manager.active_op().alltoall_slots(slots, nproc, me)
+            out = jnp.concatenate(
+                [jnp.asarray(cols[src])[:int(all_splits[src, me])]
+                 for src in range(nproc)], axis=0)
             handle._fulfill(out)
     except Exception as err:
         handle._fail(HorovodInternalError(str(err)))
@@ -748,6 +780,20 @@ def _allgather_host_metadata(arr: np.ndarray) -> np.ndarray:
     nproc = mesh.devices.size
     if nproc == 1:
         return arr[None]
+    from horovod_tpu.ops import op_manager
+
+    return op_manager.active_op().metadata_allgather(
+        arr, nproc, jax.process_index())
+
+
+def _xla_metadata_allgather(arr: np.ndarray) -> np.ndarray:
+    """XLA-plane implementation of the metadata allgather (called via
+    ``op_manager.XlaOps``): replicated identity jit over the lifted
+    array.  int64 payloads are exchanged as int32 word pairs — without
+    ``jax_enable_x64`` jnp silently truncates int64 to int32, which
+    would corrupt any value ≥ 2^31 (e.g. microsecond timestamps)."""
+    mesh = process_mesh()
+    nproc = mesh.devices.size
     is64 = arr.dtype == np.int64
     wire = arr.view(np.int32) if is64 else arr
     garr = _lift(jnp.asarray(wire))
@@ -814,10 +860,12 @@ def join() -> int:
             op = ReduceOp[d["op"]]
             if op not in _JOIN_ZERO_OPS:
                 continue  # active ranks raised; no collective runs
+            from horovod_tpu.ops import op_manager
+
             zeros = jnp.zeros((d["n"],), jnp.dtype(d["dtype"]))
-            garr = _lift(zeros)
-            _reduce_global(garr, op, d["pre"], d["post"], nproc,
-                           tuple(d["segments"]))
+            op_manager.active_op().reduce_rows(
+                zeros, op, d["pre"], d["post"], tuple(d["segments"]),
+                nproc, jax.process_index())
         elif d.get("kind") == "hostsync":
             # elastic host-update sync: participate in the fixed 3-word
             # exchange with zeros ("nothing to report")

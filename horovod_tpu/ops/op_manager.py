@@ -1,0 +1,290 @@
+"""Operation manager: the data-plane priority chain, TPU edition.
+
+The reference dispatches every collective through an ``OperationManager``
+holding per-type op lists tried in priority order — first ``Enabled()``
+wins (``ops/operation_manager.cc:40-98``), with the order set by build
+flags and env knobs (``HOROVOD_CPU_OPERATIONS=MPI|GLOO|CCL``,
+``HOROVOD_GPU_ALLREDUCE=NCCL|MPI|DDL``, chain built in
+``CreateOperationManager`` ``operations.cc:142-249``).
+
+The TPU runtime has two genuinely distinct eager data planes, each a
+plane object implementing the same five primitives so dispatch in
+``ops.eager`` is a method call, not a special case:
+
+* :class:`XlaOps` (default): tensors are lifted onto the proc mesh and
+  the collective compiles to XLA collectives over ICI/DCN — the NCCL
+  analogue, and the only plane the in-jit training path ever uses.
+* :class:`HostOps`: tensors move as raw bytes through the coordination
+  service's key-value store and reduce in numpy on the host — the
+  Gloo-on-CPU analogue.  No device compile; useful for debugging
+  transport vs. compiler issues and for tiny control payloads.
+
+``HOROVOD_TPU_OPERATIONS=XLA|HOST`` (flag ``--tpu-operations``) orders
+the chain, mirroring the reference knob's semantics: the requested plane
+goes first, the other remains as fallback; per-call dispatch takes the
+first enabled plane.
+
+Plane primitive interface (all collective — every process must call in
+the same order; ``rank``/``nproc`` are process-level):
+
+* ``metadata_allgather(arr, nproc, rank) -> (nproc, *arr.shape) ndarray``
+* ``reduce_rows(flat, op, pre, post, segments, nproc, rank) -> flat``
+* ``allgather_padded(padded, nproc, rank) -> list of per-process rows``
+* ``bcast(tensor, root, nproc, rank) -> tensor``
+* ``alltoall_slots(slots, nproc, rank) -> list indexed by source``
+  (``slots[d]`` = rows this process sends to process ``d``; returns the
+  rows each source sent to *this* process)
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from horovod_tpu.utils import logging as hvd_logging
+
+
+class XlaOps:
+    """XLA data plane — delegates to the jitted/shard_map implementations
+    in ``ops.eager`` (lazy import; eager imports this module back)."""
+
+    name = "XLA"
+
+    def enabled(self) -> bool:
+        return True
+
+    def metadata_allgather(self, arr: np.ndarray, nproc: int,
+                           rank: int) -> np.ndarray:
+        from horovod_tpu.ops import eager
+
+        return eager._xla_metadata_allgather(arr)
+
+    def reduce_rows(self, flat, op, prescale, postscale, segments,
+                    nproc: int, rank: int):
+        import jax.numpy as jnp
+
+        from horovod_tpu.ops import eager
+
+        garr = eager._lift(jnp.asarray(flat))
+        return eager._reduce_global(garr, op, prescale, postscale, nproc,
+                                    tuple(segments))
+
+    def allgather_padded(self, padded, nproc: int, rank: int) -> list:
+        from horovod_tpu.ops import eager
+
+        rep = eager._allgather_rows(eager._lift(padded))
+        return [rep[p] for p in range(nproc)]
+
+    def bcast(self, tensor, root_rank: int, nproc: int, rank: int):
+        import jax
+
+        from horovod_tpu.ops import eager
+
+        mesh = eager.process_mesh()
+        garr = eager._lift(tensor)
+        return jax.jit(lambda g: g[root_rank],
+                       out_shardings=eager._replicated(mesh))(garr)
+
+    def alltoall_slots(self, slots, nproc: int, rank: int) -> list:
+        from horovod_tpu.ops import eager
+
+        routed = eager._alltoall_rows(eager._lift(slots))
+        # my column lives in my local shard: (nproc_sender, 1, ...) —
+        # already a single-device jax.Array; slice on device
+        local = routed.addressable_shards[0].data
+        return [local[src, 0] for src in range(nproc)]
+
+
+class HostOps:
+    """Host data plane over the coordination-service KV store.
+
+    Keys carry a monotonically increasing call counter that is identical
+    on every process (calls are collective and SPMD-ordered; the counter
+    resets with the world, see :func:`reset_host_plane`).  Each call
+    records the keys it wrote; keys from call N-2 are deleted at call N:
+    a process entering call N has completed call N-1, which implies
+    every process wrote its N-1 keys, which implies every process
+    finished reading call N-2 — the deletion can never race a reader.
+    """
+
+    name = "HOST"
+    TIMEOUT_MS = 120_000
+
+    def __init__(self):
+        self._counter = 0
+        self._written: "collections.deque" = collections.deque()
+        self._lock = threading.Lock()
+
+    def reset(self) -> None:
+        """Forget counter + pending GC — the elastic world reset.  Every
+        surviving process resets in lock-step (``_reset_mesh_cache``) and
+        new processes start at zero, so counters stay aligned; the new
+        generation also gets a fresh coordination service, so stale keys
+        from the old world are unreachable anyway."""
+        with self._lock:
+            self._counter = 0
+            self._written.clear()
+
+    def _client(self):
+        from jax._src import distributed as dist
+
+        return dist.global_state.client
+
+    def enabled(self) -> bool:
+        import jax
+
+        if jax.process_count() == 1:
+            return True
+        return self._client() is not None
+
+    # -- keyed transport core ----------------------------------------------
+
+    def _next_call(self) -> int:
+        with self._lock:
+            self._counter += 1
+            return self._counter
+
+    def _gc_and_record(self, client, call: int, keys: list) -> None:
+        with self._lock:
+            self._written.append((call, keys))
+            stale = []
+            while self._written and self._written[0][0] <= call - 2:
+                stale.extend(self._written.popleft()[1])
+        for k in stale:
+            try:
+                client.key_value_delete(k)
+            except Exception:  # pragma: no cover - best-effort GC
+                pass
+
+    def _exchange(self, sends: dict, recv_keys: list) -> List[bytes]:
+        """Write ``sends`` {key: bytes}, blocking-read ``recv_keys``."""
+        client = self._client()
+        call = self._next_call()
+        written = []
+        for k, v in sends.items():
+            client.key_value_set_bytes(f"hvdhost/{call}/{k}", v)
+            written.append(f"hvdhost/{call}/{k}")
+        out = [client.blocking_key_value_get_bytes(
+            f"hvdhost/{call}/{k}", self.TIMEOUT_MS) for k in recv_keys]
+        self._gc_and_record(client, call, written)
+        return out
+
+    @staticmethod
+    def _decode(raw: bytes, like: np.ndarray) -> np.ndarray:
+        return np.frombuffer(raw, like.dtype).reshape(like.shape)
+
+    # -- plane primitives ---------------------------------------------------
+
+    def metadata_allgather(self, arr: np.ndarray, nproc: int,
+                           rank: int) -> np.ndarray:
+        arr = np.ascontiguousarray(arr)
+        if nproc == 1:
+            return arr[None]
+        rows = self._exchange({str(rank): arr.tobytes()},
+                              [str(p) for p in range(nproc)])
+        return np.stack([self._decode(r, arr) for r in rows])
+
+    def reduce_rows(self, flat, op, prescale, postscale, segments,
+                    nproc: int, rank: int):
+        from horovod_tpu.ops import eager
+
+        flat = np.ascontiguousarray(np.asarray(flat))
+        rows = self.metadata_allgather(flat, nproc, rank)
+        return eager._reduce_stacked(
+            rows, op=op, prescale=prescale, postscale=postscale,
+            nproc=nproc, segments=tuple(segments), xp=np)
+
+    def allgather_padded(self, padded, nproc: int, rank: int) -> list:
+        padded = np.ascontiguousarray(np.asarray(padded))
+        if nproc == 1:
+            return [padded]
+        rows = self._exchange({str(rank): padded.tobytes()},
+                              [str(p) for p in range(nproc)])
+        return [self._decode(r, padded) for r in rows]
+
+    def bcast(self, tensor, root_rank: int, nproc: int, rank: int):
+        tensor = np.ascontiguousarray(np.asarray(tensor))
+        if nproc == 1:
+            return tensor
+        # O(data): only the root uploads a payload; everyone reads the
+        # root's key.  Non-roots publish an empty marker so the call/GC
+        # bookkeeping stays uniform.
+        sends = {str(rank): tensor.tobytes() if rank == root_rank else b""}
+        (raw,) = self._exchange(sends, [str(root_rank)])
+        return self._decode(raw, tensor)
+
+    def alltoall_slots(self, slots, nproc: int, rank: int) -> list:
+        slots = np.ascontiguousarray(np.asarray(slots))
+        if nproc == 1:
+            return [slots[0]]
+        # O(data) per process: one key per destination, read own column —
+        # not an allgather of the whole (nproc, max_rows) slot matrix.
+        sends = {f"{rank}.{d}": np.ascontiguousarray(slots[d]).tobytes()
+                 for d in range(nproc)}
+        rows = self._exchange(sends,
+                              [f"{src}.{rank}" for src in range(nproc)])
+        return [self._decode(r, slots[0]) for r in rows]
+
+
+_XLA = XlaOps()
+_HOST = HostOps()
+_chain_cache: Optional[tuple] = None
+
+
+def _requested() -> str:
+    from horovod_tpu.runtime import state
+
+    if state.is_initialized():
+        return state.global_state().config.tpu_operations
+    from horovod_tpu.runtime.config import Config
+
+    return Config.from_env().tpu_operations
+
+
+def chain() -> List:
+    """Priority-ordered op list (reference ``CreateOperationManager``)."""
+    global _chain_cache
+    req = _requested()
+    if _chain_cache is not None and _chain_cache[0] == req:
+        return list(_chain_cache[1])
+    if req == "HOST":
+        ops = [_HOST, _XLA]
+    else:
+        if req not in ("XLA", ""):
+            hvd_logging.warning(
+                "HOROVOD_TPU_OPERATIONS=%s is not a known data plane "
+                "(XLA, HOST); defaulting to XLA", req)
+        ops = [_XLA, _HOST]
+    _chain_cache = (req, tuple(ops))
+    return ops
+
+
+def active_op():
+    """First enabled op in the chain — the reference's
+    ``ExecuteOperation`` dispatch rule (``operation_manager.cc:100``)."""
+    for op in chain():
+        if op.enabled():
+            return op
+    return _XLA   # unreachable: XLA is always enabled
+
+
+def current_operations() -> str:
+    """Name of the data plane eager collectives will use (probe API —
+    the analogue of ``horovod_nccl_built()``-style introspection,
+    ``operations.cc:784``)."""
+    return active_op().name
+
+
+def reset_host_plane() -> None:
+    """Reset HOST-plane counters on an elastic world change (called from
+    ``eager._reset_mesh_cache``)."""
+    _HOST.reset()
+
+
+def _reset_for_tests() -> None:
+    global _chain_cache
+    _chain_cache = None
+    _HOST.reset()

@@ -202,6 +202,8 @@ def _check_build() -> int:
     print(f"    [{'X' if hvd.mpi_built() else ' '}] MPI")
     print(f"    [{'X' if hvd.gloo_built() else ' '}] Gloo")
     print(f"    [{'X' if hvd.nccl_built() else ' '}] NCCL")
+    print(f"Eager data plane (HOROVOD_TPU_OPERATIONS): "
+          f"{hvd.current_operations()}")
     return 0
 
 

@@ -27,6 +27,7 @@ from typing import Optional
 
 # Activity names mirroring common.h:32-62
 QUEUE = "QUEUE"
+NEGOTIATE = "NEGOTIATE"            # NEGOTIATE_ALLREDUCE/... analogue
 FUSE = "FUSE"                      # MEMCPY_IN_FUSION_BUFFER analogue
 COLLECTIVE = "COLLECTIVE"          # NCCL_ALLREDUCE etc. analogue
 XLA_ALLREDUCE = "XLA_ALLREDUCE"

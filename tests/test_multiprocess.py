@@ -189,6 +189,47 @@ class TestTwoProcessWorld:
         assert out.returncode == 0, out.stderr[-3000:]
         assert out.stdout.count("CAUGHT_OK") == 2, out.stdout
 
+    def test_host_data_plane(self, tmp_path):
+        """HOROVOD_TPU_OPERATIONS=HOST routes every eager collective over
+        the coordination-service KV store (the Gloo-CPU analogue) with
+        identical numerics — the op-manager knob made real (reference
+        ``HOROVOD_CPU_OPERATIONS``, ``operation_manager.cc:40-100``)."""
+        out = launch("""
+            import os
+            os.environ["HOROVOD_TPU_OPERATIONS"] = "HOST"
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import jax.numpy as jnp
+            import numpy as np
+            import horovod_tpu as hvd
+
+            hvd.init()
+            assert hvd.current_operations() == "HOST", hvd.current_operations()
+            r = hvd.process_rank()
+
+            s = hvd.allreduce(jnp.full((4,), float(r + 1)), op=hvd.Sum,
+                              name="h.ar")
+            np.testing.assert_allclose(np.asarray(s), 3.0)
+            a = hvd.allreduce(jnp.full((3,), float(r)), op=hvd.Adasum,
+                              name="h.ad")
+            assert np.asarray(a).shape == (3,)
+            b = hvd.broadcast(jnp.full((3,), float(r * 7)), root_rank=1,
+                              name="h.bc")
+            np.testing.assert_allclose(np.asarray(b), 7.0)
+            g = hvd.allgather(jnp.full((r + 1, 2), float(r)), name="h.ag")
+            assert g.shape == (3, 2)
+            t = hvd.alltoall(jnp.arange(4.0) + 10 * r, splits=[2, 2],
+                             name="h.a2a")
+            expected = [0., 1., 10., 11.] if r == 0 else [2., 3., 12., 13.]
+            np.testing.assert_allclose(np.asarray(t), expected)
+            hvd.barrier()
+            stats = hvd.cache_stats()
+            assert stats["misses"] > 0
+            print("WORKER_OK", r)
+        """, tmp_path)
+        assert out.returncode == 0, out.stderr[-3000:]
+        assert out.stdout.count("WORKER_OK") == 2
+
     def test_worker_failure_fails_job(self, tmp_path):
         out = launch("""
             import os, sys

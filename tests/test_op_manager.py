@@ -1,0 +1,100 @@
+"""Op-manager priority chain + HOST data plane.
+
+Reference: ``ops/operation_manager.cc:40-100`` (first-Enabled-wins
+priority chain), ``HOROVOD_CPU_OPERATIONS`` knob selecting the CPU data
+plane (MPI/GLOO/CCL), and the ``horovod_*_built`` probe surface.
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.ops import op_manager
+from horovod_tpu.ops.collectives import ReduceOp
+from horovod_tpu.ops.eager import _reduce_stacked
+
+
+@pytest.fixture(autouse=True)
+def _reset(monkeypatch):
+    op_manager._reset_for_tests()
+    yield
+    op_manager._reset_for_tests()
+
+
+class TestChain:
+    def test_default_is_xla(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_TPU_OPERATIONS", raising=False)
+        assert [o.name for o in op_manager.chain()] == ["XLA", "HOST"]
+        assert op_manager.current_operations() == "XLA"
+
+    def test_host_requested(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_TPU_OPERATIONS", "host")
+        assert [o.name for o in op_manager.chain()] == ["HOST", "XLA"]
+        # single process: the HOST plane is trivially enabled
+        assert op_manager.current_operations() == "HOST"
+
+    def test_unknown_falls_back_to_xla(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_TPU_OPERATIONS", "NCCL")
+        assert op_manager.current_operations() == "XLA"
+
+    def test_probe_exported(self):
+        assert hvd.current_operations() in ("XLA", "HOST")
+
+
+def _host_reduce(rows, op, prescale=None, postscale=None, segments=()):
+    """HOST-plane reduction as ``HostOps.reduce_rows`` performs it: the
+    shared ``_reduce_stacked`` numerics with ``xp=np``."""
+    return _reduce_stacked(np.stack([np.asarray(r) for r in rows]),
+                           op=op, prescale=prescale, postscale=postscale,
+                           nproc=len(rows), segments=segments, xp=np)
+
+
+class TestHostReduce:
+    def test_ops(self):
+        rows = [np.asarray([1.0, 2.0]), np.asarray([3.0, 4.0])]
+        np.testing.assert_allclose(
+            _host_reduce(rows, ReduceOp.SUM), [4.0, 6.0])
+        np.testing.assert_allclose(
+            _host_reduce(rows, ReduceOp.AVERAGE), [2.0, 3.0])
+        np.testing.assert_allclose(
+            _host_reduce(rows, ReduceOp.MIN), [1.0, 2.0])
+        np.testing.assert_allclose(
+            _host_reduce(rows, ReduceOp.MAX), [3.0, 4.0])
+        np.testing.assert_allclose(
+            _host_reduce(rows, ReduceOp.PRODUCT), [3.0, 8.0])
+
+    def test_scales(self):
+        rows = [np.asarray([2.0]), np.asarray([4.0])]
+        np.testing.assert_allclose(
+            _host_reduce(rows, ReduceOp.SUM, 0.5, 10.0), [30.0])
+
+    def test_adasum_matches_xla_tree(self):
+        """Host and XLA planes share one Adasum formula — the numpy tree
+        must match the jnp tree exactly (same check style as
+        tests/test_adasum.py vs NumPy)."""
+        import jax.numpy as jnp
+
+        from horovod_tpu.ops.eager import _adasum_tree
+
+        rng = np.random.RandomState(0)
+        rows = [rng.randn(16).astype(np.float32) for _ in range(4)]
+        want = np.asarray(_adasum_tree([jnp.asarray(r) for r in rows],
+                                       xp=jnp))
+        got = _adasum_tree(rows, xp=np)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_adasum_segments(self):
+        from horovod_tpu.ops.eager import _adasum_tree
+
+        rng = np.random.RandomState(1)
+        rows = [rng.randn(10).astype(np.float32) for _ in range(2)]
+        out = _host_reduce(rows, ReduceOp.ADASUM, segments=(4, 6))
+        np.testing.assert_allclose(
+            out[:4], _adasum_tree([r[:4] for r in rows], xp=np), rtol=1e-5)
+        np.testing.assert_allclose(
+            out[4:], _adasum_tree([r[4:] for r in rows], xp=np), rtol=1e-5)
+
+    def test_zero_rows_are_identity_for_sum(self):
+        rows = [np.zeros(3), np.asarray([1.0, 2.0, 3.0])]
+        np.testing.assert_allclose(
+            _host_reduce(rows, ReduceOp.SUM), [1.0, 2.0, 3.0])
