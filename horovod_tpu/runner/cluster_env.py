@@ -36,6 +36,32 @@ class LSFUtils:
     def get_num_processes() -> int:
         return sum(h.slots for h in LSFUtils.get_compute_hosts())
 
+    # Node-shape introspection for the jsrun ERF rankfile (reference
+    # queries CSM allocation + remote lscpu, ``lsf.py:42-103``; here the
+    # values come from the LSF/user env with local-machine fallbacks —
+    # no CSM daemon on TPU clusters).
+    @staticmethod
+    def get_num_cores() -> int:
+        v = os.environ.get("HOROVOD_LSF_CORES_PER_NODE")
+        if v:
+            return int(v)
+        return os.cpu_count() or 1
+
+    @staticmethod
+    def get_num_threads() -> int:
+        return int(os.environ.get("HOROVOD_LSF_THREADS_PER_CORE", "1"))
+
+    @staticmethod
+    def get_num_accelerators() -> int:
+        """Accelerators (TPU chips / GPUs) per node — bounds the slot
+        count a host may carry in the rankfile (reference
+        ``get_num_gpus``)."""
+        v = os.environ.get("HOROVOD_LSF_ACCELERATORS_PER_NODE")
+        if v:
+            return int(v)
+        hosts = LSFUtils.get_compute_hosts()
+        return max((h.slots for h in hosts), default=1)
+
 
 class TpuPodUtils:
     """TPU pod slice introspection from the runtime-provided env."""
@@ -54,6 +80,26 @@ class TpuPodUtils:
     def worker_id() -> Optional[int]:
         wid = os.environ.get("TPU_WORKER_ID")
         return int(wid) if wid is not None else None
+
+
+def jsm_identity() -> Optional[dict]:
+    """Per-process identity from the PMIx/JSM env that ``jsrun`` (and
+    OpenMPI's mpirun) set on each spawned rank — the worker-side half of
+    the jsrun launch path.  Returns ``{rank, size, local_rank,
+    local_size}`` or None outside such a launcher."""
+    for rank_var, size_var, lrank_var, lsize_var in (
+            ("PMIX_RANK", "PMIX_SIZE", "PMIX_LOCAL_RANK", "PMIX_LOCAL_SIZE"),
+            ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE",
+             "OMPI_COMM_WORLD_LOCAL_RANK", "OMPI_COMM_WORLD_LOCAL_SIZE"),
+    ):
+        if rank_var in os.environ and size_var in os.environ:
+            return {
+                "rank": int(os.environ[rank_var]),
+                "size": int(os.environ[size_var]),
+                "local_rank": int(os.environ.get(lrank_var, "0")),
+                "local_size": int(os.environ.get(lsize_var, "1")),
+            }
+    return None
 
 
 def detect_cluster_hosts() -> Optional[List[HostInfo]]:

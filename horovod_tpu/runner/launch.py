@@ -49,6 +49,9 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--hostfile", dest="hostfile",
                    help="file with one 'host slots=N' per line")
     p.add_argument("--ssh-port", type=int, default=None)
+    p.add_argument("--jsrun", action="store_true",
+                   help="launch through jsrun with an ERF rankfile "
+                        "(LSF clusters)")
     p.add_argument("--start-timeout", type=int, default=30)
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--output-filename", dest="output_filename",
@@ -123,17 +126,131 @@ def _coordinator_addr(hosts: List[HostInfo]) -> str:
     return f"{head}:{_free_port()}"
 
 
+def _discover_coordinator_addr(hosts: List[HostInfo], args) -> str:
+    """Multi-host coordinator addressing via the NIC ring probe: start a
+    probe task on every host (ssh for remote ones), compute the
+    interfaces every consecutive pair can route over, and address the
+    coordinator by rank-0's IP on a common interface (reference
+    ``get_common_interfaces`` + driver/task services,
+    ``driver_service.py:124-193``) — instead of hoping ``hosts[0]``'s
+    name resolves identically from every worker."""
+    import subprocess
+
+    from horovod_tpu.runner.driver_service import discover_common_interfaces
+    from horovod_tpu.runner.network import make_secret_key
+
+    hostnames = [h.hostname for h in hosts]
+    if all(_is_local(h) for h in hostnames):
+        return _coordinator_addr(hosts)
+    key = make_secret_key()
+    procs = []
+
+    def spawn(host: str, index: int, driver_addrs: str) -> None:
+        # the key rides the command line, not the env — ssh does not
+        # forward env vars (the reference ships settings incl. the key
+        # base64-encoded in the remote command, driver_service.py:49-84)
+        cmd = [sys.executable, "-m", "horovod_tpu.runner.probe_task",
+               driver_addrs, str(index), key]
+        slot = SlotInfo(hostname=host, rank=index, local_rank=0,
+                        cross_rank=0, size=len(hostnames), local_size=1,
+                        cross_size=len(hostnames))
+        full = build_worker_command(slot, cmd, args.ssh_port)
+        procs.append(subprocess.Popen(full,
+                                      stdout=subprocess.DEVNULL,
+                                      stderr=subprocess.DEVNULL))
+
+    try:
+        common, driver = discover_common_interfaces(hostnames, spawn, key)
+        try:
+            rank0 = driver.task_address(0)
+            iface = next(i for i in common if i in rank0)
+            ip = rank0[iface][0]
+        finally:
+            driver.shutdown()
+        if args.verbose:
+            print(f"[launcher] common interfaces: {common}; coordinator "
+                  f"on {ip}", file=sys.stderr)
+        return f"{ip}:{_free_port()}"
+    finally:
+        # reap without masking the primary error: stragglers get
+        # terminated, then killed — never re-raise from cleanup
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except Exception:
+                p.terminate()
+                try:
+                    p.wait(timeout=2)
+                except Exception:
+                    p.kill()
+
+
 def build_worker_command(slot: SlotInfo, command: List[str],
                          ssh_port: Optional[int] = None) -> List[str]:
     """Local slots exec directly; remote slots go through ssh (reference
-    ``gloo_run.py:113-180`` ssh/exec split)."""
+    ``gloo_run.py:113-180`` ssh/exec split).  Remote args are
+    ``shlex.quote``d — naive single-quoting corrupts any argument that
+    itself contains a quote."""
+    import shlex
+
     if _is_local(slot.hostname):
         return list(command)
     ssh = ["ssh", "-o", "StrictHostKeyChecking=no", slot.hostname]
     if ssh_port:
         ssh += ["-p", str(ssh_port)]
-    quoted = " ".join(f"'{c}'" for c in command)
-    return ssh + [quoted]
+    return ssh + [" ".join(shlex.quote(c) for c in command)]
+
+
+SSH_CHECK_TIMEOUT_S = 30
+
+
+def check_all_hosts_ssh_successful(hostnames: List[str],
+                                   ssh_port: Optional[int] = None,
+                                   runner=None) -> None:
+    """Verify every remote host is ssh-reachable before fan-out
+    (reference ``_check_all_hosts_ssh_successful``, ``launch.py:55-104``)
+    — one bad host should fail the launch immediately with a named
+    culprit, not hang N-1 healthy workers.  ``runner`` is injectable for
+    tests; defaults to running the composed ssh command."""
+    import shlex
+    import subprocess
+
+    def default_runner(cmd: List[str]) -> int:
+        try:
+            return subprocess.run(
+                cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                timeout=SSH_CHECK_TIMEOUT_S).returncode
+        except subprocess.TimeoutExpired:
+            return 255
+
+    run = runner or default_runner
+    remote = [h for h in hostnames if not _is_local(h)]
+    results: Dict[str, int] = {}
+    lock = threading.Lock()
+
+    def check(host: str) -> None:
+        cmd = ["ssh", "-o", "BatchMode=yes",
+               "-o", "StrictHostKeyChecking=no"]
+        if ssh_port:
+            cmd += ["-p", str(ssh_port)]
+        cmd += [host, shlex.quote("true")]
+        rc = run(cmd)
+        with lock:
+            results[host] = rc
+
+    threads = [threading.Thread(target=check, args=(h,), daemon=True)
+               for h in remote]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(SSH_CHECK_TIMEOUT_S + 5)
+    failed = sorted(h for h, rc in results.items() if rc != 0)
+    failed += sorted(h for h in remote if h not in results)
+    if failed:
+        raise RuntimeError(
+            "SSH was unable to connect to hosts: {}\n"
+            "Check that every host is reachable, accepts passwordless "
+            "ssh, and that --ssh-port matches.".format(", ".join(failed)))
 
 
 def build_worker_env(slot: SlotInfo, base_env: Dict[str, str],
@@ -146,10 +263,26 @@ def build_worker_env(slot: SlotInfo, base_env: Dict[str, str],
     return env
 
 
+def _run_jsrun(args, hosts: List[HostInfo]) -> int:
+    """LSF/jsrun launch: one jsrun command with an ERF rankfile places
+    every rank; workers read identity from the PMIx env (reference
+    ``run_controller`` jsrun branch, ``launch.py:632`` + ``js_run.py``)."""
+    from horovod_tpu.runner import js_run
+
+    env = config_parser.set_env_from_args(dict(os.environ), args)
+    env["HOROVOD_COORDINATOR_ADDR"] = _coordinator_addr(hosts)
+    env["HOROVOD_SIZE"] = str(args.np)
+    return js_run.js_run(args, hosts, env)
+
+
 def _run_static(args) -> int:
     hosts = _resolve_hosts(args)
+    if args.jsrun:
+        return _run_jsrun(args, hosts)
+    check_all_hosts_ssh_successful([h.hostname for h in hosts],
+                                   args.ssh_port)
     assignments = get_host_assignments(hosts, args.np, args.np)
-    coordinator = _coordinator_addr(hosts)
+    coordinator = _discover_coordinator_addr(hosts, args)
     base_env = config_parser.set_env_from_args(dict(os.environ), args)
 
     if args.verbose:

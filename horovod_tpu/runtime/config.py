@@ -128,11 +128,23 @@ class Config:
             v = os.environ.get(name)
             return int(v) if v not in (None, "") else None
 
+        # Identity fallback for jsrun/mpirun launches: when the launcher
+        # is JSM/PMIx (hvdrun --jsrun), ranks carry PMIX_*/OMPI_* vars
+        # instead of the HOROVOD_* env contract (reference: jsrun workers
+        # read identity through the MPI controller; js_run.py).
+        jsm = None
+        if opt_int("HOROVOD_RANK") is None:
+            from horovod_tpu.runner.cluster_env import jsm_identity
+
+            jsm = jsm_identity()
+
         return Config(
-            rank=opt_int("HOROVOD_RANK"),
-            size=opt_int("HOROVOD_SIZE"),
-            local_rank=opt_int("HOROVOD_LOCAL_RANK"),
-            local_size=opt_int("HOROVOD_LOCAL_SIZE"),
+            rank=opt_int("HOROVOD_RANK") if jsm is None else jsm["rank"],
+            size=opt_int("HOROVOD_SIZE") if jsm is None else jsm["size"],
+            local_rank=opt_int("HOROVOD_LOCAL_RANK")
+            if jsm is None else jsm["local_rank"],
+            local_size=opt_int("HOROVOD_LOCAL_SIZE")
+            if jsm is None else jsm["local_size"],
             cross_rank=opt_int("HOROVOD_CROSS_RANK"),
             cross_size=opt_int("HOROVOD_CROSS_SIZE"),
             coordinator_addr=os.environ.get("HOROVOD_COORDINATOR_ADDR"),

@@ -78,7 +78,42 @@ class TestLaunchCommand:
         assert cmd[0] == "ssh"
         assert "worker-7" in cmd
         assert "-p" in cmd and "2222" in cmd
-        assert "'python' 'train.py'" in cmd[-1]
+        assert cmd[-1] == "python train.py"
+
+    def test_remote_command_quotes_special_chars(self):
+        """shlex-quoted remote args: embedded quotes and spaces must
+        survive the ssh hop intact (reference uses shlex.quote in every
+        remote command composition; round-1 naive single-quoting
+        corrupted args containing quotes)."""
+        import shlex
+
+        slot = get_host_assignments([HostInfo("worker-7", 1)], 1)[0]
+        tricky = ["python", "-c", "print('hello world')", "--flag=a b"]
+        cmd = build_worker_command(slot, tricky)
+        assert shlex.split(cmd[-1]) == tricky
+
+    def test_ssh_reachability_check_names_bad_host(self):
+        """Pre-fan-out reachability check fails fast, naming the culprit
+        (reference _check_all_hosts_ssh_successful, launch.py:55-104)."""
+        from horovod_tpu.runner.launch import check_all_hosts_ssh_successful
+
+        calls = []
+
+        def fake_runner(cmd):
+            calls.append(cmd)
+            return 255 if "badhost" in cmd else 0
+
+        with pytest.raises(RuntimeError, match="badhost"):
+            check_all_hosts_ssh_successful(
+                ["localhost", "goodhost", "badhost"], runner=fake_runner)
+        # localhost is skipped; both remote hosts probed over BatchMode ssh
+        assert len(calls) == 2
+        assert all(c[0] == "ssh" and "BatchMode=yes" in c[2] for c in calls)
+
+    def test_ssh_reachability_all_good(self):
+        from horovod_tpu.runner.launch import check_all_hosts_ssh_successful
+
+        check_all_hosts_ssh_successful(["h1", "h2"], runner=lambda c: 0)
 
     def test_worker_env(self):
         slot = get_host_assignments([HostInfo("localhost", 2)], 2)[0]
@@ -142,6 +177,135 @@ class TestClusterEnv:
         monkeypatch.delenv("LSB_JOBID", raising=False)
         monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
         assert detect_cluster_hosts() is None
+
+    def test_jsm_identity_pmix(self, monkeypatch):
+        from horovod_tpu.runner.cluster_env import jsm_identity
+
+        for v in ("PMIX_RANK", "PMIX_SIZE", "OMPI_COMM_WORLD_RANK",
+                  "OMPI_COMM_WORLD_SIZE"):
+            monkeypatch.delenv(v, raising=False)
+        assert jsm_identity() is None
+        monkeypatch.setenv("PMIX_RANK", "3")
+        monkeypatch.setenv("PMIX_SIZE", "8")
+        monkeypatch.setenv("PMIX_LOCAL_RANK", "1")
+        monkeypatch.setenv("PMIX_LOCAL_SIZE", "4")
+        assert jsm_identity() == {"rank": 3, "size": 8,
+                                  "local_rank": 1, "local_size": 4}
+
+    def test_jsm_identity_feeds_config(self, monkeypatch):
+        from horovod_tpu.runtime.config import Config
+
+        monkeypatch.delenv("HOROVOD_RANK", raising=False)
+        monkeypatch.delenv("HOROVOD_SIZE", raising=False)
+        monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "2")
+        monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+        cfg = Config.from_env()
+        assert cfg.rank == 2 and cfg.size == 4
+
+
+class TestJsRun:
+    """jsrun command + ERF rankfile composed as strings, no LSF needed
+    (reference test_run.py mpirun-command string assertions)."""
+
+    def test_rankfile_format(self, tmp_path):
+        from horovod_tpu.runner.js_run import generate_jsrun_rankfile
+
+        rf = tmp_path / "rf.erf"
+        generate_jsrun_rankfile(
+            [HostInfo("host1", 2), HostInfo("host2", 2)], np=3,
+            path=str(rf), cores_per_node=4, threads_per_core=2,
+            accelerators_per_node=2)
+        text = rf.read_text()
+        assert "overlapping_rs: allow" in text
+        assert "cpu_index_using: logical" in text
+        # 4 cores x 2 threads / 2 accels = 4 cpus per slot
+        assert "rank: 0: { hostname: host1; cpu: {0-3} ; gpu: * ; mem: * }" \
+            in text
+        assert "rank: 1: { hostname: host1; cpu: {4-7} ; gpu: * ; mem: * }" \
+            in text
+        # np=3 truncates host2 to one slot
+        assert "rank: 2: { hostname: host2; cpu: {0-3} ; gpu: * ; mem: * }" \
+            in text
+        assert "rank: 3" not in text
+
+    def test_rankfile_rejects_oversubscription(self, tmp_path):
+        from horovod_tpu.runner.js_run import generate_jsrun_rankfile
+
+        with pytest.raises(ValueError, match="greater than number"):
+            generate_jsrun_rankfile(
+                [HostInfo("h", 8)], np=8, path=str(tmp_path / "rf"),
+                cores_per_node=4, threads_per_core=1,
+                accelerators_per_node=4)
+
+    def test_rankfile_rejects_too_few_slots(self, tmp_path):
+        from horovod_tpu.runner.js_run import generate_jsrun_rankfile
+
+        with pytest.raises(ValueError, match="Not enough slots"):
+            generate_jsrun_rankfile(
+                [HostInfo("h", 2)], np=4, path=str(tmp_path / "rf"),
+                cores_per_node=4, threads_per_core=1,
+                accelerators_per_node=2)
+
+    def test_command_composition(self):
+        from horovod_tpu.runner.js_run import js_run_command
+
+        cmd = js_run_command(["python", "train.py"], "/tmp/rf.erf",
+                             output_filename="/tmp/out")
+        assert cmd == ["jsrun", "--erf_input", "/tmp/rf.erf",
+                       "--stdio_stderr", "/tmp/out",
+                       "--stdio_stdout", "/tmp/out",
+                       "python", "train.py"]
+
+    def test_jsrun_flag_parses(self):
+        args = parse_args(["-np", "2", "--jsrun", "--", "python", "t.py"])
+        assert args.jsrun
+
+
+class TestNicDiscovery:
+    """Ring-probe NIC discovery exercised for real on localhost
+    (reference driver/task services, driver_service.py:124-193)."""
+
+    def test_local_interfaces_nonempty(self):
+        from horovod_tpu.runner.driver_service import (
+            local_interface_addresses,
+        )
+
+        ifaces = local_interface_addresses()
+        assert ifaces, "at least loopback must be discoverable"
+        assert any(ip.startswith("127.") for ip in ifaces.values())
+
+    def test_ring_probe_finds_common_interfaces(self):
+        import threading
+
+        from horovod_tpu.runner.driver_service import (
+            discover_common_interfaces,
+            run_probe_task,
+        )
+
+        def spawn(host, index, driver_addr):
+            threading.Thread(target=run_probe_task,
+                             args=(driver_addr, index, "k"),
+                             daemon=True).start()
+
+        common, driver = discover_common_interfaces(
+            ["localhost", "localhost", "localhost"], spawn,
+            secret_key="k", timeout_s=30)
+        try:
+            assert common, "localhost tasks must share an interface"
+            rank0 = driver.task_address(0)
+            assert any(i in rank0 for i in common)
+        finally:
+            driver.shutdown()
+
+    def test_probe_timeout_names_missing_tasks(self):
+        from horovod_tpu.runner.driver_service import ProbeDriver
+
+        driver = ProbeDriver(2, "k")
+        try:
+            with pytest.raises(TimeoutError, match=r"task\(s\) \[0, 1\]"):
+                driver.wait_common_interfaces(timeout_s=0.5)
+        finally:
+            driver.shutdown()
 
 
 class TestRunApi:
