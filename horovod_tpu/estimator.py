@@ -22,15 +22,22 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from horovod_tpu.spark.store import (
+    ColSpec,
+    Store,
+    assemble_features,
+    extract_columns,
+    extract_typed,
+    infer_metadata,
+    save_metadata,
+)
 
-def _extract(df, cols: Sequence[str]) -> np.ndarray:
-    """(n, len(cols)) float array from a DataFrame or dict of arrays;
-    columns holding arrays (images) are stacked along feature dims."""
-    parts = []
-    for c in cols:
-        col = np.asarray(list(df[c]) if not isinstance(df, dict) else df[c])
-        parts.append(col.reshape(len(col), -1).astype(np.float32))
-    return np.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+
+def _features(df, specs: Sequence[ColSpec]):
+    """Typed feature assembly (reference petastorm feeds named, typed
+    columns; round 1 flattened everything to float32 — ints and image
+    shapes now survive, see ``spark/store.py``)."""
+    return assemble_features(extract_columns(df, specs), specs)
 
 
 @dataclasses.dataclass
@@ -46,22 +53,29 @@ class TpuModel:
 
     def __init__(self, apply_fn: Callable, params: Any,
                  feature_cols: Sequence[str], output_col: str = "prediction",
-                 batch_size: int = 1024):
+                 batch_size: int = 1024,
+                 feature_specs: Optional[Sequence[ColSpec]] = None):
         self._apply = apply_fn
         self.params = params
         self._feature_cols = list(feature_cols)
+        self._specs = list(feature_specs) if feature_specs else None
         self._output_col = output_col
         self._batch_size = batch_size
 
     def transform(self, df):
         """Return ``df`` with the model output column appended (reference
         ``transform`` adds prediction columns to the DataFrame)."""
-        x = _extract(df, self._feature_cols)
+        specs = self._specs or infer_metadata(df, self._feature_cols)
+        x = _features(df, specs)
         outs = []
         apply = jax.jit(self._apply)
-        for i in range(0, len(x), self._batch_size):
-            outs.append(np.asarray(
-                apply(self.params, jnp.asarray(x[i:i + self._batch_size]))))
+        n = len(x) if not isinstance(x, dict) else \
+            len(next(iter(x.values())))
+        for i in range(0, n, self._batch_size):
+            xb = {k: jnp.asarray(v[i:i + self._batch_size])
+                  for k, v in x.items()} if isinstance(x, dict) else \
+                jnp.asarray(x[i:i + self._batch_size])
+            outs.append(np.asarray(apply(self.params, xb)))
         preds = np.concatenate(outs, axis=0)
         if isinstance(df, dict):
             out = dict(df)
@@ -86,6 +100,7 @@ class Estimator:
                  initial_params: Any = None,
                  batch_size: int = 32, epochs: int = 1,
                  callbacks: Optional[List] = None,
+                 store: Optional[Any] = None,
                  store_dir: Optional[str] = None,
                  validation_fraction: float = 0.0,
                  seed: int = 0):
@@ -98,7 +113,16 @@ class Estimator:
         self._batch_size = batch_size
         self._epochs = epochs
         self._callbacks = callbacks or []
-        self._store_dir = store_dir
+        # `store` is the reference Estimator's artifact manager
+        # (spark/common/store.py: runs/<id>/{checkpoint,logs,metadata} +
+        # intermediate parquet).  `store_dir` keeps its original, narrower
+        # meaning — checkpoints written directly under that path, no run
+        # layout, no data materialization — so existing tooling pointed
+        # at a store_dir keeps finding its files.
+        if isinstance(store, str):
+            store = Store.create(store)
+        self._store = store
+        self._legacy_ckpt_dir = store_dir if store is None else None
         self._validation_fraction = validation_fraction
         self._seed = seed
 
@@ -112,22 +136,58 @@ class Estimator:
         from horovod_tpu.callbacks import CallbackList
 
         hvd.init()
-        x = _extract(df, self._feature_cols)
-        y = np.asarray(df[self._label_col])
-        if y.dtype.kind == "f":
-            y = y.astype(np.float32)
-        else:
-            y = y.astype(np.int32)
+        cols_x, feature_specs = extract_typed(df, self._feature_cols)
+        cols_y, (label_spec,) = extract_typed(df, [self._label_col])
+        x = assemble_features(cols_x, feature_specs)
+        y = cols_y[self._label_col]
 
-        n_val = int(len(x) * self._validation_fraction)
+        def take(data, sl):
+            if isinstance(data, dict):
+                return {k: v[sl] for k, v in data.items()}
+            return data[sl]
+
+        n_rows = len(y)
+        n_val = int(n_rows * self._validation_fraction)
         if n_val:
-            x, x_val = x[:-n_val], x[-n_val:]
+            x, x_val = take(x, slice(None, -n_val)), take(x, slice(-n_val,
+                                                                   None))
             y, y_val = y[:-n_val], y[-n_val:]
+
+        run_id = None
+        if self._store is not None:
+            # reference run layout: runs/<run_id>/{checkpoint,logs,
+            # metadata.json} + intermediate parquet data dirs (store.py
+            # path contract, util.py materialization).  Writes happen on
+            # rank 0 only — the repo's Checkpointer convention — and the
+            # run id is broadcast so every rank agrees on the paths.
+            run_id = hvd.broadcast_object(
+                self._store.new_run_id() if hvd.rank() == 0 else None,
+                root_rank=0)
+            if hvd.rank() == 0:
+                self._store.makedirs(self._store.get_logs_path(run_id))
+                save_metadata(self._store, run_id, feature_specs,
+                              label_spec)
+                import pandas as pd
+
+                if isinstance(df, pd.DataFrame):
+                    split = len(df) - n_val
+                    self._store.write_dataframe(
+                        df.iloc[:split],
+                        self._store.get_train_data_path())
+                    if n_val:
+                        self._store.write_dataframe(
+                            df.iloc[split:],
+                            self._store.get_val_data_path())
 
         apply_fn = self._apply_fn()
         loss = self._loss or (
             lambda out, batch: optax.softmax_cross_entropy_with_integer_labels(
                 out, batch["y"]).mean())
+
+        def to_dev(data):
+            if isinstance(data, dict):
+                return {k: jnp.asarray(v) for k, v in data.items()}
+            return jnp.asarray(data)
 
         def loss_fn(params, batch):
             return loss(apply_fn(params, batch["x"]), batch)
@@ -138,23 +198,28 @@ class Estimator:
             if not hasattr(self._model, "init"):
                 raise ValueError("pass initial_params for a bare apply fn")
             params = self._model.init(jax.random.PRNGKey(self._seed),
-                                      jnp.asarray(x[:1]))
+                                      to_dev(take(x, slice(0, 1))))
         params = hvd.broadcast_variables(params, root_rank=0)
         params, opt_state = step.init(params)
 
-        ckpt = hvd.checkpoint.Checkpointer(self._store_dir) \
-            if self._store_dir else None
+        if self._store is not None:
+            ckpt = hvd.checkpoint.Checkpointer(
+                self._store.get_checkpoint_path(run_id))
+        elif self._legacy_ckpt_dir:
+            ckpt = hvd.checkpoint.Checkpointer(self._legacy_ckpt_dir)
+        else:
+            ckpt = None
         loop = _Loop(params, opt_state)
         cbs = CallbackList(self._callbacks)
         cbs.on_train_begin(loop)
 
         global_bs = self._batch_size * hvd.size()
-        nbatches = max(len(x) // global_bs, 1)
+        nbatches = max(len(y) // global_bs, 1)
         rng = np.random.RandomState(self._seed)
         logs: dict = {}
         for epoch in range(self._epochs):
             cbs.on_epoch_begin(epoch, loop, logs)
-            perm = rng.permutation(len(x))
+            perm = rng.permutation(len(y))
             for b in range(nbatches):
                 cbs.on_batch_begin(b, loop, logs)
                 idx = perm[b * global_bs:(b + 1) * global_bs]
@@ -163,7 +228,7 @@ class Estimator:
                     # still yields a full, device-divisible batch
                     idx = np.concatenate(
                         [idx, np.resize(perm, global_bs - len(idx))])
-                batch = step.shard_batch({"x": jnp.asarray(x[idx]),
+                batch = step.shard_batch({"x": to_dev(take(x, idx)),
                                           "y": jnp.asarray(y[idx])})
                 loop.params, loop.opt_state, train_loss = step(
                     loop.params, loop.opt_state, batch)
@@ -171,11 +236,12 @@ class Estimator:
             logs["loss"] = float(train_loss)
             if n_val:
                 logs["val_loss"] = float(loss_fn(
-                    loop.params, {"x": jnp.asarray(x_val),
+                    loop.params, {"x": to_dev(x_val),
                                   "y": jnp.asarray(y_val)}))
             cbs.on_epoch_end(epoch, loop, logs)
             if ckpt:
                 ckpt.save(epoch, {"params": loop.params,
                                   "opt_state": loop.opt_state})
         cbs.on_train_end(loop, logs)
-        return TpuModel(apply_fn, loop.params, self._feature_cols)
+        return TpuModel(apply_fn, loop.params, self._feature_cols,
+                        feature_specs=feature_specs)

@@ -11,7 +11,23 @@ everywhere; the Spark path activates automatically when pyspark is
 importable.
 """
 
-from horovod_tpu.estimator import Estimator, TpuModel
 from horovod_tpu.spark.runner import run, run_elastic
+from horovod_tpu.spark.store import (
+    FilesystemStore,
+    HDFSStore,
+    LocalStore,
+    Store,
+)
 
-__all__ = ["run", "run_elastic", "Estimator", "TpuModel"]
+__all__ = ["run", "run_elastic", "Estimator", "TpuModel",
+           "Store", "FilesystemStore", "LocalStore", "HDFSStore"]
+
+
+def __getattr__(name):
+    # estimator imports spark.store; resolving Estimator lazily keeps
+    # `horovod_tpu.spark.Estimator` importable without a module cycle
+    if name in ("Estimator", "TpuModel"):
+        from horovod_tpu import estimator
+
+        return getattr(estimator, name)
+    raise AttributeError(name)

@@ -1,0 +1,352 @@
+"""Storage layout for estimator runs: train/val data, checkpoints, logs.
+
+Reference: ``horovod/spark/common/store.py`` — the ``Store`` manages
+"train/val/test data paths, checkpoint + runs paths, and filesystem
+access" for estimators (``LocalStore``/``HDFSStore``, 433 LoC).  The TPU
+edition keeps the same directory contract and method surface over a
+plain filesystem (parquet via pyarrow, which the reference also uses
+through petastorm), so an estimator run leaves the same artifact layout
+a reference user expects:
+
+    <prefix>/
+      intermediate_train_data/   (parquet)
+      intermediate_val_data/     (parquet)
+      runs/<run_id>/
+        checkpoint/              (Checkpointer output)
+        logs/
+        metadata.json            (column specs, see ``infer_metadata``)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class Store:
+    """Abstract artifact store (reference ``Store``, ``store.py:29``)."""
+
+    def is_parquet_dataset(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def get_train_data_path(self, idx: Optional[int] = None) -> str:
+        raise NotImplementedError
+
+    def get_val_data_path(self, idx: Optional[int] = None) -> str:
+        raise NotImplementedError
+
+    def get_test_data_path(self, idx: Optional[int] = None) -> str:
+        raise NotImplementedError
+
+    def saving_runs(self) -> bool:
+        raise NotImplementedError
+
+    def get_runs_path(self) -> str:
+        raise NotImplementedError
+
+    def get_run_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_logs_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_checkpoint_filename(self) -> str:
+        return "checkpoint"
+
+    def get_logs_subdir(self) -> str:
+        return "logs"
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def write(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def makedirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def new_run_id(self) -> str:
+        raise NotImplementedError
+
+    def write_dataframe(self, df, path: str) -> None:
+        raise NotImplementedError
+
+    def read_dataframe(self, path: str):
+        raise NotImplementedError
+
+    @staticmethod
+    def create(prefix_path: str, *args, **kwargs) -> "Store":
+        """Factory by path scheme (reference ``Store.create``,
+        ``store.py:141``)."""
+        if prefix_path.startswith(("hdfs://", "gs://", "s3://")):
+            raise NotImplementedError(
+                f"remote store scheme in '{prefix_path}' is not available "
+                f"in this build (no hdfs/gcs/s3 client libraries); mount "
+                f"the filesystem (fuse) and pass a local path instead.")
+        return LocalStore(prefix_path, *args, **kwargs)
+
+
+class FilesystemStore(Store):
+    """Store over a (possibly network-mounted) filesystem (reference
+    ``FilesystemStore``, ``store.py:148`` — same path layout)."""
+
+    def __init__(self, prefix_path: str,
+                 train_path: Optional[str] = None,
+                 val_path: Optional[str] = None,
+                 test_path: Optional[str] = None,
+                 runs_path: Optional[str] = None,
+                 save_runs: bool = True):
+        self.prefix_path = prefix_path
+        self._train_path = train_path or os.path.join(
+            prefix_path, "intermediate_train_data")
+        self._val_path = val_path or os.path.join(
+            prefix_path, "intermediate_val_data")
+        self._test_path = test_path or os.path.join(
+            prefix_path, "intermediate_test_data")
+        self._runs_path = runs_path or os.path.join(prefix_path, "runs")
+        self._save_runs = save_runs
+
+    def is_parquet_dataset(self, path: str) -> bool:
+        return os.path.isdir(path) and any(
+            f.endswith(".parquet") for f in os.listdir(path))
+
+    def get_train_data_path(self, idx: Optional[int] = None) -> str:
+        return self._train_path if idx is None \
+            else f"{self._train_path}.{idx}"
+
+    def get_val_data_path(self, idx: Optional[int] = None) -> str:
+        return self._val_path if idx is None else f"{self._val_path}.{idx}"
+
+    def get_test_data_path(self, idx: Optional[int] = None) -> str:
+        return self._test_path if idx is None \
+            else f"{self._test_path}.{idx}"
+
+    def saving_runs(self) -> bool:
+        return self._save_runs
+
+    def get_runs_path(self) -> str:
+        return self._runs_path
+
+    def get_run_path(self, run_id: str) -> str:
+        return os.path.join(self._runs_path, run_id)
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id),
+                            self.get_checkpoint_filename())
+
+    def get_logs_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id),
+                            self.get_logs_subdir())
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def read(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def new_run_id(self) -> str:
+        """Next free ``run_NNN`` under the runs dir."""
+        os.makedirs(self._runs_path, exist_ok=True)
+        existing = [d for d in os.listdir(self._runs_path)
+                    if d.startswith("run_")]
+        nums = [int(d[4:]) for d in existing if d[4:].isdigit()]
+        return f"run_{(max(nums) + 1) if nums else 1:03d}"
+
+    # -- dataframe materialization (reference util.py prepare_data /
+    #    petastorm parquet round-trip) -----------------------------------
+
+    def write_dataframe(self, df, path: str) -> None:
+        """Materialize as parquet.  Multi-dimensional array cells
+        (images) are flattened to 1-D lists with their per-row shape
+        recorded in ``_meta.json`` — parquet has no tensor type, so the
+        reference stores intermediate data exactly this way (petastorm
+        flattens ndarrays and reshapes from metadata at read time,
+        ``spark/common/util.py``)."""
+        import pandas as pd
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        if not isinstance(df, pd.DataFrame):
+            df = pd.DataFrame({k: list(v) for k, v in df.items()})
+        shapes = {}
+        out = {}
+        for c in df.columns:
+            col = df[c]
+            first = col.iloc[0] if len(col) else None
+            if isinstance(first, np.ndarray) and first.ndim > 1:
+                shapes[c] = list(first.shape)
+                out[c] = [np.ravel(v) for v in col]
+            else:
+                out[c] = col
+        table = pa.Table.from_pandas(pd.DataFrame(out),
+                                     preserve_index=False)
+        pq.write_table(table, os.path.join(path, "part-00000.parquet"))
+        with open(os.path.join(path, "_meta.json"), "w") as f:
+            json.dump({"shapes": shapes}, f)
+
+    def read_dataframe(self, path: str):
+        import pyarrow.parquet as pq
+
+        df = pq.read_table(path).to_pandas()
+        meta_path = os.path.join(path, "_meta.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                shapes = json.load(f).get("shapes", {})
+            for c, shape in shapes.items():
+                df[c] = [np.asarray(v).reshape(shape) for v in df[c]]
+        return df
+
+
+class LocalStore(FilesystemStore):
+    """Local-disk store (reference ``LocalStore``, ``store.py:251``)."""
+
+
+class HDFSStore(Store):
+    """Gated: the reference's HDFS store needs pyarrow hdfs bindings +
+    a namenode; absent in this build (reference ``store.py:279``)."""
+
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "HDFSStore requires an HDFS client (libhdfs) which is not "
+            "available in this build; use LocalStore over a mounted "
+            "path.")
+
+
+# ---------------------------------------------------------------------------
+# typed column metadata (reference spark/common/util.py schema inference)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ColSpec:
+    """One column's type/shape contract (reference metadata entries:
+    per-column dtype, shape and max_size inferred from the DataFrame,
+    ``util.py`` ``_get_metadata``)."""
+
+    name: str
+    dtype: str            # numpy dtype name, e.g. "float32", "int32"
+    shape: tuple          # per-row shape, () for scalars
+
+    def to_json(self) -> dict:
+        return {"name": self.name, "dtype": self.dtype,
+                "shape": list(self.shape)}
+
+    @staticmethod
+    def from_json(d: dict) -> "ColSpec":
+        return ColSpec(d["name"], d["dtype"], tuple(d["shape"]))
+
+
+def _column_array(df, name: str) -> np.ndarray:
+    col = df[name]
+    if not isinstance(df, dict):
+        col = list(col)
+    arr = np.asarray(col)
+    if arr.dtype == object:   # ragged/list column → stack
+        arr = np.stack([np.asarray(v) for v in col])
+    return arr
+
+
+def _canonical_dtype(arr: np.ndarray) -> np.dtype:
+    """Accelerator-friendly canonical dtypes: float→float32 (unless
+    already half/bfloat16), int/uint/bool→int32 — integers stay
+    integers (embedding ids, masks) instead of the round-1
+    flatten-everything-to-float32."""
+    kind = arr.dtype.kind
+    if kind == "f":
+        return arr.dtype if arr.dtype.itemsize <= 2 else np.dtype(np.float32)
+    if kind in "iub":
+        return np.dtype(np.int32)
+    raise TypeError(f"unsupported column dtype {arr.dtype}")
+
+
+def extract_typed(df, cols: Sequence[str]):
+    """One-pass extraction + schema inference: ``({name: typed array},
+    [ColSpec])`` (reference schema/metadata inference,
+    ``spark/common/util.py``).  Prefer this over ``infer_metadata`` +
+    ``extract_columns`` when both the arrays and the specs are needed —
+    each column is materialized exactly once."""
+    columns: Dict[str, np.ndarray] = {}
+    specs: List[ColSpec] = []
+    for c in cols:
+        arr = _column_array(df, c)
+        dtype = _canonical_dtype(arr)
+        columns[c] = np.ascontiguousarray(arr.astype(dtype))
+        specs.append(ColSpec(c, dtype.name, tuple(arr.shape[1:])))
+    return columns, specs
+
+
+def infer_metadata(df, cols: Sequence[str]) -> List[ColSpec]:
+    """Per-column specs from the data (reference schema/metadata
+    inference, ``spark/common/util.py``)."""
+    return extract_typed(df, cols)[1]
+
+
+def extract_columns(df, specs: Sequence[ColSpec]) -> Dict[str, np.ndarray]:
+    """``{name: typed array}`` per spec — dtype converted, per-row shape
+    validated (a same-size shape mismatch, e.g. CHW data against an NHWC
+    spec, must fail loudly instead of silently reinterpreting memory)."""
+    out = {}
+    for s in specs:
+        arr = _column_array(df, s.name)
+        if tuple(arr.shape[1:]) != s.shape:
+            raise ValueError(
+                f"column '{s.name}' has per-row shape "
+                f"{tuple(arr.shape[1:])} but the model was trained with "
+                f"{s.shape}")
+        out[s.name] = np.ascontiguousarray(arr.astype(np.dtype(s.dtype)))
+    return out
+
+
+def assemble_features(columns: Dict[str, np.ndarray],
+                      specs: Sequence[ColSpec]):
+    """Model input from typed columns: a single feature column passes
+    through with dtype and shape intact (images stay (H, W, C), int ids
+    stay ints); multiple columns of one float dtype concatenate along
+    the feature axis; mixed-type multi-column input stays a dict for
+    the model to route (the reference feeds named columns through
+    petastorm for exactly this reason)."""
+    if len(specs) == 1:
+        return columns[specs[0].name]
+    dtypes = {s.dtype for s in specs}
+    if len(dtypes) == 1 and next(iter(dtypes)).startswith("float"):
+        return np.concatenate(
+            [columns[s.name].reshape(len(columns[s.name]), -1)
+             for s in specs], axis=1)
+    return {s.name: columns[s.name] for s in specs}
+
+
+def save_metadata(store: FilesystemStore, run_id: str,
+                  feature_specs: Sequence[ColSpec],
+                  label_spec: ColSpec) -> None:
+    payload = json.dumps({
+        "features": [s.to_json() for s in feature_specs],
+        "label": label_spec.to_json(),
+    }, indent=2).encode()
+    store.write(os.path.join(store.get_run_path(run_id), "metadata.json"),
+                payload)
+
+
+def load_metadata(store: FilesystemStore, run_id: str):
+    raw = json.loads(store.read(
+        os.path.join(store.get_run_path(run_id), "metadata.json")))
+    return ([ColSpec.from_json(d) for d in raw["features"]],
+            ColSpec.from_json(raw["label"]))

@@ -71,6 +71,119 @@ class TestEstimator:
         assert "loss" in seen[-1][1]
 
 
+class TestStore:
+    """Store path contract + parquet round-trip (reference
+    ``spark/common/store.py`` LocalStore layout)."""
+
+    def test_create_and_layout(self, tmp_path):
+        from horovod_tpu.spark import LocalStore, Store
+
+        store = Store.create(str(tmp_path / "s"))
+        assert isinstance(store, LocalStore)
+        assert store.get_train_data_path().endswith(
+            "intermediate_train_data")
+        assert store.get_val_data_path(2).endswith(
+            "intermediate_val_data.2")
+        rid = store.new_run_id()
+        assert rid == "run_001"
+        assert store.get_checkpoint_path(rid).endswith(
+            "runs/run_001/checkpoint")
+        assert store.get_logs_path(rid).endswith("runs/run_001/logs")
+
+    def test_remote_schemes_gated(self):
+        from horovod_tpu.spark import HDFSStore, Store
+
+        with pytest.raises(NotImplementedError, match="remote store"):
+            Store.create("hdfs://nn/data")
+        with pytest.raises(NotImplementedError):
+            HDFSStore("hdfs://nn/data")
+
+    def test_parquet_roundtrip(self, tmp_path):
+        from horovod_tpu.spark import Store
+
+        store = Store.create(str(tmp_path))
+        df = pd.DataFrame({"a": [1, 2, 3], "b": [0.5, 1.5, 2.5]})
+        store.write_dataframe(df, store.get_train_data_path())
+        assert store.is_parquet_dataset(store.get_train_data_path())
+        back = store.read_dataframe(store.get_train_data_path())
+        pd.testing.assert_frame_equal(back, df)
+
+    def test_fit_populates_store_layout(self, tmp_path):
+        from horovod_tpu.spark.store import Store, load_metadata
+
+        df = make_df(64)
+        store = Store.create(str(tmp_path / "s"))
+        Estimator(Net(), feature_cols=["f1", "f2", "f3", "f4"],
+                  label_col="label", batch_size=4, epochs=1,
+                  store=store, validation_fraction=0.25).fit(df)
+        assert store.is_parquet_dataset(store.get_train_data_path())
+        assert store.is_parquet_dataset(store.get_val_data_path())
+        assert store.exists(store.get_checkpoint_path("run_001"))
+        assert store.exists(store.get_logs_path("run_001"))
+        feats, label = load_metadata(store, "run_001")
+        assert [s.name for s in feats] == ["f1", "f2", "f3", "f4"]
+        assert label.dtype == "int32"
+        # train parquet holds the 48-row training split
+        assert len(store.read_dataframe(
+            store.get_train_data_path())) == 48
+
+
+class TestTypedColumns:
+    """Typed feature extraction (reference schema inference in
+    spark/common/util.py; round 1 flattened everything to float32)."""
+
+    def test_int_columns_stay_int(self):
+        from horovod_tpu.spark.store import (
+            extract_columns,
+            infer_metadata,
+        )
+
+        df = pd.DataFrame({"ids": [1, 2, 3], "w": [0.5, 1.0, 1.5]})
+        specs = infer_metadata(df, ["ids", "w"])
+        cols = extract_columns(df, specs)
+        assert cols["ids"].dtype == np.int32
+        assert cols["w"].dtype == np.float32
+
+    def test_image_shape_preserved(self):
+        from horovod_tpu.spark.store import (
+            assemble_features,
+            extract_columns,
+            infer_metadata,
+        )
+
+        imgs = [np.zeros((8, 8, 3), np.float64) for _ in range(4)]
+        df = pd.DataFrame({"img": imgs})
+        specs = infer_metadata(df, ["img"])
+        assert specs[0].shape == (8, 8, 3)
+        x = assemble_features(extract_columns(df, specs), specs)
+        assert x.shape == (4, 8, 8, 3) and x.dtype == np.float32
+
+    def test_mixed_types_stay_dict(self):
+        from horovod_tpu.spark.store import (
+            assemble_features,
+            extract_columns,
+            infer_metadata,
+        )
+
+        df = pd.DataFrame({"ids": [1, 2], "w": [0.5, 1.0]})
+        specs = infer_metadata(df, ["ids", "w"])
+        x = assemble_features(extract_columns(df, specs), specs)
+        assert isinstance(x, dict)
+        assert x["ids"].dtype == np.int32
+
+    def test_float_columns_concatenate(self):
+        from horovod_tpu.spark.store import (
+            assemble_features,
+            extract_columns,
+            infer_metadata,
+        )
+
+        df = make_df(8)
+        specs = infer_metadata(df, ["f1", "f2", "f3", "f4"])
+        x = assemble_features(extract_columns(df, specs), specs)
+        assert x.shape == (8, 4) and x.dtype == np.float32
+
+
 class TestSparkRun:
     def test_falls_back_to_local(self):
         """Without pyspark, spark.run uses the localhost launcher with the
