@@ -28,16 +28,27 @@ from horovod_tpu.spark.store import (
     assemble_features,
     extract_columns,
     extract_typed,
-    infer_metadata,
     save_metadata,
 )
 
 
-def _features(df, specs: Sequence[ColSpec]):
+def _features(df, cols: Sequence[str],
+              specs: Optional[Sequence[ColSpec]] = None):
     """Typed feature assembly (reference petastorm feeds named, typed
     columns; round 1 flattened everything to float32 — ints and image
-    shapes now survive, see ``spark/store.py``)."""
-    return assemble_features(extract_columns(df, specs), specs)
+    shapes now survive, see ``spark/store.py``).  With known specs the
+    columns are validated against them; otherwise a single-pass
+    extract-and-infer avoids materializing every column twice."""
+    if specs is not None:
+        return assemble_features(extract_columns(df, specs), specs)
+    columns, inferred = extract_typed(df, cols)
+    return assemble_features(columns, inferred)
+
+
+def _map_leaves(f, x):
+    """Apply ``f`` to an array or to every array of a feature dict —
+    one pytree map instead of scattered isinstance branches."""
+    return jax.tree_util.tree_map(f, x)
 
 
 @dataclasses.dataclass
@@ -65,16 +76,13 @@ class TpuModel:
     def transform(self, df):
         """Return ``df`` with the model output column appended (reference
         ``transform`` adds prediction columns to the DataFrame)."""
-        specs = self._specs or infer_metadata(df, self._feature_cols)
-        x = _features(df, specs)
+        x = _features(df, self._feature_cols, self._specs)
         outs = []
         apply = jax.jit(self._apply)
-        n = len(x) if not isinstance(x, dict) else \
-            len(next(iter(x.values())))
+        n = len(jax.tree_util.tree_leaves(x)[0])
         for i in range(0, n, self._batch_size):
-            xb = {k: jnp.asarray(v[i:i + self._batch_size])
-                  for k, v in x.items()} if isinstance(x, dict) else \
-                jnp.asarray(x[i:i + self._batch_size])
+            xb = _map_leaves(
+                lambda v: jnp.asarray(v[i:i + self._batch_size]), x)
             outs.append(np.asarray(apply(self.params, xb)))
         preds = np.concatenate(outs, axis=0)
         if isinstance(df, dict):
@@ -142,9 +150,7 @@ class Estimator:
         y = cols_y[self._label_col]
 
         def take(data, sl):
-            if isinstance(data, dict):
-                return {k: v[sl] for k, v in data.items()}
-            return data[sl]
+            return _map_leaves(lambda v: v[sl], data)
 
         n_rows = len(y)
         n_val = int(n_rows * self._validation_fraction)
@@ -185,9 +191,7 @@ class Estimator:
                 out, batch["y"]).mean())
 
         def to_dev(data):
-            if isinstance(data, dict):
-                return {k: jnp.asarray(v) for k, v in data.items()}
-            return jnp.asarray(data)
+            return _map_leaves(jnp.asarray, data)
 
         def loss_fn(params, batch):
             return loss(apply_fn(params, batch["x"]), batch)

@@ -70,10 +70,10 @@ def fused_scale(x: jax.Array, factor: float,
 
 
 # ---------------------------------------------------------------------------
-# flash attention (forward kernel; backward recomputes blockwise)
+# flash attention (forward + blockwise backward kernels)
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                       causal: bool, scale: float):
     # blocks: q (1, BQ, D); k/v (1, T, D); o (1, BQ, D)
     q = q_ref[0].astype(jnp.float32)                  # (BQ, D)
@@ -112,18 +112,31 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
     m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
     o, m, l = jax.lax.fori_loop(0, num_k, body, (o0, m0, l0))
-    o_ref[0] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (o / l_safe[:, None]).astype(o_ref.dtype)
+    # per-row logsumexp: what the backward needs to rebuild p = exp(s-lse)
+    # without re-running the online-softmax recurrence.  Stored with an
+    # 8-sublane replication axis — Mosaic requires the last two block
+    # dims be (8k, 128k) or full-size (jax's own flash kernel pads its
+    # l/m residuals the same way, with 128 lanes)
+    lse_ref[0] = jnp.broadcast_to((m + jnp.log(l_safe))[None, :],
+                                  lse_ref.shape[1:])
+
+
+def _bh_layout(q, k, v):
+    b, t, h, d = q.shape
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    return to_bh(q), to_bh(k), to_bh(v)
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     b, t, h, d = q.shape
-    # (b, t, h, d) -> (b*h, t, d): one grid row per (batch, head)
-    def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-
-    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    qb, kb, vb = _bh_layout(q, k, v)
     grid = (b * h, t // block_q)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, block_k=block_k,
                           causal=causal, scale=scale),
         grid=grid,
@@ -132,11 +145,165 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, qi: (bh, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 8, t), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qb, kb, vb)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3), lse
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, causal: bool,
+                         scale: float):
+    """dQ for one Q block: stream K/V blocks, rebuild p from the saved
+    logsumexp, accumulate dq = Σ ds·K·scale (FlashAttention-2 backward,
+    dS = P ∘ (dP − delta) with delta = rowsum(dO ∘ O))."""
+    q = q_ref[0].astype(jnp.float32)          # (BQ, D)
+    do = do_ref[0].astype(jnp.float32)        # (BQ, D)
+    lse = lse_ref[0, 0]                       # (BQ,) (sublane 0)
+    delta = delta_ref[0, 0]                   # (BQ,)
+    block_q, d = q.shape
+    t = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = q_pos >= k_pos
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jnp.dot(ds, k_blk,
+                            preferred_element_type=jnp.float32) * scale
+
+    num_k = t // block_k
+    if causal:
+        num_k_live = (qi + 1) * block_q // block_k
+        num_k = jnp.minimum(num_k, jnp.maximum(num_k_live, 1))
+    dq = jax.lax.fori_loop(0, num_k, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, causal: bool,
+                          scale: float):
+    """dK/dV for one K block: stream Q/dO blocks; dV = Σ pᵀ·dO,
+    dK = Σ dsᵀ·Q·scale.  Causal: Q blocks strictly above the diagonal
+    contribute nothing and are skipped."""
+    k = k_ref[0].astype(jnp.float32)          # (BK, D)
+    v = v_ref[0].astype(jnp.float32)          # (BK, D)
+    block_k, d = k.shape
+    t = q_ref.shape[1]
+    ki = pl.program_id(1)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(
+            jnp.float32)
+        lse_blk = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        delta_blk = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = q_pos >= k_pos
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse_blk[:, None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        dv = dv + jnp.dot(p.T, do_blk,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk[:, None])
+        dk = dk + jnp.dot(ds.T, q_blk,
+                          preferred_element_type=jnp.float32) * scale
+        return dk, dv
+
+    start = 0
+    if causal:
+        # first Q block that reaches this K block's diagonal
+        start = (ki * block_k) // block_q
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, t // block_q, body, (zeros, zeros))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+               interpret):
+    b, t, h, d = q.shape
+    qb, kb, vb = _bh_layout(q, k, v)
+    do = g.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    ob = out.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    # delta = rowsum(dO ∘ O): tiny elementwise pass, XLA fuses it;
+    # replicated to the same 8-sublane layout as lse (tiling contract)
+    delta = jnp.broadcast_to(
+        (do.astype(jnp.float32) * ob.astype(jnp.float32)).sum(-1)[:, None],
+        (b * h, 8, t))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+                          causal=causal, scale=scale),
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, qi: (bh, 0, qi)),
+        ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
         interpret=interpret,
-    )(qb, kb, vb)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    )(qb, kb, vb, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                          causal=causal, scale=scale),
+        grid=(b * h, t // block_k),
+        in_specs=[
+            pl.BlockSpec((1, t, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 8, t), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 8, t), lambda bh, ki: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qb, kb, vb, do, lse, delta)
+
+    def from_bh(x):
+        return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+    return from_bh(dq), from_bh(dk), from_bh(dv)
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -146,9 +313,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """Blocked attention over ``(batch, seq, heads, head_dim)`` inputs.
 
     Falls back to the dense jnp formulation off-TPU or when ``seq`` is not
-    divisible by the block sizes.  Differentiable: the backward pass is
-    the dense recomputation (a blockwise backward kernel is the natural
-    next optimization).
+    divisible by the block sizes.  Differentiable end-to-end in Pallas:
+    the forward saves per-row logsumexp and the backward runs the
+    FlashAttention-2 blockwise kernels (dQ streaming K/V; dK/dV
+    streaming Q/dO) — the (T, T) score matrix never exists in HBM in
+    either direction.
     """
     from horovod_tpu.parallel.ring_attention import reference_attention
 
@@ -164,18 +333,19 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     @jax.custom_vjp
     def _attn(q, k, v):
-        return _flash_fwd(q, k, v, causal, scale, block_q, block_k,
-                          interpret)
+        out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                            interpret)
+        return out
 
     def _fwd(q, k, v):
-        return _attn(q, k, v), (q, k, v)
+        out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                              interpret)
+        return out, (q, k, v, out, lse)
 
     def _bwd(res, g):
-        q, k, v = res
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_: reference_attention(
-                q_, k_, v_, causal=causal, scale=scale), q, k, v)
-        return vjp(g)
+        q, k, v, out, lse = res
+        return _flash_bwd(q, k, v, out, lse, g, causal, scale,
+                          block_q, block_k, interpret)
 
     _attn.defvjp(_fwd, _bwd)
     return _attn(q, k, v)

@@ -164,12 +164,20 @@ class FilesystemStore(Store):
         os.makedirs(path, exist_ok=True)
 
     def new_run_id(self) -> str:
-        """Next free ``run_NNN`` under the runs dir."""
+        """Next free ``run_NNN`` under the runs dir, reserved atomically
+        with ``mkdir`` — two jobs sharing a store prefix must never both
+        claim the same run and clobber each other's artifacts."""
         os.makedirs(self._runs_path, exist_ok=True)
-        existing = [d for d in os.listdir(self._runs_path)
-                    if d.startswith("run_")]
-        nums = [int(d[4:]) for d in existing if d[4:].isdigit()]
-        return f"run_{(max(nums) + 1) if nums else 1:03d}"
+        while True:
+            existing = [d for d in os.listdir(self._runs_path)
+                        if d.startswith("run_")]
+            nums = [int(d[4:]) for d in existing if d[4:].isdigit()]
+            rid = f"run_{(max(nums) + 1) if nums else 1:03d}"
+            try:
+                os.mkdir(os.path.join(self._runs_path, rid))
+                return rid
+            except FileExistsError:
+                continue   # lost the race; re-scan
 
     # -- dataframe materialization (reference util.py prepare_data /
     #    petastorm parquet round-trip) -----------------------------------
@@ -278,6 +286,28 @@ def _canonical_dtype(arr: np.ndarray) -> np.dtype:
     raise TypeError(f"unsupported column dtype {arr.dtype}")
 
 
+def _checked_cast(arr: np.ndarray, dtype: np.dtype,
+                  name: str) -> np.ndarray:
+    """Cast with loud failure on value corruption: int values outside
+    the target range would silently wrap and float NaN→int becomes
+    INT_MIN with only a RuntimeWarning — garbage ids/labels must raise
+    instead."""
+    if dtype.kind == "i":
+        if arr.dtype.kind in "iu" and arr.size:
+            info = np.iinfo(dtype)
+            lo, hi = int(arr.min()), int(arr.max())
+            if lo < info.min or hi > info.max:
+                raise ValueError(
+                    f"column '{name}' holds integers in [{lo}, {hi}] "
+                    f"which do not fit the canonical {dtype.name}; remap "
+                    f"the ids or cast the column explicitly.")
+        if arr.dtype.kind == "f" and np.isnan(arr).any():
+            raise ValueError(
+                f"column '{name}' contains NaN but the model expects "
+                f"integer {dtype.name} values — clean the data first.")
+    return arr.astype(dtype)
+
+
 def extract_typed(df, cols: Sequence[str]):
     """One-pass extraction + schema inference: ``({name: typed array},
     [ColSpec])`` (reference schema/metadata inference,
@@ -289,7 +319,7 @@ def extract_typed(df, cols: Sequence[str]):
     for c in cols:
         arr = _column_array(df, c)
         dtype = _canonical_dtype(arr)
-        columns[c] = np.ascontiguousarray(arr.astype(dtype))
+        columns[c] = np.ascontiguousarray(_checked_cast(arr, dtype, c))
         specs.append(ColSpec(c, dtype.name, tuple(arr.shape[1:])))
     return columns, specs
 
@@ -312,7 +342,8 @@ def extract_columns(df, specs: Sequence[ColSpec]) -> Dict[str, np.ndarray]:
                 f"column '{s.name}' has per-row shape "
                 f"{tuple(arr.shape[1:])} but the model was trained with "
                 f"{s.shape}")
-        out[s.name] = np.ascontiguousarray(arr.astype(np.dtype(s.dtype)))
+        out[s.name] = np.ascontiguousarray(
+            _checked_cast(arr, np.dtype(s.dtype), s.name))
     return out
 
 
