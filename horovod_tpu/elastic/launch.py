@@ -24,7 +24,8 @@ def run_elastic(args) -> int:
     if not min_np:
         raise SystemExit("elastic mode needs --min-np or -np")
     if args.host_discovery_script:
-        discovery = HostDiscoveryScript(args.host_discovery_script)
+        discovery = HostDiscoveryScript(args.host_discovery_script,
+                                        default_slots=args.slots or 1)
     elif args.hosts:
         discovery = FixedHosts(
             {h.hostname: h.slots for h in parse_hosts(args.hosts)})
@@ -38,7 +39,9 @@ def run_elastic(args) -> int:
     start_timeout = float(os.environ.get("HOROVOD_ELASTIC_START_TIMEOUT",
                                          START_TIMEOUT_S))
     driver = ElasticDriver(discovery, min_np, args.max_np,
-                           timeout=args.elastic_timeout, secret_key=key,
+                           timeout=args.elastic_timeout,
+                           reset_limit=args.reset_limit or 0,
+                           secret_key=key,
                            start_timeout=start_timeout)
     base_env = config_parser.set_env_from_args(dict(os.environ), args)
     driver_host, driver_port = driver.address
@@ -58,7 +61,8 @@ def run_elastic(args) -> int:
             "HOROVOD_ELASTIC_NOTIFY_ADDR": "1",
             "HOROVOD_ELASTIC_GENERATION": str(generation),
         })
-        cmd = build_worker_command(slot, args.command, args.ssh_port)
+        cmd = build_worker_command(slot, args.command, args.ssh_port,
+                                   getattr(args, "ssh_identity_file", None))
         stdout = stderr = None
         if out_dir:
             stdout = open(os.path.join(out_dir, f"rank.{slot.rank}.out"), "ab")

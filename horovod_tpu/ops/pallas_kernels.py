@@ -105,8 +105,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
     num_k = t // block_k
     if causal:
-        # skip blocks strictly above the diagonal (their mask is all-false)
-        num_k_live = (qi + 1) * block_q // block_k
+        # skip blocks strictly above the diagonal (their mask is
+        # all-false); ceil-divide — flooring would drop the partially
+        # live diagonal block whenever block_q is not a block_k multiple
+        num_k_live = ((qi + 1) * block_q + block_k - 1) // block_k
         num_k = jnp.minimum(num_k, jnp.maximum(num_k_live, 1))
     o0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
@@ -193,7 +195,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     num_k = t // block_k
     if causal:
-        num_k_live = (qi + 1) * block_q // block_k
+        # ceil-divide: see the forward kernel's diagonal-block note
+        num_k_live = ((qi + 1) * block_q + block_k - 1) // block_k
         num_k = jnp.minimum(num_k, jnp.maximum(num_k_live, 1))
     dq = jax.lax.fori_loop(0, num_k, body,
                            jnp.zeros((block_q, d), jnp.float32))
@@ -326,8 +329,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     block_q = min(block_q, t)
     block_k = min(block_k, t)
     usable = (interpret or _on_tpu()) and \
-        t % block_q == 0 and t % block_k == 0 and \
-        (block_q % block_k == 0 or not causal)
+        t % block_q == 0 and t % block_k == 0
     if not usable:
         return reference_attention(q, k, v, causal=causal, scale=scale)
 

@@ -18,6 +18,17 @@ _PARAMS = [
     ("cache.capacity", "cache_capacity", "HOROVOD_CACHE_CAPACITY"),
     ("autotune.enabled", "autotune", "HOROVOD_AUTOTUNE"),
     ("autotune.log_file", "autotune_log_file", "HOROVOD_AUTOTUNE_LOG"),
+    ("autotune.warmup_samples", "autotune_warmup_samples",
+     "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"),
+    ("autotune.steps_per_sample", "autotune_steps_per_sample",
+     "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"),
+    ("autotune.bayes_opt_max_samples", "autotune_bayes_opt_max_samples",
+     "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"),
+    ("autotune.gaussian_process_noise", "autotune_gaussian_process_noise",
+     "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"),
+    ("logging.level", "log_level", "HOROVOD_LOG_LEVEL"),
+    ("logging.hide_timestamp", "log_hide_timestamp",
+     "HOROVOD_LOG_HIDE_TIME"),
     ("timeline.filename", "timeline_filename", "HOROVOD_TIMELINE"),
     ("timeline.mark_cycles", "timeline_mark_cycles",
      "HOROVOD_TIMELINE_MARK_CYCLES"),
@@ -65,4 +76,10 @@ def set_env_from_args(env: Dict[str, str], args) -> Dict[str, str]:
             env[env_var] = str(int(value) * 1024 * 1024)
         else:
             env[env_var] = str(value)
+    # --disable-cache is the reference's spelling for cache capacity 0
+    if getattr(args, "disable_cache", None):
+        env["HOROVOD_CACHE_CAPACITY"] = "0"
+    # restrict gloo's CPU collectives to the requested interfaces
+    if getattr(args, "nics", None):
+        env["GLOO_SOCKET_IFNAME"] = args.nics
     return env

@@ -49,9 +49,22 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--hostfile", dest="hostfile",
                    help="file with one 'host slots=N' per line")
     p.add_argument("--ssh-port", type=int, default=None)
+    p.add_argument("-i", "--ssh-identity-file", dest="ssh_identity_file",
+                   help="ssh private key for remote worker launch")
+    p.add_argument("--gloo", action="store_true", dest="use_gloo",
+                   help="use the built-in launcher fan-out (the default; "
+                        "accepted for reference CLI compatibility)")
+    p.add_argument("--mpi", action="store_true", dest="use_mpi",
+                   help="launch through mpirun (workers read identity "
+                        "from the OMPI/PMIx env)")
     p.add_argument("--jsrun", action="store_true",
                    help="launch through jsrun with an ERF rankfile "
                         "(LSF clusters)")
+    p.add_argument("--mpi-args", dest="mpi_args",
+                   help="extra arguments appended to mpirun")
+    p.add_argument("--network-interface", dest="nics",
+                   help="comma-separated interfaces to restrict control "
+                        "and data traffic to (skips NIC discovery)")
     p.add_argument("--start-timeout", type=int, default=30)
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--output-filename", dest="output_filename",
@@ -63,17 +76,39 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     # elastic (reference --min-np/--max-np/--host-discovery-script)
     p.add_argument("--min-np", type=int, dest="min_np")
     p.add_argument("--max-np", type=int, dest="max_np")
+    p.add_argument("--slots-per-host", type=int, dest="slots",
+                   help="default slot count for discovered hosts")
     p.add_argument("--host-discovery-script", dest="host_discovery_script")
     p.add_argument("--elastic-timeout", type=int, default=600)
+    p.add_argument("--reset-limit", type=int, dest="reset_limit",
+                   help="stop after this many elastic resets (reference "
+                        "--reset-limit)")
 
     # knobs → env (reference config_parser flag set)
     p.add_argument("--fusion-threshold-mb", type=int,
                    dest="fusion_threshold_mb")
     p.add_argument("--cycle-time-ms", type=float, dest="cycle_time_ms")
     p.add_argument("--cache-capacity", type=int, dest="cache_capacity")
+    p.add_argument("--disable-cache", action="store_const", const=True,
+                   dest="disable_cache",
+                   help="disable the response-cache analogue "
+                        "(sets HOROVOD_CACHE_CAPACITY=0)")
     p.add_argument("--autotune", action="store_const", const=True,
                    dest="autotune")
     p.add_argument("--autotune-log-file", dest="autotune_log_file")
+    p.add_argument("--autotune-warmup-samples", type=int,
+                   dest="autotune_warmup_samples")
+    p.add_argument("--autotune-steps-per-sample", type=int,
+                   dest="autotune_steps_per_sample")
+    p.add_argument("--autotune-bayes-opt-max-samples", type=int,
+                   dest="autotune_bayes_opt_max_samples")
+    p.add_argument("--autotune-gaussian-process-noise", type=float,
+                   dest="autotune_gaussian_process_noise")
+    p.add_argument("--log-level", dest="log_level",
+                   choices=["trace", "debug", "info", "warning", "error",
+                            "fatal"])
+    p.add_argument("--log-hide-timestamp", action="store_const", const=True,
+                   dest="log_hide_timestamp")
     p.add_argument("--timeline-filename", dest="timeline_filename")
     p.add_argument("--timeline-mark-cycles", action="store_const", const=True,
                    dest="timeline_mark_cycles")
@@ -143,6 +178,7 @@ def _discover_coordinator_addr(hosts: List[HostInfo], args) -> str:
     if all(_is_local(h) for h in hostnames):
         return _coordinator_addr(hosts)
     key = make_secret_key()
+    requested_nics = set(args.nics.split(",")) if args.nics else None
     procs = []
 
     def spawn(host: str, index: int, driver_addrs: str) -> None:
@@ -154,13 +190,23 @@ def _discover_coordinator_addr(hosts: List[HostInfo], args) -> str:
         slot = SlotInfo(hostname=host, rank=index, local_rank=0,
                         cross_rank=0, size=len(hostnames), local_size=1,
                         cross_size=len(hostnames))
-        full = build_worker_command(slot, cmd, args.ssh_port)
+        full = build_worker_command(slot, cmd, args.ssh_port,
+                                    args.ssh_identity_file)
         procs.append(subprocess.Popen(full,
                                       stdout=subprocess.DEVNULL,
                                       stderr=subprocess.DEVNULL))
 
     try:
         common, driver = discover_common_interfaces(hostnames, spawn, key)
+        if requested_nics is not None:
+            # reference --network-interface: the user's list wins; fail
+            # loudly if none of them is mutually routable
+            narrowed = [i for i in common if i in requested_nics]
+            if not narrowed:
+                raise RuntimeError(
+                    f"--network-interface {args.nics} matches none of the "
+                    f"mutually-routable interfaces {common}")
+            common = narrowed
         try:
             rank0 = driver.task_address(0)
             iface = next(i for i in common if i in rank0)
@@ -186,7 +232,9 @@ def _discover_coordinator_addr(hosts: List[HostInfo], args) -> str:
 
 
 def build_worker_command(slot: SlotInfo, command: List[str],
-                         ssh_port: Optional[int] = None) -> List[str]:
+                         ssh_port: Optional[int] = None,
+                         ssh_identity_file: Optional[str] = None
+                         ) -> List[str]:
     """Local slots exec directly; remote slots go through ssh (reference
     ``gloo_run.py:113-180`` ssh/exec split).  Remote args are
     ``shlex.quote``d — naive single-quoting corrupts any argument that
@@ -195,9 +243,13 @@ def build_worker_command(slot: SlotInfo, command: List[str],
 
     if _is_local(slot.hostname):
         return list(command)
-    ssh = ["ssh", "-o", "StrictHostKeyChecking=no", slot.hostname]
+    ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if ssh_identity_file:
+        ssh += ["-i", ssh_identity_file]
     if ssh_port:
+        # options must precede the destination — ssh stops parsing at it
         ssh += ["-p", str(ssh_port)]
+    ssh.append(slot.hostname)
     return ssh + [" ".join(shlex.quote(c) for c in command)]
 
 
@@ -206,6 +258,7 @@ SSH_CHECK_TIMEOUT_S = 30
 
 def check_all_hosts_ssh_successful(hostnames: List[str],
                                    ssh_port: Optional[int] = None,
+                                   ssh_identity_file: Optional[str] = None,
                                    runner=None) -> None:
     """Verify every remote host is ssh-reachable before fan-out
     (reference ``_check_all_hosts_ssh_successful``, ``launch.py:55-104``)
@@ -231,6 +284,8 @@ def check_all_hosts_ssh_successful(hostnames: List[str],
     def check(host: str) -> None:
         cmd = ["ssh", "-o", "BatchMode=yes",
                "-o", "StrictHostKeyChecking=no"]
+        if ssh_identity_file:
+            cmd += ["-i", ssh_identity_file]
         if ssh_port:
             cmd += ["-p", str(ssh_port)]
         cmd += [host, shlex.quote("true")]
@@ -275,12 +330,25 @@ def _run_jsrun(args, hosts: List[HostInfo]) -> int:
     return js_run.js_run(args, hosts, env)
 
 
+def _run_mpi(args, hosts: List[HostInfo]) -> int:
+    """mpirun launch: mpirun places the ranks; workers read identity
+    from the OMPI/PMIx env (reference ``mpi_run.py``)."""
+    from horovod_tpu.runner import mpi_run
+
+    env = config_parser.set_env_from_args(dict(os.environ), args)
+    env["HOROVOD_COORDINATOR_ADDR"] = _coordinator_addr(hosts)
+    env["HOROVOD_SIZE"] = str(args.np)
+    return mpi_run.mpi_run(args, hosts, env)
+
+
 def _run_static(args) -> int:
     hosts = _resolve_hosts(args)
     if args.jsrun:
         return _run_jsrun(args, hosts)
+    if args.use_mpi:
+        return _run_mpi(args, hosts)
     check_all_hosts_ssh_successful([h.hostname for h in hosts],
-                                   args.ssh_port)
+                                   args.ssh_port, args.ssh_identity_file)
     assignments = get_host_assignments(hosts, args.np, args.np)
     coordinator = _discover_coordinator_addr(hosts, args)
     base_env = config_parser.set_env_from_args(dict(os.environ), args)
@@ -298,7 +366,8 @@ def _run_static(args) -> int:
         os.makedirs(out_dir, exist_ok=True)
 
     def run_slot(slot: SlotInfo):
-        cmd = build_worker_command(slot, args.command, args.ssh_port)
+        cmd = build_worker_command(slot, args.command, args.ssh_port,
+                                   args.ssh_identity_file)
         env = build_worker_env(slot, base_env, coordinator)
         stdout = stderr = None
         if out_dir:

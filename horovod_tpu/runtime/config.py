@@ -86,6 +86,8 @@ class Config:
     autotune: bool = False
     autotune_log: Optional[str] = None
     autotune_warmup_samples: int = 3
+    autotune_bayes_opt_max_samples: int = 20
+    autotune_gaussian_process_noise: float = 0.8
     autotune_steps_per_sample: int = 10
 
     # -- timeline (reference operations.cc:417-424)
@@ -160,6 +162,10 @@ class Config:
             autotune=_env_bool("HOROVOD_AUTOTUNE", False),
             autotune_log=os.environ.get("HOROVOD_AUTOTUNE_LOG"),
             autotune_warmup_samples=_env_int("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3),
+            autotune_bayes_opt_max_samples=_env_int(
+                "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 20),
+            autotune_gaussian_process_noise=_env_float(
+                "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", 0.8),
             autotune_steps_per_sample=_env_int(
                 "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", 10),
             timeline_filename=os.environ.get("HOROVOD_TIMELINE"),

@@ -58,7 +58,10 @@ class ParameterManager:
         self._log_path = log_path
         self._log_rows: List[dict] = []
         self._bo = None
-        self._bo_samples_left = _BO_SAMPLES
+        self._bo_samples_left = getattr(
+            config, 'autotune_bayes_opt_max_samples', _BO_SAMPLES)
+        self._gp_noise = getattr(
+            config, 'autotune_gaussian_process_noise', 0.8)
         if not self._done:
             self._apply(self._points[0])
 
@@ -105,7 +108,8 @@ class ParameterManager:
         from horovod_tpu.utils.bayesian import BayesianOptimizer
 
         if self._bo is None:
-            self._bo = BayesianOptimizer(_BO_BOUNDS, seed=0)
+            self._bo = BayesianOptimizer(_BO_BOUNDS, seed=0,
+                                         noise=self._gp_noise)
             for sc, (thr, cyc) in self._scores:
                 self._bo.observe(
                     [math.log2(max(thr, 1 * MiB)), cyc], sc)

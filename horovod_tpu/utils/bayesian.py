@@ -70,10 +70,12 @@ class BayesianOptimizer:
     unit cube internally; observations standardized)."""
 
     def __init__(self, bounds: Sequence[Tuple[float, float]],
-                 seed: int = 0, num_candidates: int = 512):
+                 seed: int = 0, num_candidates: int = 512,
+                 noise: float = 1e-4):
         self._bounds = np.asarray(bounds, np.float64)
         self._rng = np.random.RandomState(seed)
         self._num_candidates = num_candidates
+        self._noise = float(noise)
         self._xs: List[np.ndarray] = []
         self._ys: List[float] = []
 
@@ -89,7 +91,7 @@ class BayesianOptimizer:
             return lo + (hi - lo) * self._rng.rand(dim)
         ys = np.asarray(self._ys)
         mu, sd = ys.mean(), max(ys.std(), 1e-12)
-        gp = GaussianProcess(length_scale=0.3)
+        gp = GaussianProcess(length_scale=0.3, sigma_n=self._noise)
         gp.fit(np.stack(self._xs), (ys - mu) / sd)
         cand = self._rng.rand(self._num_candidates, dim)
         mean, std = gp.predict(cand)

@@ -63,9 +63,11 @@ class TestBayesianOptimizer:
 class TestParameterManagerLifecycle:
     def test_full_tuning_run(self, tmp_path):
         log = tmp_path / "autotune.csv"
-        cfg = Config(autotune=True, autotune_steps_per_sample=2)
+        cfg = Config(autotune=True, autotune_steps_per_sample=2,
+                     autotune_bayes_opt_max_samples=4)
         pm = ParameterManager(cfg, log_path=str(log))
-        total_points = len(_WARMUP_GRID) + _BO_SAMPLES + 1
+        total_points = len(_WARMUP_GRID) + \
+            cfg.autotune_bayes_opt_max_samples + 1
         steps = 0
         while pm.active and steps < total_points * 2 + 10:
             pm.record_bytes(1 << 20)

@@ -31,13 +31,16 @@ class TestFusedScale:
 
 
 class TestFlashAttention:
-    @pytest.mark.parametrize("causal", [False, True])
-    def test_matches_dense(self, causal):
+    @pytest.mark.parametrize("causal,bq,bk", [
+        (False, 16, 16), (True, 16, 16),
+        (True, 16, 32),  # partial diagonal block (block_q < block_k)
+    ])
+    def test_matches_dense(self, causal, bq, bk):
         ks = jax.random.split(jax.random.PRNGKey(0), 3)
         shape = (2, 64, 2, 16)
         q, k, v = (jax.random.normal(kk, shape, jnp.float32) for kk in ks)
-        out = flash_attention(q, k, v, causal=causal, block_q=16,
-                              block_k=16, interpret=True)
+        out = flash_attention(q, k, v, causal=causal, block_q=bq,
+                              block_k=bk, interpret=True)
         expected = reference_attention(q, k, v, causal=causal)
         np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
                                    rtol=2e-5, atol=2e-5)
@@ -63,6 +66,7 @@ class TestFlashAttention:
 
     @pytest.mark.parametrize("causal,bq,bk", [
         (False, 8, 8), (False, 16, 8), (True, 16, 8), (True, 8, 8),
+        (True, 8, 16),   # block_q < block_k: diagonal block is partial
     ])
     def test_bwd_kernel_matches_dense(self, causal, bq, bk):
         """The Pallas FlashAttention-2 backward (dQ + dK/dV kernels, fed

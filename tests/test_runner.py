@@ -261,6 +261,81 @@ class TestJsRun:
         assert args.jsrun
 
 
+class TestMpiRun:
+    """mpirun command composed as strings, no MPI needed (reference
+    test_run.py mpirun-command string assertions)."""
+
+    def test_command_composition(self):
+        from horovod_tpu.runner.mpi_run import mpi_run_command
+
+        env = {"HOROVOD_COORDINATOR_ADDR": "10.0.0.1:1234",
+               "PYTHONPATH": "/x", "HOME": "/root", "GLOO_SOCKET_IFNAME":
+               "eth0"}
+        cmd = mpi_run_command(
+            4, [HostInfo("h1", 2), HostInfo("h2", 2)],
+            ["python", "train.py"], env,
+            impl_flags=["-bind-to", "none", "-map-by", "slot"],
+            nics="eth0", extra_mpi_args="--oversubscribe")
+        s = " ".join(cmd)
+        assert s.startswith("mpirun -bind-to none -map-by slot")
+        assert "-np 4" in s and "-H h1:2,h2:2" in s
+        assert "-mca btl_tcp_if_include eth0" in s
+        assert "-x GLOO_SOCKET_IFNAME" in s
+        assert "-x HOROVOD_COORDINATOR_ADDR" in s
+        assert "-x PYTHONPATH" in s
+        assert "-x HOME" not in s       # only the forwarding allowlist
+        assert "--oversubscribe" in s
+        assert s.endswith("python train.py")
+
+    def test_mpi_flag_without_mpirun_errors(self, monkeypatch):
+        from horovod_tpu.runner import mpi_run
+        from horovod_tpu.runner.launch import run_commandline
+
+        monkeypatch.setattr(mpi_run.shutil, "which", lambda _: None)
+        with pytest.raises(RuntimeError, match="does not find an installed"):
+            run_commandline(["-np", "2", "--mpi", "--", "python", "t.py"])
+
+
+class TestFlagParity:
+    def test_reference_flags_accepted(self):
+        args = parse_args([
+            "-np", "2", "--disable-cache", "--network-interface", "eth0,lo",
+            "-i", "/root/.ssh/key", "--slots-per-host", "4",
+            "--reset-limit", "3", "--log-level", "debug",
+            "--log-hide-timestamp", "--autotune-warmup-samples", "5",
+            "--autotune-steps-per-sample", "20",
+            "--autotune-bayes-opt-max-samples", "30",
+            "--autotune-gaussian-process-noise", "0.5",
+            "--gloo", "--", "python", "t.py"])
+        assert args.disable_cache and args.nics == "eth0,lo"
+        assert args.ssh_identity_file == "/root/.ssh/key"
+        assert args.slots == 4 and args.reset_limit == 3
+        env = config_parser.set_env_from_args({}, args)
+        assert env["HOROVOD_CACHE_CAPACITY"] == "0"   # --disable-cache
+        assert env["GLOO_SOCKET_IFNAME"] == "eth0,lo"
+        assert env["HOROVOD_LOG_LEVEL"] == "debug"
+        assert env["HOROVOD_LOG_HIDE_TIME"] == "1"
+        assert env["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] == "5"
+        assert env["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] == "30"
+        assert env["HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"] == "0.5"
+
+    def test_ssh_identity_in_commands(self):
+        from horovod_tpu.runner.launch import (
+            build_worker_command,
+            check_all_hosts_ssh_successful,
+        )
+
+        slot = get_host_assignments([HostInfo("w1", 1)], 1)[0]
+        cmd = build_worker_command(slot, ["true"],
+                                   ssh_identity_file="/k.pem")
+        assert "-i" in cmd and "/k.pem" in cmd
+        seen = []
+        check_all_hosts_ssh_successful(
+            ["w1"], ssh_identity_file="/k.pem",
+            runner=lambda c: seen.append(c) or 0)
+        assert "-i" in seen[0] and "/k.pem" in seen[0]
+
+
 class TestNicDiscovery:
     """Ring-probe NIC discovery exercised for real on localhost
     (reference driver/task services, driver_service.py:124-193)."""
