@@ -169,8 +169,21 @@ def _negotiate(desc: Optional[dict], join_cycle: int = -1) -> _Negotiation:
     blocked in its service loop must observe every cycle, so there is no
     skip-the-wire fast path (the reference pays the same: its cache-hit
     path still does 2 bitwise-AND + 1 bitwise-OR cross-rank syncs,
-    ``controller.cc:133-164``).  The signature cache only tracks
-    hit/miss statistics (``response_cache.{h,cc}`` observability).
+    ``controller.cc:133-164``).
+
+    On cache invalidation (deliberate design difference): the reference
+    stall inspector invalidates cached responses of stalled tensors so
+    they renegotiate (``stall_inspector.h:73-81`` +
+    ``response_cache.cc``).  Here the caches are *cross-process wire
+    state* — ``need_payload`` is computed from cache membership on every
+    process independently, which is only sound because all processes
+    mutate the caches at identical cycles.  A stall-triggered,
+    one-sided invalidation would desynchronize that decision and
+    misalign the payload exchange (deadlock), so stalls are surfaced
+    through the stall inspector's warnings/shutdown and the timeline's
+    NEGOTIATE events instead of cache eviction; the only evictions are
+    the deterministic size-bound clear below and the world-reset clear
+    in ``_reset_mesh_cache``.
     """
     global _cycle
     mesh = process_mesh()
@@ -282,6 +295,29 @@ def _negotiate(desc: Optional[dict], join_cycle: int = -1) -> _Negotiation:
                 f"zero contributions from joined ranks have no identity "
                 f"under {desc['op']}.")
     return _Negotiation(False, -1, joined, shared_desc)
+
+
+def _localize(tensor) -> jax.Array:
+    """Intake normalization: a previous eager collective returns an array
+    replicated over the *global* proc mesh; feeding it straight into the
+    next collective (the natural training loop: ``w -= lr *
+    allreduce(grad(w))``) must work.  Such arrays span non-addressable
+    devices, which ``device_put``/``np.asarray`` reject — take the local
+    replica.  Only *replicated* arrays get this shortcut: truncating a
+    genuinely sharded array to its shard 0 would silently reduce a
+    fragment."""
+    if isinstance(tensor, jax.Array) and \
+            len(tensor.sharding.device_set) > 1:
+        if tensor.sharding.is_fully_replicated:
+            return jnp.asarray(tensor.addressable_data(0))
+        if not tensor.is_fully_addressable:
+            raise HorovodInternalError(
+                "eager collectives take per-process local tensors (or "
+                "replicated results of previous eager collectives); got "
+                "a globally-sharded array — gather it first, or use the "
+                "in-jit horovod_tpu.ops.collectives inside your step.")
+        # fully-addressable sharded input: jnp.asarray gathers it
+    return jnp.asarray(tensor)
 
 
 def _lift(tensor: jax.Array) -> jax.Array:
@@ -465,7 +501,7 @@ def allreduce_async(tensor, average: Optional[bool] = None,
     name = name or _next_name("allreduce")
     handle = Handle(name)
     _register(name, handle)
-    tensor = jnp.asarray(tensor)
+    tensor = _localize(tensor)
     ctx = None
     if compression is not None:
         tensor, ctx = compression.compress(tensor)
@@ -653,7 +689,7 @@ def allgather_with_sizes(tensor, name: Optional[str] = None):
     sizes as a host ``np.ndarray`` — callers exchanging variable payloads
     (``allgather_object``) reuse them instead of a second collective."""
     name = name or _next_name("allgather")
-    tensor = jnp.asarray(tensor)
+    tensor = _localize(tensor)
     mesh = process_mesh()
     nproc = mesh.devices.size
     if nproc == 1:
@@ -691,7 +727,7 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
     """Broadcast from ``root_rank`` process to all (reference
     ``EnqueueTensorBroadcast``, ``operations.cc:928``)."""
     name = name or _next_name("broadcast")
-    tensor = jnp.asarray(tensor)
+    tensor = _localize(tensor)
     mesh = process_mesh()
     nproc = mesh.devices.size
     if nproc == 1:
@@ -721,7 +757,7 @@ def alltoall(tensor, splits=None, name: Optional[str] = None):
     to process i; uniform split when ``splits`` is None.  Returns the
     concatenation of slices received from every process."""
     name = name or _next_name("alltoall")
-    tensor = jnp.asarray(tensor)
+    tensor = _localize(tensor)
     mesh = process_mesh()
     nproc = mesh.devices.size
     if splits is None:

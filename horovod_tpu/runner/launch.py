@@ -177,8 +177,12 @@ def _discover_coordinator_addr(hosts: List[HostInfo], args) -> str:
     hostnames = [h.hostname for h in hosts]
     if all(_is_local(h) for h in hostnames):
         return _coordinator_addr(hosts)
+    if args.nics:
+        # user-specified interfaces skip discovery entirely (reference
+        # semantics): the coordinator uses the given hostname and the
+        # workers' transports are pinned via GLOO_SOCKET_IFNAME
+        return _coordinator_addr(hosts)
     key = make_secret_key()
-    requested_nics = set(args.nics.split(",")) if args.nics else None
     procs = []
 
     def spawn(host: str, index: int, driver_addrs: str) -> None:
@@ -198,15 +202,6 @@ def _discover_coordinator_addr(hosts: List[HostInfo], args) -> str:
 
     try:
         common, driver = discover_common_interfaces(hostnames, spawn, key)
-        if requested_nics is not None:
-            # reference --network-interface: the user's list wins; fail
-            # loudly if none of them is mutually routable
-            narrowed = [i for i in common if i in requested_nics]
-            if not narrowed:
-                raise RuntimeError(
-                    f"--network-interface {args.nics} matches none of the "
-                    f"mutually-routable interfaces {common}")
-            common = narrowed
         try:
             rank0 = driver.task_address(0)
             iface = next(i for i in common if i in rank0)

@@ -39,19 +39,25 @@ def is_mpirun_installed() -> bool:
 def mpi_implementation_flags(env: Optional[Dict[str, str]] = None
                              ) -> List[str]:
     """Implementation-specific placement flags (reference
-    ``_get_mpi_implementation_flags``: OpenMPI gets the bind/map and MCA
-    transport tuning; others get the portable subset)."""
+    ``_get_mpi_implementation_flags`` detects OpenMPI/SpectrumMPI and
+    errors on anything else — the composed command uses ``-x``/MCA
+    spellings only those implementations understand, and workers derive
+    identity from the OMPI/PMIx env only they set)."""
     try:
         out = subprocess.run(["mpirun", "--version"],
                              capture_output=True, text=True,
                              timeout=10).stdout
     except (OSError, subprocess.TimeoutExpired):
         out = ""
-    if "Open MPI" in out or "OpenRTE" in out:
+    if "Open MPI" in out or "OpenRTE" in out or "Spectrum MPI" in out:
         return ["--allow-run-as-root", "--tag-output",
                 "-bind-to", "none", "-map-by", "slot",
                 "-mca", "pml", "ob1", "-mca", "btl", "^openib"]
-    return ["-bind-to", "none", "-map-by", "slot"]
+    raise RuntimeError(
+        "Unsupported MPI implementation for --mpi (need Open MPI or "
+        "IBM Spectrum MPI: the launch uses their -x env forwarding and "
+        "PMIx rank env). Detected: "
+        + (out.splitlines()[0] if out else "no mpirun version output"))
 
 
 def mpi_run_command(np: int, hosts: List[HostInfo], command: List[str],

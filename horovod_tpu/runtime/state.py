@@ -75,6 +75,15 @@ class GlobalState:
     def initialize(self, ranks: Optional[list] = None) -> None:
         cfg = self.config
 
+        # HOROVOD_THREAD_AFFINITY: confine this worker to its core set
+        # (reference parse_and_set_affinity, common.cc).  Must run BEFORE
+        # any jax.distributed setup — sched_setaffinity is inherited only
+        # by threads created afterwards, and the distributed runtime's
+        # gRPC/heartbeat threads are exactly what the mask should cover.
+        from horovod_tpu.utils.affinity import set_affinity_from_env
+
+        set_affinity_from_env(cfg.local_rank or 0)
+
         # Multi-process bootstrap: the coordination-service analogue of the
         # reference's gloo rendezvous (gloo_context.cc:71-91).  The launcher
         # sets HOROVOD_COORDINATOR_ADDR + HOROVOD_RANK/SIZE; jax.distributed

@@ -1,0 +1,73 @@
+"""CPU affinity pinning (reference ``HOROVOD_THREAD_AFFINITY``).
+
+The reference pins its background communication thread to a core per
+local rank (``parse_and_set_affinity``, ``common/common.cc:~150``).
+There is no background thread here — XLA schedules collectives — but
+pinning still matters on shared hosts: each worker process (and with it
+the gloo/gRPC helper threads jax spawns) can be confined to its own
+core set so co-located workers do not migrate onto each other.
+
+``HOROVOD_THREAD_AFFINITY`` holds one core set per local rank,
+semicolon-separated; each set is a comma list and/or ranges::
+
+    HOROVOD_THREAD_AFFINITY="0-3;4-7"      # local rank 0 → 0-3, 1 → 4-7
+    HOROVOD_THREAD_AFFINITY="0,2;1,3"
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Set
+
+from horovod_tpu.utils import logging as hvd_logging
+
+
+def parse_affinity(spec: str) -> List[Set[int]]:
+    """Parse the per-local-rank core sets; raises ValueError on junk."""
+    out: List[Set[int]] = []
+    for rank_spec in spec.split(";"):
+        cores: Set[int] = set()
+        for part in rank_spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "-" in part:
+                lo, _, hi = part.partition("-")
+                lo_i, hi_i = int(lo), int(hi)
+                if hi_i < lo_i:
+                    raise ValueError(
+                        f"invalid core range '{part}' in affinity spec")
+                cores.update(range(lo_i, hi_i + 1))
+            else:
+                cores.add(int(part))
+        if not cores:
+            raise ValueError(
+                f"empty core set in HOROVOD_THREAD_AFFINITY: {spec!r}")
+        out.append(cores)
+    return out
+
+
+def set_affinity_from_env(local_rank: int,
+                          setter=None) -> Optional[Set[int]]:
+    """Apply this process's core set from ``HOROVOD_THREAD_AFFINITY``;
+    returns the set applied, or None when the knob is unset.  ``setter``
+    is injectable for tests (defaults to ``os.sched_setaffinity``)."""
+    spec = os.environ.get("HOROVOD_THREAD_AFFINITY")
+    if not spec:
+        return None
+    try:
+        sets = parse_affinity(spec)
+    except ValueError as e:
+        hvd_logging.warning("ignoring HOROVOD_THREAD_AFFINITY: %s", e)
+        return None
+    cores = sets[local_rank % len(sets)]
+    setter = setter or (lambda c: os.sched_setaffinity(0, c))
+    try:
+        setter(cores)
+        hvd_logging.info("pinned process to cores %s (local rank %d)",
+                         sorted(cores), local_rank)
+        return cores
+    except OSError as e:  # pragma: no cover - cores absent on this host
+        hvd_logging.warning("could not set CPU affinity %s: %s",
+                            sorted(cores), e)
+        return None

@@ -1,0 +1,37 @@
+"""CPU affinity knob (reference ``HOROVOD_THREAD_AFFINITY``,
+``common.cc parse_and_set_affinity``)."""
+
+import pytest
+
+from horovod_tpu.utils.affinity import parse_affinity, set_affinity_from_env
+
+
+class TestParse:
+    def test_ranges_and_lists(self):
+        assert parse_affinity("0-3;4,6;7") == [
+            {0, 1, 2, 3}, {4, 6}, {7}]
+
+    def test_rejects_junk(self):
+        with pytest.raises(ValueError):
+            parse_affinity("3-1")
+        with pytest.raises(ValueError):
+            parse_affinity(";")
+
+
+class TestApply:
+    def test_local_rank_selects_set(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_THREAD_AFFINITY", "0-1;2-3")
+        applied = {}
+        set_affinity_from_env(1, setter=lambda c: applied.update(c=c))
+        assert applied["c"] == {2, 3}
+        # more local ranks than sets wraps around
+        set_affinity_from_env(2, setter=lambda c: applied.update(c=c))
+        assert applied["c"] == {0, 1}
+
+    def test_unset_is_noop(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_THREAD_AFFINITY", raising=False)
+        assert set_affinity_from_env(0, setter=lambda c: 1 / 0) is None
+
+    def test_bad_spec_warns_not_raises(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_THREAD_AFFINITY", "not-cores")
+        assert set_affinity_from_env(0, setter=lambda c: 1 / 0) is None

@@ -189,6 +189,36 @@ class TestTwoProcessWorld:
         assert out.returncode == 0, out.stderr[-3000:]
         assert out.stdout.count("CAUGHT_OK") == 2, out.stdout
 
+    def test_collective_output_feeds_next_collective(self, tmp_path):
+        """The natural training loop — w -= lr * allreduce(grad(w)) —
+        feeds a replicated (non-fully-addressable) result straight back
+        into the next eager collective; intake must localize it instead
+        of crashing in device_put (regression: found by
+        examples/adasum_small_model.py)."""
+        out = launch("""
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import jax.numpy as jnp
+            import numpy as np
+            import horovod_tpu as hvd
+
+            hvd.init()
+            r = hvd.process_rank()
+            w = jnp.zeros((4,))
+            for i in range(3):
+                g = hvd.allreduce(w + (r + 1), op=hvd.Average,
+                                  name=f"loop.{i}")
+                w = w - 0.5 * g      # w now spans the global mesh
+            np.testing.assert_allclose(np.asarray(w)[0], -1.3125)
+            # the looped array also feeds broadcast/allgather intakes
+            b = hvd.broadcast(w, root_rank=0, name="loop.bc")
+            gth = hvd.allgather(w[None], name="loop.ag")
+            assert gth.shape == (2, 4)
+            print("WORKER_OK", r)
+        """, tmp_path)
+        assert out.returncode == 0, out.stderr[-3000:]
+        assert out.stdout.count("WORKER_OK") == 2
+
     def test_host_data_plane(self, tmp_path):
         """HOROVOD_TPU_OPERATIONS=HOST routes every eager collective over
         the coordination-service KV store (the Gloo-CPU analogue) with
