@@ -408,6 +408,21 @@ class ElasticDriver:
         if self._shutdown.is_set():
             return
         with self._resume_lock:
+            # Unrecoverable-fast-path: the state carrier rule requires a
+            # previously-assigned host to survive (reference
+            # driver.py:236-242).  If every one of them is blacklisted,
+            # no future discovery output can help — stop now instead of
+            # waiting out the elastic timeout for slots that cannot
+            # carry the state anyway.
+            with self._lock:
+                prev_hosts = {h for h, _ in self._assignments}
+            if prev_hosts and all(self._host_manager.is_blacklisted(h)
+                                  for h in prev_hosts):
+                hvd_logging.warning(
+                    "elastic: every previously-assigned host is "
+                    "blacklisted — model state is lost; stopping job")
+                self.stop(1)
+                return
             try:
                 self.wait_for_available_slots(self._min_np)
             except TimeoutError as e:

@@ -64,7 +64,8 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="extra arguments appended to mpirun")
     p.add_argument("--network-interface", dest="nics",
                    help="comma-separated interfaces to restrict control "
-                        "and data traffic to (skips NIC discovery)")
+                        "and data traffic to (narrows NIC discovery and "
+                        "pins GLOO_SOCKET_IFNAME)")
     p.add_argument("--start-timeout", type=int, default=30)
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--output-filename", dest="output_filename",
@@ -177,12 +178,8 @@ def _discover_coordinator_addr(hosts: List[HostInfo], args) -> str:
     hostnames = [h.hostname for h in hosts]
     if all(_is_local(h) for h in hostnames):
         return _coordinator_addr(hosts)
-    if args.nics:
-        # user-specified interfaces skip discovery entirely (reference
-        # semantics): the coordinator uses the given hostname and the
-        # workers' transports are pinned via GLOO_SOCKET_IFNAME
-        return _coordinator_addr(hosts)
     key = make_secret_key()
+    requested_nics = set(args.nics.split(",")) if args.nics else None
     procs = []
 
     def spawn(host: str, index: int, driver_addrs: str) -> None:
@@ -202,6 +199,17 @@ def _discover_coordinator_addr(hosts: List[HostInfo], args) -> str:
 
     try:
         common, driver = discover_common_interfaces(hostnames, spawn, key)
+        if requested_nics is not None:
+            # --network-interface: the user's list wins, but the probe
+            # still supplies rank-0's IP on that interface (the launcher
+            # cannot know it otherwise) and fails loudly if the requested
+            # interface is not mutually routable
+            narrowed = [i for i in common if i in requested_nics]
+            if not narrowed:
+                raise RuntimeError(
+                    f"--network-interface {args.nics} matches none of "
+                    f"the mutually-routable interfaces {common}")
+            common = narrowed
         try:
             rank0 = driver.task_address(0)
             iface = next(i for i in common if i in rank0)

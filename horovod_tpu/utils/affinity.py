@@ -60,7 +60,16 @@ def set_affinity_from_env(local_rank: int,
     except ValueError as e:
         hvd_logging.warning("ignoring HOROVOD_THREAD_AFFINITY: %s", e)
         return None
-    cores = sets[local_rank % len(sets)]
+    if local_rank >= len(sets):
+        # never silently share a core set between co-located workers —
+        # that is the exact contention pinning exists to prevent (the
+        # reference raises when the list is shorter than local size)
+        hvd_logging.warning(
+            "HOROVOD_THREAD_AFFINITY has %d core set(s) but this is "
+            "local rank %d — not pinning; provide one set per local "
+            "rank", len(sets), local_rank)
+        return None
+    cores = sets[local_rank]
     setter = setter or (lambda c: os.sched_setaffinity(0, c))
     try:
         setter(cores)

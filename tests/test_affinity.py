@@ -24,9 +24,12 @@ class TestApply:
         applied = {}
         set_affinity_from_env(1, setter=lambda c: applied.update(c=c))
         assert applied["c"] == {2, 3}
-        # more local ranks than sets wraps around
-        set_affinity_from_env(2, setter=lambda c: applied.update(c=c))
-        assert applied["c"] == {0, 1}
+
+    def test_too_few_sets_never_shares(self, monkeypatch):
+        """A spec shorter than the local world must not silently pin two
+        workers to the same cores (the contention pinning prevents)."""
+        monkeypatch.setenv("HOROVOD_THREAD_AFFINITY", "0-1;2-3")
+        assert set_affinity_from_env(2, setter=lambda c: 1 / 0) is None
 
     def test_unset_is_noop(self, monkeypatch):
         monkeypatch.delenv("HOROVOD_THREAD_AFFINITY", raising=False)

@@ -146,7 +146,7 @@ def write_discovery_script(path, logfile, schedule):
 
 def run_elastic(tmp_path, discovery_schedule, np=1, min_np=1, max_np=2,
                 exit_schedule=None, exit_mode="exception", epochs=3,
-                timeout=420):
+                timeout=420, extra_args=(), extra_env=None):
     logfile = tmp_path / "log.jsonl"
     disc = tmp_path / "discover.sh"
     write_discovery_script(disc, logfile, discovery_schedule)
@@ -161,11 +161,13 @@ def run_elastic(tmp_path, discovery_schedule, np=1, min_np=1, max_np=2,
     env.pop("HOROVOD_TPU_MESH_SHAPE", None)
     env["HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT"] = "5"
     env["HOROVOD_ELASTIC_START_TIMEOUT"] = "90"
+    env.update(extra_env or {})
 
     cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
            "-np", str(np), "--min-np", str(min_np), "--max-np", str(max_np),
            "--host-discovery-script", str(disc),
            "--output-filename", str(out_dir),
+           *extra_args,
            "--", sys.executable, str(train),
            "--logfile", str(logfile),
            "--epochs", str(epochs),
@@ -224,6 +226,46 @@ class TestElasticEndToEnd:
         # all three generations (2 batches/epoch x 3 epochs, average of
         # ones is ones regardless of world size)
         assert results[2]["w"] == pytest.approx(6.0)
+
+    def test_all_ranks_failure_fails_job(self, tmp_path):
+        """Every host failing leaves no state carrier — the launcher must
+        exit non-zero, not hang (reference ``test_all_ranks_failure``)."""
+        schedule = [(None, ["localhost:1", "127.0.0.1:1"])]
+        proc, results = run_elastic(
+            tmp_path, schedule, np=2, min_np=1, max_np=2,
+            exit_schedule={"1,0": [0, 1]}, exit_mode="exception",
+            timeout=300)
+        assert proc.returncode != 0
+        assert len(results) == 1    # only epoch 0 completed
+
+    def test_reset_limit_stops_job(self, tmp_path):
+        """--reset-limit bounds recovery attempts (reference
+        ``--reset-limit`` + registry reset counting)."""
+        schedule = [(None, ["localhost:1", "127.0.0.1:1"])]
+        # first failure consumes the one allowed reset; the second one
+        # (start_rank 1, now sole survivor, fails at epoch 2) stops the
+        # job with a non-zero exit
+        proc, _ = run_elastic(
+            tmp_path, schedule, np=2, min_np=1, max_np=2,
+            exit_schedule={"1,0": [0], "2,0": [1]},
+            extra_args=("--reset-limit", "1"), timeout=300)
+        assert proc.returncode != 0, proc.stdout[-2000:]
+
+    def test_host_data_plane_survives_churn(self, tmp_path):
+        """HOROVOD_TPU_OPERATIONS=HOST under elastic growth: the KV-store
+        transport's call counters must re-align across the generation
+        switch (they reset with the world)."""
+        schedule = [
+            (0, ["localhost:1"]),
+            (None, ["localhost:1", "127.0.0.1:1"]),
+        ]
+        proc, results = run_elastic(
+            tmp_path, schedule,
+            extra_env={"HOROVOD_TPU_OPERATIONS": "HOST"})
+        assert proc.returncode == 0, (
+            proc.stderr[-3000:] + worker_logs(tmp_path))
+        assert [r["size"] for r in results] == [1, 2, 2], results
+        assert results[-1]["w"] == pytest.approx(6.0)
 
     @pytest.mark.parametrize("exit_mode", ["exception", "kill"])
     def test_single_rank_failure(self, tmp_path, exit_mode):
