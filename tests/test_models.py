@@ -1,0 +1,54 @@
+"""Model zoo sanity: shapes, dtypes, stem variants.
+
+The throughput path is exercised by ``bench.py`` /
+``examples/synthetic_benchmark.py`` on hardware; these tests pin the
+model-surface contracts cheaply on CPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.models.resnet import ResNet50
+
+
+class TestResNet:
+    @pytest.mark.parametrize("s2d", [False, True])
+    def test_forward_shapes(self, s2d):
+        model = ResNet50(num_classes=10, dtype=jnp.float32,
+                         space_to_depth=s2d)
+        x = jnp.zeros((2, 64, 64, 3))
+        params = model.init(jax.random.PRNGKey(0), x, train=False)
+        out = model.apply(params, x, train=False)
+        assert out.shape == (2, 10)
+        assert out.dtype == jnp.float32   # head stays fp32
+
+    def test_space_to_depth_rearrange_preserves_pixels(self):
+        """The stem's 2x2 rearrange must be a pure pixel shuffle: every
+        input value appears exactly once in the (H/2, W/2, 4C) layout."""
+        x = jnp.arange(2 * 8 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 8, 3)
+        n, h, w, c = x.shape
+        y = x.reshape(n, h // 2, 2, w // 2, 2, c) \
+             .transpose(0, 1, 3, 2, 4, 5) \
+             .reshape(n, h // 2, w // 2, 4 * c)
+        assert y.shape == (2, 4, 4, 12)
+        np.testing.assert_array_equal(
+            np.sort(np.asarray(y).ravel()), np.sort(np.asarray(x).ravel()))
+        # block (0,0) holds the original 2x2 pixel neighborhood
+        np.testing.assert_array_equal(
+            np.asarray(y)[0, 0, 0].reshape(2, 2, 3), np.asarray(x)[0, :2, :2])
+
+    def test_grad_flows(self):
+        model = ResNet50(num_classes=4, dtype=jnp.bfloat16,
+                         space_to_depth=True)
+        x = jnp.ones((2, 32, 32, 3))
+        params = model.init(jax.random.PRNGKey(0), x, train=False)
+
+        def loss(p):
+            return jnp.sum(model.apply(p, x, train=False).astype(
+                jnp.float32))
+
+        g = jax.grad(loss)(params)
+        leaves = jax.tree_util.tree_leaves(g)
+        assert leaves and all(jnp.isfinite(l).all() for l in leaves)
