@@ -50,6 +50,19 @@ class BottleneckBlock(nn.Module):
         return self.act(residual + y)
 
 
+def space_to_depth_2x2(x):
+    """(N, H, W, C) → (N, H/2, W/2, 4C) pixel shuffle for the TPU stem;
+    pure rearrangement — every input value appears exactly once."""
+    n, h, w, c = x.shape
+    if h % 2 or w % 2:
+        raise ValueError(
+            f"space_to_depth stem requires even spatial dims, got "
+            f"({h}, {w})")
+    return x.reshape(n, h // 2, 2, w // 2, 2, c) \
+            .transpose(0, 1, 3, 2, 4, 5) \
+            .reshape(n, h // 2, w // 2, 4 * c)
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     num_classes: int = 1000
@@ -71,10 +84,7 @@ class ResNet(nn.Module):
 
         x = x.astype(self.dtype)
         if self.space_to_depth:
-            n, h, w, c = x.shape
-            x = x.reshape(n, h // 2, 2, w // 2, 2, c) \
-                 .transpose(0, 1, 3, 2, 4, 5) \
-                 .reshape(n, h // 2, w // 2, 4 * c)
+            x = space_to_depth_2x2(x)
             x = conv(self.num_filters, (4, 4), (1, 1),
                      padding="SAME", name="conv_init")(x)
         else:

@@ -25,19 +25,21 @@ class TestResNet:
         assert out.dtype == jnp.float32   # head stays fp32
 
     def test_space_to_depth_rearrange_preserves_pixels(self):
-        """The stem's 2x2 rearrange must be a pure pixel shuffle: every
-        input value appears exactly once in the (H/2, W/2, 4C) layout."""
+        """The stem's 2x2 rearrange (the model's own helper) must be a
+        pure pixel shuffle: every input value appears exactly once and
+        each output pixel holds its 2x2 source neighborhood."""
+        from horovod_tpu.models.resnet import space_to_depth_2x2
+
         x = jnp.arange(2 * 8 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 8, 3)
-        n, h, w, c = x.shape
-        y = x.reshape(n, h // 2, 2, w // 2, 2, c) \
-             .transpose(0, 1, 3, 2, 4, 5) \
-             .reshape(n, h // 2, w // 2, 4 * c)
+        y = space_to_depth_2x2(x)
         assert y.shape == (2, 4, 4, 12)
         np.testing.assert_array_equal(
             np.sort(np.asarray(y).ravel()), np.sort(np.asarray(x).ravel()))
         # block (0,0) holds the original 2x2 pixel neighborhood
         np.testing.assert_array_equal(
             np.asarray(y)[0, 0, 0].reshape(2, 2, 3), np.asarray(x)[0, :2, :2])
+        with pytest.raises(ValueError, match="even spatial"):
+            space_to_depth_2x2(jnp.zeros((1, 7, 8, 3)))
 
     def test_grad_flows(self):
         model = ResNet50(num_classes=4, dtype=jnp.bfloat16,
