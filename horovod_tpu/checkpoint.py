@@ -48,7 +48,12 @@ class Checkpointer:
             try:
                 import orbax.checkpoint  # noqa: F401
 
-                use_orbax = True
+                # orbax's CheckpointManager is collective: __init__ and
+                # save() run global barriers over jax.distributed, which
+                # deadlocks against this class's rank-0-only contract
+                # (the reference's "checkpoint on rank 0" rule).  Use
+                # orbax single-process; the pickle layout multi-process.
+                use_orbax = jax.process_count() == 1
             except ImportError:
                 use_orbax = False
         self._use_orbax = use_orbax
@@ -97,21 +102,31 @@ class Checkpointer:
     # -- read ---------------------------------------------------------------
 
     def all_steps(self) -> list:
-        if self._manager is not None:
-            return list(self._manager.all_steps())
+        """Steps present on disk, in EITHER layout.  The write format
+        depends on availability and process count, but a run resumed or
+        evaluated with a different process count must still find its
+        existing checkpoints — reads are layout-agnostic."""
         if not os.path.isdir(self._dir):
             return []
-        if self._use_orbax:
-            # Non-root ranks have no CheckpointManager (orbax's manager
-            # coordinates saves across hosts; constructing it everywhere
-            # while only rank 0 saves would desynchronize its barriers).
-            # checkpoint_steps lists only *finalized* steps, so a non-root
-            # restore can never pick a step rank 0 is still writing.
-            from orbax.checkpoint import utils as ocp_utils
+        steps = set(self._pickle_steps())
+        if self._manager is not None:
+            steps.update(int(s) for s in self._manager.all_steps())
+        else:
+            # Non-root ranks / pickle writers still list orbax-finalized
+            # steps (checkpoint_steps only reports finalized ones, so a
+            # reader can never pick a step rank 0 is mid-writing).
+            try:
+                from orbax.checkpoint import utils as ocp_utils
 
-            return [int(s) for s in ocp_utils.checkpoint_steps(self._dir)]
+                steps.update(int(s)
+                             for s in ocp_utils.checkpoint_steps(self._dir))
+            except ImportError:
+                pass
+        return sorted(steps)
+
+    def _pickle_steps(self) -> list:
         return [int(d.split("_", 1)[1]) for d in os.listdir(self._dir)
-                if d.startswith("step_")]
+                if d.startswith("step_") and d.split("_", 1)[1].isdigit()]
 
     def latest_step(self) -> Optional[int]:
         steps = self.all_steps()
@@ -124,23 +139,25 @@ class Checkpointer:
             step = self._resolve_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self._dir}")
-        if self._use_orbax:
-            import orbax.checkpoint as ocp
+        # layout-agnostic: read whichever format holds this step
+        pkl = os.path.join(self._dir, f"step_{step}", "state.pkl")
+        if os.path.exists(pkl):
+            with open(pkl, "rb") as f:
+                return pickle.load(f)
+        import orbax.checkpoint as ocp
 
-            host_target = jax.tree_util.tree_map(
-                lambda x: np.asarray(x) if hasattr(x, "shape") else x,
-                target)
-            if self._manager is not None:
-                return self._manager.restore(
-                    step, args=ocp.args.StandardRestore(host_target))
-            # Non-root: plain per-host read of the shared directory; no
-            # cross-host coordination needed for a restore.  Layout is the
-            # manager's: <dir>/<step>/default.
-            return ocp.StandardCheckpointer().restore(
-                os.path.join(self._dir, str(step), "default"), host_target)
-        with open(os.path.join(self._dir, f"step_{step}",
-                               "state.pkl"), "rb") as f:
-            return pickle.load(f)
+        host_target = jax.tree_util.tree_map(
+            lambda x: np.asarray(x) if hasattr(x, "shape") else x,
+            target)
+        if self._manager is not None and \
+                step in set(self._manager.all_steps()):
+            return self._manager.restore(
+                step, args=ocp.args.StandardRestore(host_target))
+        # Non-root / cross-layout: plain per-host read of the shared
+        # directory; no cross-host coordination needed for a restore.
+        # Layout is the manager's: <dir>/<step>/default.
+        return ocp.StandardCheckpointer().restore(
+            os.path.join(self._dir, str(step), "default"), host_target)
 
     def _resolve_step(self) -> Optional[int]:
         """Pick the latest step, agreed across ranks.

@@ -232,8 +232,10 @@ class Estimator:
                     # still yields a full, device-divisible batch
                     idx = np.concatenate(
                         [idx, np.resize(perm, global_bs - len(idx))])
-                batch = step.shard_batch({"x": to_dev(take(x, idx)),
-                                          "y": jnp.asarray(y[idx])})
+                # host arrays go straight in: shard_batch feeds each
+                # process's addressable shards from the numpy buffers
+                batch = step.shard_batch({"x": take(x, idx),
+                                          "y": y[idx]})
                 loop.params, loop.opt_state, train_loss = step(
                     loop.params, loop.opt_state, batch)
                 cbs.on_batch_end(b, loop, logs)

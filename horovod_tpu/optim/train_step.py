@@ -27,6 +27,7 @@ from typing import Callable, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -143,8 +144,33 @@ class DistributedTrainStep:
         return params, opt_state
 
     def shard_batch(self, batch):
-        """Place a host batch onto the mesh sharded along the data axis."""
-        return jax.device_put(batch, self._batch_sharding)
+        """Place a host batch onto the mesh sharded along the data axis.
+
+        ``batch`` is the *global* batch, identical on every process (the
+        reference's data-parallel contract: each worker reads the full
+        shuffled stream and consumes its slice).  Multi-process, each
+        process materializes only the rows its addressable devices own
+        (``make_array_from_callback``) — no cross-process value
+        broadcast/compare and no redundant full-batch transfer, which
+        ``device_put`` onto a partially-addressable sharding would do."""
+        if jax.process_count() == 1:
+            return jax.device_put(batch, self._batch_sharding)
+        sharding = self._batch_sharding
+
+        def to_global(arr):
+            if isinstance(arr, jax.Array) and \
+                    len(arr.sharding.device_set) > 1:
+                # already global: keep device_put's idempotent semantics
+                return jax.device_put(arr, sharding)
+            # host path: feed each addressable shard straight from the
+            # numpy buffer — no extra device round-trips (callers should
+            # pass host arrays; a single-device jax.Array costs one D2H)
+            if not isinstance(arr, np.ndarray):
+                arr = np.asarray(arr)
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx: arr[idx])
+
+        return jax.tree_util.tree_map(to_global, batch)
 
     def __call__(self, params, opt_state, batch):
         return self._step(params, opt_state, batch)

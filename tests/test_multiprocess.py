@@ -260,6 +260,51 @@ class TestTwoProcessWorld:
         assert out.returncode == 0, out.stderr[-3000:]
         assert out.stdout.count("WORKER_OK") == 2
 
+    def test_estimator_distributed_fit(self, tmp_path):
+        """Estimator.fit on a real 2-process world: the run id is
+        broadcast from rank 0, store writes happen on rank 0 only, and
+        both ranks converge to identical parameters."""
+        store_dir = tmp_path / "store"
+        out = launch(f"""
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import pandas as pd
+            import flax.linen as nn
+            import horovod_tpu as hvd
+            from horovod_tpu.spark import Estimator, Store
+
+            class Net(nn.Module):
+                @nn.compact
+                def __call__(self, x):
+                    return nn.Dense(3)(nn.relu(nn.Dense(8)(x)))
+
+            rng = np.random.RandomState(0)
+            x = rng.rand(64, 4).astype(np.float32)
+            y = (x @ rng.rand(4, 3)).argmax(1).astype(np.int32)
+            df = pd.DataFrame({{"f1": x[:, 0], "f2": x[:, 1],
+                                "f3": x[:, 2], "f4": x[:, 3], "label": y}})
+            store = Store.create({str(store_dir)!r})
+            est = Estimator(Net(), feature_cols=["f1", "f2", "f3", "f4"],
+                            label_col="label", batch_size=4, epochs=2,
+                            store=store, validation_fraction=0.25)
+            model = est.fit(df)
+            # params must be identical across ranks (broadcast + synced
+            # training); compare a digest via allgather
+            leaf = np.asarray(jax.tree_util.tree_leaves(model.params)[0],
+                              np.float32)
+            digests = hvd.allgather_object(float(np.abs(leaf).sum()))
+            assert digests[0] == digests[1], digests
+            print("WORKER_OK", hvd.process_rank())
+        """, tmp_path)
+        assert out.returncode == 0, out.stderr[-3000:]
+        assert out.stdout.count("WORKER_OK") == 2
+        # rank-0-only store writes produced exactly one run layout
+        runs = sorted((store_dir / "runs").iterdir())
+        assert [r.name for r in runs] == ["run_001"], runs
+        assert (store_dir / "runs/run_001/metadata.json").exists()
+        assert (store_dir / "intermediate_train_data").exists()
+
     def test_worker_failure_fails_job(self, tmp_path):
         out = launch("""
             import os, sys
