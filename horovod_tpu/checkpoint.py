@@ -56,6 +56,14 @@ class Checkpointer:
                 use_orbax = jax.process_count() == 1
             except ImportError:
                 use_orbax = False
+        elif use_orbax and jax.process_count() > 1:
+            raise ValueError(
+                "use_orbax=True is not supported in multi-process runs: "
+                "orbax's CheckpointManager is collective (global barriers "
+                "in __init__/save) and this Checkpointer writes on rank 0 "
+                "only — the job would deadlock at the first save. Leave "
+                "use_orbax unset (the pickle layout is chosen "
+                "automatically; reads remain layout-agnostic).")
         self._use_orbax = use_orbax
         self._manager = None
         if _is_root():
@@ -92,7 +100,10 @@ class Checkpointer:
         return True
 
     def _gc(self) -> None:
-        steps = sorted(self.all_steps())
+        # rank retention over the pickle layout only — mixing in orbax
+        # step numbers could delete a just-written pickle step while
+        # never pruning the (manager-owned) orbax dirs
+        steps = sorted(self._pickle_steps())
         for s in steps[:-self._max_to_keep]:
             import shutil
 
@@ -144,6 +155,10 @@ class Checkpointer:
         if os.path.exists(pkl):
             with open(pkl, "rb") as f:
                 return pickle.load(f)
+        if step not in self.all_steps():
+            raise FileNotFoundError(
+                f"no checkpoint for step {step} in {self._dir} "
+                f"(available: {self.all_steps()})")
         import orbax.checkpoint as ocp
 
         host_target = jax.tree_util.tree_map(

@@ -159,8 +159,12 @@ class DistributedTrainStep:
 
         def to_global(arr):
             if isinstance(arr, jax.Array) and \
-                    len(arr.sharding.device_set) > 1:
-                # already global: keep device_put's idempotent semantics
+                    not arr.sharding.is_fully_addressable:
+                # already global (spans other processes): keep
+                # device_put's idempotent semantics.  Fully-addressable
+                # arrays — including ones spread over this process's
+                # local devices — take the host path below, which works
+                # for any local layout.
                 return jax.device_put(arr, sharding)
             # host path: feed each addressable shard straight from the
             # numpy buffer — no extra device round-trips (callers should

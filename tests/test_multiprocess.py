@@ -260,6 +260,78 @@ class TestTwoProcessWorld:
         assert out.returncode == 0, out.stderr[-3000:]
         assert out.stdout.count("WORKER_OK") == 2
 
+    def test_negotiation_observability(self, tmp_path):
+        """The timeline records one NEGOTIATE instant per controller
+        cycle with the cache outcome, and hvd.cache_stats() counts hits
+        and misses (reference NEGOTIATE phases + response-cache stats)."""
+        out = launch(f"""
+            import os
+            os.environ["HOROVOD_TIMELINE"] = \
+                str({str(tmp_path)!r}) + "/tl." + \
+                os.environ["HOROVOD_RANK"] + ".json"
+            os.environ["HOROVOD_TIMELINE_PYTHON"] = "1"
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import jax.numpy as jnp
+            import horovod_tpu as hvd
+
+            hvd.init()
+            for i in range(3):
+                hvd.allreduce(jnp.ones(2), op=hvd.Sum, name="obs")
+            stats = hvd.cache_stats()
+            assert stats["misses"] >= 1 and stats["hits"] >= 2, stats
+            hvd.shutdown()
+            print("WORKER_OK")
+        """, tmp_path)
+        assert out.returncode == 0, out.stderr[-3000:]
+        assert out.stdout.count("WORKER_OK") == 2
+        import json
+
+        events = json.loads((tmp_path / "tl.0.json").read_text())
+        neg = [e for e in events if e.get("name") == "NEGOTIATE"]
+        assert len(neg) >= 3
+        outcomes = {e["args"]["cache"] for e in neg}
+        assert outcomes == {"hit", "miss"}, outcomes
+        assert all("cycle" in e["args"] and "joined" in e["args"]
+                   for e in neg)
+
+    def test_train_step_across_processes(self, tmp_path):
+        """DistributedTrainStep on a real 2-process world: host batches
+        are sharded by addressable rows (make_array_from_callback path)
+        and both ranks step to the identical loss."""
+        out = launch("""
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import jax.numpy as jnp
+            import optax
+            import horovod_tpu as hvd
+
+            hvd.init()
+
+            def loss_fn(params, batch):
+                return jnp.mean((batch["x"] @ params - batch["y"]) ** 2)
+
+            step = hvd.DistributedTrainStep(loss_fn, optax.sgd(0.1))
+            params, opt_state = step.init(jnp.zeros((4,)))
+            x = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+            y = x @ np.ones(4, np.float32)
+            losses = []
+            for _ in range(3):
+                b = step.shard_batch({"x": x, "y": y})
+                params, opt_state, loss = step(params, opt_state, b)
+                losses.append(float(loss))
+            assert losses[0] > losses[-1] > 0
+            agreed = hvd.allgather_object(losses)
+            assert agreed[0] == agreed[1], agreed
+            # shard_batch is idempotent on already-global arrays
+            b2 = step.shard_batch(step.shard_batch({"x": x, "y": y}))
+            params, _, _ = step(params, opt_state, b2)
+            print("WORKER_OK", hvd.process_rank())
+        """, tmp_path)
+        assert out.returncode == 0, out.stderr[-3000:]
+        assert out.stdout.count("WORKER_OK") == 2
+
     def test_estimator_distributed_fit(self, tmp_path):
         """Estimator.fit on a real 2-process world: the run id is
         broadcast from rank 0, store writes happen on rank 0 only, and
