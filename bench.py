@@ -110,7 +110,9 @@ def main():
         img_secs.append(global_bs * args.num_batches_per_iter / dt)
         log(f"bench: iter {it}: {img_secs[-1]:.1f} img/sec total")
 
-    per_chip = float(np.mean(img_secs)) / n_chips
+    # median across iters: robust to single-iteration tunnel/scheduler
+    # hiccups (observed ±3% run-to-run drift, PERF_NOTES.md)
+    per_chip = float(np.median(img_secs)) / n_chips
     # MFU: fwd+bwd ≈ 3 × 4.1 GFLOP/img at 224px (scaled for other sizes).
     # PERF_NOTES.md derives why the structural ceiling for this model on
     # v5e is ≈26% MFU (HBM-bound).
