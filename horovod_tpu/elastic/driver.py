@@ -68,6 +68,10 @@ class ElasticDriver:
         self._lock = threading.RLock()
         self._assignments: Dict[Tuple[str, int], SlotInfo] = {}
         self._abort_events: Dict[Tuple[str, int], threading.Event] = {}
+        # per-spawn token so a startup watchdog armed for an earlier
+        # spawn of the same (host, local_rank) slot cannot fail a newer
+        # worker that reuses the key (see _check_started)
+        self._spawn_tokens: Dict[Tuple[str, int], int] = {}
         # workers that asked for a generation newer than the current one
         # (worker-initiated re-rendezvous, see _handle)
         self._regen_requests: set = set()
@@ -309,37 +313,54 @@ class ElasticDriver:
         # WorkerReadyRequest / the rendezvous GET) so a worker hung in
         # startup is observable — the round-1 design marked workers ready
         # at spawn, making a wedged startup look healthy forever.
-        self._registry.record_spawned(slot.hostname, slot.local_rank)
         abort = threading.Event()
+        key = (slot.hostname, slot.local_rank)
+        # token first, SPAWNED second: a stale watchdog firing in between
+        # fails its token check; the reverse order would let it see the
+        # new worker's SPAWNED state while the token still matches its own
         with self._lock:
-            self._abort_events[(slot.hostname, slot.local_rank)] = abort
+            self._abort_events[key] = abort
+            token = self._spawn_tokens.get(key, 0) + 1
+            self._spawn_tokens[key] = token
+        self._registry.record_spawned(slot.hostname, slot.local_rank)
         thread = threading.Thread(
-            target=self._run_worker, args=(slot, abort), daemon=True,
+            target=self._run_worker, args=(slot, abort, token), daemon=True,
             name=f"hvd_tpu_elastic_worker_{slot.rank}")
         thread.start()
         watchdog = threading.Timer(
-            self._start_timeout, self._check_started, args=(slot,))
+            self._start_timeout, self._check_started, args=(slot, token))
         watchdog.daemon = True
         watchdog.start()
 
-    def _check_started(self, slot: SlotInfo) -> None:
+    def _check_started(self, slot: SlotInfo, token: int) -> None:
         """Startup watchdog: a worker that never reported READY within the
         start timeout is treated as a startup failure (blacklist + resume),
         the reference's start-timeout semantics
-        (``runner/elastic/settings.py`` elastic start timeout)."""
+        (``runner/elastic/settings.py`` elastic start timeout).
+
+        ``token`` pins the watchdog to the spawn that armed it: a slot
+        removed by scale-down and re-spawned at the same (host,
+        local_rank) within start_timeout is again SPAWNED when the stale
+        timer fires — without the token it would fail the new worker."""
         from horovod_tpu.elastic.registration import SPAWNED
 
         if self._shutdown.is_set():
             return
+        with self._lock:
+            if self._spawn_tokens.get(
+                    (slot.hostname, slot.local_rank)) != token:
+                return
         if self._registry.get_state(slot.hostname, slot.local_rank) == SPAWNED:
             hvd_logging.warning(
                 "elastic: worker %s:%d never reported ready within %.0fs — "
                 "treating as startup failure",
                 slot.hostname, slot.local_rank, self._start_timeout)
-            self.record_worker_exit(slot.hostname, slot.local_rank, 1)
+            self.record_worker_exit(slot.hostname, slot.local_rank, 1,
+                                    token=token)
 
     def _run_worker(self, slot: SlotInfo,
-                    abort: Optional[threading.Event] = None) -> None:
+                    abort: Optional[threading.Event] = None,
+                    token: Optional[int] = None) -> None:
         with self._lock:
             coordinator = self._coordinator_addr
             generation = self._generation
@@ -354,7 +375,8 @@ class ElasticDriver:
             hvd_logging.warning("elastic: worker rank %d crashed in "
                                 "launcher: %s", slot.rank, e)
             exit_code = 1
-        self.record_worker_exit(slot.hostname, slot.local_rank, exit_code)
+        self.record_worker_exit(slot.hostname, slot.local_rank, exit_code,
+                                token=token)
 
     def _abort_workers(self, keys) -> None:
         """Fire abort events so the launcher kills the worker process
@@ -368,19 +390,43 @@ class ElasticDriver:
             ev.set()
 
     def record_worker_exit(self, host: str, local_rank: int,
-                           exit_code: int) -> None:
+                           exit_code: int,
+                           token: Optional[int] = None) -> None:
         """Reference ``_handle_worker_exit``: zero → success (job completes
         when every assigned worker succeeded); non-zero → blacklist +
         resume with survivors.  Exits from workers without a current rank
         assignment (scale-down removals, already-blacklisted hosts) are
         ignored (reference ``driver.py:292-296``) — otherwise a gracefully
-        removed worker's exit would blacklist its still-healthy host."""
+        removed worker's exit would blacklist its still-healthy host.
+
+        ``token``, when given, pins the exit to the spawn that produced
+        it: a slot removed and re-spawned at the same (host, local_rank)
+        key can otherwise have the *old* worker's late exit recorded
+        against the *new* worker — exit 0 would mark it SUCCESS (and
+        could complete the job mid-training), non-zero would blacklist
+        its healthy host."""
         with self._lock:
+            if token is not None and \
+                    self._spawn_tokens.get((host, local_rank)) != token:
+                hvd_logging.debug(
+                    "elastic: ignoring exit code %d from superseded spawn "
+                    "of %s:%d", exit_code, host, local_rank)
+                return
             if (host, local_rank) not in self._assignments:
                 hvd_logging.debug(
                     "elastic: ignoring exit code %d from unassigned worker "
                     "%s:%d", exit_code, host, local_rank)
                 return
+        if self._host_manager.is_blacklisted(host):
+            # one incident, one reset: the first failure on this host
+            # blacklisted it and queued the resume; its sibling workers'
+            # exits (aborted, or crashing on the dead host) must not each
+            # burn a --reset-limit slot, and a straggler exit 0 from a
+            # blacklisted host must not count toward job completion.
+            hvd_logging.debug(
+                "elastic: ignoring exit code %d from blacklisted host "
+                "%s:%d", exit_code, host, local_rank)
+            return
         if exit_code == 0:
             self._registry.record_success(host, local_rank)
             with self._lock:
@@ -392,10 +438,20 @@ class ElasticDriver:
                 self._finished.set()
                 self._shutdown.set()
         else:
+            # record_failure's check-and-set is atomic: it returns False
+            # when the worker is already FAILURE — e.g. the startup
+            # watchdog recorded the failure and the aborted process's
+            # real exit lands before resume() purges the assignment.  A
+            # second count would halve the effective --reset-limit and
+            # queue a redundant resume.
+            if not self._registry.record_failure(host, local_rank):
+                hvd_logging.debug(
+                    "elastic: ignoring duplicate failure exit %d from "
+                    "%s:%d", exit_code, host, local_rank)
+                return
             hvd_logging.warning(
                 "elastic: worker %s:%d exited with code %d",
                 host, local_rank, exit_code)
-            self._registry.record_failure(host, local_rank)
             # the whole host is blacklisted: kill its other workers too
             with self._lock:
                 siblings = [k for k in self._abort_events if k[0] == host]
@@ -444,11 +500,20 @@ class ElasticDriver:
                 self._spawn(slot)
             self._notify_workers_host_changes(HostUpdateResult.mixed)
             # give de-assigned workers a grace window to self-retire via
-            # the rendezvous (clean exit 0), then force-kill stragglers
+            # the rendezvous (clean exit 0), then force-kill stragglers.
+            # Capture the Event objects NOW: resolving keys at fire time
+            # would abort a worker re-spawned at the same (host,
+            # local_rank) during the grace window, since _spawn
+            # overwrites _abort_events entries.
             if removed:
+                with self._lock:
+                    stale_events = [self._abort_events[k] for k in removed
+                                    if k in self._abort_events]
+
                 def _reap():
                     self._shutdown.wait(30.0)
-                    self._abort_workers(removed)
+                    for ev in stale_events:
+                        ev.set()
 
                 threading.Thread(target=_reap, daemon=True,
                                  name="hvd_tpu_elastic_reaper").start()

@@ -54,16 +54,25 @@ class WorkerStateRegistry:
         with self._lock:
             self._states[(host, local_rank)] = SUCCESS
 
-    def record_failure(self, host: str, local_rank: int) -> None:
+    def record_failure(self, host: str, local_rank: int) -> bool:
         """A worker exited non-zero: blacklist its host once failures
         exceed its slot count is NOT the reference rule — the reference
         blacklists immediately on failure exit (``driver.py:291-307``) and
-        resumes with the survivors."""
+        resumes with the survivors.
+
+        Returns False (and does nothing) when the worker is already in
+        FAILURE — the check-and-set is atomic under the registry lock so
+        two concurrent exit records for the same incident (startup
+        watchdog + the aborted process's real exit) cannot both
+        increment reset_count or queue two resumes."""
         with self._lock:
+            if self._states.get((host, local_rank)) == FAILURE:
+                return False
             self._states[(host, local_rank)] = FAILURE
             self._failure_count += 1
         self._host_manager.blacklist(host)
         self._maybe_resume()
+        return True
 
     def _maybe_resume(self) -> None:
         with self._lock:

@@ -128,6 +128,14 @@ _JOIN_UNSUPPORTED = {
 _JOIN_ZERO_OPS = (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.ADASUM)
 
 
+def _join_bad_op_error(op_name: str) -> str:
+    """One shared message for active and joined ranks — the error-cycle
+    contract is that every rank raises the identical error."""
+    return (f"Allreduce op {op_name} is not supported with Join: zero "
+            f"contributions from joined ranks have no identity under "
+            f"{op_name}.")
+
+
 class _Negotiation:
     """Outcome of one controller cycle."""
 
@@ -253,14 +261,24 @@ def _negotiate(desc: Optional[dict], join_cycle: int = -1) -> _Negotiation:
     bad = [p for p in active
            if not (heads[p, 2:] == heads[ref, 2:]).all()]
     if desc is None:
-        # Joined rank: when active ranks disagree they all raise and no
-        # collective runs — return no descriptor so the join service loop
-        # does not emulate a collective nobody will issue.
-        if not bad and not seen:
+        # Joined rank: when active ranks disagree they all raise and
+        # stop issuing collectives — re-entering the head exchange would
+        # block forever.  The mismatch is computable right here from the
+        # gathered heads (the same data the active ranks used), so raise
+        # the error on this rank too: the reference controller delivers
+        # the error response on every rank (``controller.cc:380``).
+        if bad:
+            raise HorovodInternalError(
+                f"Mismatched collective across processes while this "
+                f"process (rank {jax.process_index()}) was in join(): "
+                f"process(es) {bad} disagree with process {ref} on the "
+                f"name/dtype/shape/op for this collective slot. All "
+                f"processes must issue identical collectives in "
+                f"identical order.")
+        if not seen:
             _validated_signatures.add(ref_digest)
             _desc_cache[ref_digest] = shared_desc
-        return _Negotiation(False, -1, joined,
-                            None if bad else shared_desc)
+        return _Negotiation(False, -1, joined, shared_desc)
     if bad:
         raise HorovodInternalError(
             f"Mismatched {desc.get('kind')} across processes: process "
@@ -290,10 +308,7 @@ def _negotiate(desc: Optional[dict], join_cycle: int = -1) -> _Negotiation:
             raise HorovodInternalError(_JOIN_UNSUPPORTED[kind])
         if kind == "allreduce" and \
                 ReduceOp[desc["op"]] not in _JOIN_ZERO_OPS:
-            raise HorovodInternalError(
-                f"Allreduce op {desc['op']} is not supported with Join: "
-                f"zero contributions from joined ranks have no identity "
-                f"under {desc['op']}.")
+            raise HorovodInternalError(_join_bad_op_error(desc["op"]))
     return _Negotiation(False, -1, joined, shared_desc)
 
 
@@ -872,8 +887,13 @@ def join() -> int:
     divides by the full world size, exactly like the reference's
     postscale-1/size) and ``barrier``.  ``allgather``/``broadcast``/
     ``alltoall`` from non-joined ranks raise the reference's
-    "not supported with Join" errors on those ranks
-    (``controller.cc:487-497,569``).  Ragged *per-step* participation
+    "not supported with Join" errors — on those ranks AND out of this
+    loop (the reference delivers error responses on every rank,
+    ``controller.cc:380``; a fatally-erroring peer must not leave
+    joined processes blocking forever).  The error cycle completes its
+    wire exchanges everywhere before anyone raises, so ranks that catch
+    the error stay aligned and may re-enter ``join()``.  Ragged
+    *per-step* participation
     inside a jitted train step is handled by zero-masking instead (see
     ``horovod_tpu.optim.join_step``).
     """
@@ -890,12 +910,19 @@ def join() -> int:
         if neg.all_joined:
             return neg.last_rank
         d = neg.desc
-        if d is None:
-            continue  # active ranks errored; nothing will execute
-        if d.get("kind") == "allreduce":
+        if d is None:  # pragma: no cover - _negotiate raises on mismatch
+            continue
+        kind = d.get("kind")
+        # Active ranks raise on join-unsupported collectives and then
+        # stop issuing cycles; raise the identical error here instead of
+        # blocking forever in the next head exchange (reference delivers
+        # error responses on every rank, ``controller.cc:487-497,569``).
+        if kind in _JOIN_UNSUPPORTED:
+            raise HorovodInternalError(_JOIN_UNSUPPORTED[kind])
+        if kind == "allreduce":
             op = ReduceOp[d["op"]]
             if op not in _JOIN_ZERO_OPS:
-                continue  # active ranks raised; no collective runs
+                raise HorovodInternalError(_join_bad_op_error(d["op"]))
             from horovod_tpu.ops import op_manager
 
             zeros = jnp.zeros((d["n"],), jnp.dtype(d["dtype"]))

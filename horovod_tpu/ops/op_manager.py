@@ -117,6 +117,7 @@ class HostOps:
         self._counter = 0
         self._written: "collections.deque" = collections.deque()
         self._lock = threading.Lock()
+        self._pool = None   # lazy, reused across calls (thread churn)
 
     def reset(self) -> None:
         """Forget counter + pending GC — the elastic world reset.  Every
@@ -127,6 +128,9 @@ class HostOps:
         with self._lock:
             self._counter = 0
             self._written.clear()
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     def _client(self):
         from jax._src import distributed as dist
@@ -160,17 +164,57 @@ class HostOps:
                 pass
 
     def _exchange(self, sends: dict, recv_keys: list) -> List[bytes]:
-        """Write ``sends`` {key: bytes}, blocking-read ``recv_keys``."""
+        """Write ``sends`` {key: bytes}, blocking-read ``recv_keys``.
+
+        Reads are issued concurrently so a collective costs one
+        round-trip of latency, not ``nproc`` sequential round trips —
+        the flat-latency property the reference's Gloo ring has
+        (``ops/gloo_operations.cc:119``).
+
+        GC safety requires every call to read at least one key written
+        by *every other* process: observing process p's call-K key
+        proves p entered call K, hence finished all call K-1 reads,
+        hence no reader can still be inside call K-1 when this process
+        reaches call K+1 and deletes K-1 keys (see class docstring).
+        Callers must pass ``recv_keys`` covering all peers.
+        """
         client = self._client()
         call = self._next_call()
         written = []
         for k, v in sends.items():
             client.key_value_set_bytes(f"hvdhost/{call}/{k}", v)
             written.append(f"hvdhost/{call}/{k}")
-        out = [client.blocking_key_value_get_bytes(
-            f"hvdhost/{call}/{k}", self.TIMEOUT_MS) for k in recv_keys]
+        get = lambda k: client.blocking_key_value_get_bytes(  # noqa: E731
+            f"hvdhost/{call}/{k}", self.TIMEOUT_MS)
+        if len(recv_keys) <= 1:
+            out = [get(k) for k in recv_keys]
+        else:
+            out = self._pool_map(get, recv_keys)
         self._gc_and_record(client, call, written)
         return out
+
+    def _pool_map(self, fn, keys: list) -> list:
+        """Concurrent map on the cached pool; a concurrent ``reset()``
+        may shut the pool down between acquisition and map — retry with
+        a fresh pool, falling back to serial reads rather than leaking a
+        RuntimeError the recovery path doesn't treat as recoverable."""
+        for _ in range(2):
+            with self._lock:
+                if self._pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    self._pool = ThreadPoolExecutor(
+                        max_workers=32,
+                        thread_name_prefix="hvd_tpu_host_plane")
+                pool = self._pool
+            try:
+                return list(pool.map(fn, keys))
+            except RuntimeError:
+                with self._lock:
+                    if self._pool is pool:
+                        self._pool = None
+                continue
+        return [fn(k) for k in keys]
 
     @staticmethod
     def _decode(raw: bytes, like: np.ndarray) -> np.ndarray:
@@ -209,12 +253,15 @@ class HostOps:
         tensor = np.ascontiguousarray(np.asarray(tensor))
         if nproc == 1:
             return tensor
-        # O(data): only the root uploads a payload; everyone reads the
-        # root's key.  Non-roots publish an empty marker so the call/GC
-        # bookkeeping stays uniform.
+        # O(data): only the root uploads a payload; non-roots publish an
+        # empty marker.  Every process reads every peer's key (payload
+        # from root, markers from the rest) — the marker reads are what
+        # keep the GC invariant (see _exchange): without them a fast
+        # root could finish, advance two calls, and delete keys a slow
+        # peer is still blocking on.
         sends = {str(rank): tensor.tobytes() if rank == root_rank else b""}
-        (raw,) = self._exchange(sends, [str(root_rank)])
-        return self._decode(raw, tensor)
+        rows = self._exchange(sends, [str(p) for p in range(nproc)])
+        return self._decode(rows[root_rank], tensor)
 
     def alltoall_slots(self, slots, nproc: int, rank: int) -> list:
         slots = np.ascontiguousarray(np.asarray(slots))

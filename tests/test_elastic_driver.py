@@ -248,6 +248,46 @@ class TestElasticDriver:
         workers.finish_all(0)
         assert driver.wait_for_completion() == 0
 
+    def test_duplicate_failure_exit_not_double_counted(self, monkeypatch):
+        """The startup watchdog records a failure, then the aborted
+        process's real non-zero exit lands before resume() purges the
+        assignment: the second exit must not increment reset_count again
+        (it would halve the effective --reset-limit) or queue a
+        redundant resume."""
+        workers = _BlockingWorkers()
+        driver = make_driver({"h1": 1, "h2": 1}, min_np=1)
+        driver.start(2, workers)
+        assert wait_until(lambda: len(workers.started) == 2)
+        # freeze resume so the h2 assignment stays in place between the
+        # two exit records, exposing the double-count window
+        resumes = []
+        monkeypatch.setattr(driver, "resume", lambda: resumes.append(1))
+        driver.record_worker_exit("h2", 0, 1)   # watchdog-style record
+        assert driver.registry.reset_count == 1 and len(resumes) == 1
+        driver.record_worker_exit("h2", 0, 1)   # real process exit lands
+        assert driver.registry.reset_count == 1, "failure double-counted"
+        assert len(resumes) == 1, "redundant resume queued"
+        driver.stop(0)
+
+    def test_stale_watchdog_token_is_noop(self):
+        """A watchdog armed for an earlier spawn of the same (host,
+        local_rank) must not fail a re-spawned worker that is again in
+        SPAWNED state when the stale timer fires."""
+        workers = _BlockingWorkers()
+        driver = make_driver({"h1": 1}, min_np=1, start_timeout=3600.0)
+        driver.start(1, workers)
+        assert wait_until(lambda: len(workers.started) == 1)
+        slot = driver.get_slot_info("h1", 0)
+        current = driver._spawn_tokens[("h1", 0)]
+        # stale token from a prior spawn: must be ignored
+        driver._check_started(slot, current - 1)
+        assert not driver.host_manager.is_blacklisted("h1")
+        # the matching token does fail the still-SPAWNED worker
+        driver._check_started(slot, current)
+        assert wait_until(
+            lambda: driver.host_manager.is_blacklisted("h1"), timeout=15)
+        driver.stop(0)
+
     def test_worker_initiated_rerendezvous(self):
         """When every assigned worker asks for a generation newer than
         the current one (collective failure the driver cannot observe),
