@@ -1,19 +1,25 @@
-"""Driver benchmark: ResNet-50 synthetic training throughput.
+"""Driver benchmark: ResNet-50 + transformer-LM synthetic training throughput.
 
-TPU-native counterpart of the reference's headline benchmark
-(``examples/tensorflow2_synthetic_benchmark.py``, ResNet-50 synthetic
-data, img/sec — ``docs/benchmarks.rst:66-80``).  Trains
-:class:`horovod_tpu.models.resnet.ResNet50` with
-``DistributedTrainStep`` on whatever devices are present (one real TPU
-chip under the driver) and prints ONE JSON line::
+TPU-native counterpart of the reference's synthetic benchmarks
+(``examples/tensorflow2_synthetic_benchmark.py`` /
+``examples/pytorch_synthetic_benchmark.py`` — ResNet, synthetic data,
+img/sec; ``docs/benchmarks.rst:66-80``).  Trains both flagship models
+with ``DistributedTrainStep`` on whatever devices are present (one real
+TPU chip under the driver) and prints ONE JSON line::
 
     {"metric": "resnet50_img_sec_per_chip", "value": N, "unit": "img/sec/chip",
-     "vs_baseline": N}
+     "vs_baseline": N, "mfu": N,
+     "transformer_tokens_per_sec": N, "transformer_mfu": N, ...}
 
 ``vs_baseline`` compares against the only absolute per-accelerator
 throughput the reference publishes: ResNet-101 at 1,656.82 img/sec on 16
 Pascal P100s (``docs/benchmarks.rst:43``) → 103.55 img/sec per GPU.
 (The reference's other numbers are scaling efficiencies; BASELINE.md.)
+
+The transformer entry (183.8M params, 12L/1024d, seq 1024, bf16, Pallas
+flash attention fwd+bwd) is the long-context flagship; it makes the
+flash-backward speedup a driver-scored, re-measurable artifact rather
+than prose in PERF_NOTES.md.
 """
 
 import argparse
@@ -34,10 +40,174 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def hw_peak_flops():
+    """Per-chip peak bf16 TFLOP/s for MFU, or None off-TPU/unknown."""
+    if jax.devices()[0].platform != "tpu":
+        return None
+    kind = jax.devices()[0].device_kind.lower()
+    peaks = {"v5 lite": 197e12, "v5e": 197e12, "v4": 275e12,
+             "v5p": 459e12, "v5": 459e12, "v6 lite": 918e12,
+             "v6e": 918e12}
+    return next((p for k, p in peaks.items() if k in kind), None)
+
+
+def median_rate(step_fn, state, warmup_batches, iters, batches_per_iter,
+                units_per_batch, label):
+    """Warm up (compile), then median units/sec across ``iters`` timed
+    iterations.
+
+    Fences on a host fetch of the loss, not ``jax.block_until_ready``:
+    through remote-device tunnels block_until_ready can return before
+    the step finishes, silently inflating rates; a scalar device_get
+    cannot.  Median is robust to single-iteration tunnel/scheduler
+    hiccups (observed ±3% run-to-run drift, PERF_NOTES.md).
+    """
+    t0 = time.perf_counter()
+    for _ in range(warmup_batches):
+        state = step_fn(state)
+    if warmup_batches:
+        float(state[-1])
+        log(f"bench[{label}]: warmup (incl. compile) "
+            f"{time.perf_counter() - t0:.1f}s, loss={float(state[-1]):.3f}")
+    rates = []
+    for it in range(iters):
+        t0 = time.perf_counter()
+        for _ in range(batches_per_iter):
+            state = step_fn(state)
+        float(state[-1])
+        dt = time.perf_counter() - t0
+        rates.append(units_per_batch * batches_per_iter / dt)
+        log(f"bench[{label}]: iter {it}: {rates[-1]:.1f}/sec")
+    return float(np.median(rates))
+
+
+def run_resnet(args, hvd):
+    from horovod_tpu.models.resnet import ResNet50
+
+    n_chips = hvd.size()
+    platform = jax.devices()[0].platform
+    batch_size, image_size, dtype = \
+        args.batch_size, args.image_size, args.dtype
+    if platform == "cpu" and dtype == "bfloat16":
+        dtype = "float32"            # bf16 is emulated (slow) on host CPU
+        if image_size == 224:
+            image_size = 96          # keep the CPU smoke run tractable
+            batch_size = 16
+    log(f"bench[resnet]: {n_chips} chip(s) on {platform}, "
+        f"batch {batch_size}/chip, {image_size}px, {dtype}")
+
+    compute_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    model = ResNet50(num_classes=1000, dtype=compute_dtype,
+                     space_to_depth=args.space_to_depth)
+
+    def loss_fn(params, batch):
+        logits = model.apply(params, batch["x"], train=False)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    step = hvd.DistributedTrainStep(
+        loss_fn, optax.sgd(0.01 * n_chips, momentum=0.9))
+    x0 = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+    params, opt_state = step.init(
+        model.init(jax.random.PRNGKey(0), x0, train=False))
+
+    global_bs = batch_size * n_chips
+    rng = np.random.RandomState(0)
+    batch = step.shard_batch({
+        "x": jnp.asarray(
+            rng.rand(global_bs, image_size, image_size, 3), jnp.float32),
+        "y": jnp.asarray(rng.randint(0, 1000, (global_bs,)), jnp.int32),
+    })
+
+    per_chip = median_rate(
+        lambda s: step(s[0], s[1], batch), (params, opt_state, None),
+        args.num_warmup_batches, args.num_iters,
+        args.num_batches_per_iter, global_bs, "resnet") / n_chips
+
+    # MFU: fwd+bwd ≈ 3 × 4.1 GFLOP/img at 224px (scaled for other sizes).
+    # PERF_NOTES.md derives why the structural ceiling for this model on
+    # v5e is ≈26% MFU (HBM-bound).
+    flops_per_img = 3 * 4.1e9 * (image_size / 224.0) ** 2
+    peak = hw_peak_flops()
+    return {
+        "metric": "resnet50_img_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "img/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_SEC_PER_ACCEL, 3),
+        "mfu": round(per_chip * flops_per_img / peak, 4) if peak else None,
+        "model_tflops_per_sec": round(per_chip * flops_per_img / 1e12, 1),
+    }
+
+
+def run_transformer(args, hvd):
+    from horovod_tpu.models import TransformerConfig, TransformerLM
+
+    n_chips = hvd.size()
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        # smoke-scale twin for the driver's CPU path / local dev
+        layers, d_model, heads, seq, batch, dtype, attn = \
+            2, 128, 4, 128, 4, jnp.float32, "dense"
+    else:
+        layers, d_model, heads, seq, batch, dtype, attn = (
+            args.tf_layers, args.tf_d_model, args.tf_heads, args.tf_seq_len,
+            args.tf_batch_size, jnp.bfloat16, args.tf_attention)
+    log(f"bench[transformer]: {n_chips} chip(s) on {platform}, "
+        f"{layers}L/{d_model}d, seq {seq}, batch {batch}/chip, "
+        f"attention={attn}")
+
+    cfg = TransformerConfig(
+        vocab_size=32_000, num_layers=layers, num_heads=heads,
+        d_model=d_model, d_ff=4 * d_model, max_seq_len=seq,
+        dtype=dtype, attention_impl=attn)
+    model = TransformerLM(cfg)
+
+    def loss_fn(params, batch):
+        logits = model.apply(params, batch["inputs"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["labels"]).mean()
+
+    step = hvd.DistributedTrainStep(loss_fn, optax.adamw(3e-4))
+    tokens0 = jnp.zeros((1, seq), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens0)
+    nparams = sum(x.size for x in jax.tree_util.tree_leaves(variables))
+    params, opt_state = step.init(variables)
+
+    global_bs = batch * n_chips
+    rng = np.random.RandomState(0)
+    raw = rng.randint(0, cfg.vocab_size, (global_bs, seq + 1))
+    batch_data = step.shard_batch({
+        "inputs": jnp.asarray(raw[:, :-1], jnp.int32),
+        "labels": jnp.asarray(raw[:, 1:], jnp.int32),
+    })
+
+    log(f"bench[transformer]: {nparams / 1e6:.1f}M params")
+    tokens_per_chip_sec = median_rate(
+        lambda s: step(s[0], s[1], batch_data), (params, opt_state, None),
+        args.num_warmup_batches, args.num_iters,
+        args.num_batches_per_iter, global_bs * seq, "transformer") / n_chips
+
+    # fwd+bwd FLOPs/token: 6·P (params incl. the tied embedding head,
+    # whose 6·V·d logits share stands in for the lookup) + causal
+    # attention ≈ 6·L·T·d (QKᵀ + AV, fwd 4·T·d + bwd 8·T·d, halved by
+    # the causal mask).  Matches PERF_NOTES.md's ≈62 TF/s at 54k tok/s.
+    flops_per_token = 6 * nparams + 6 * layers * seq * d_model
+    peak = hw_peak_flops()
+    tf_s = tokens_per_chip_sec * flops_per_token
+    return {
+        "transformer_tokens_per_sec": round(tokens_per_chip_sec, 1),
+        "transformer_mfu": round(tf_s / peak, 4) if peak else None,
+        "transformer_tflops_per_sec": round(tf_s / 1e12, 1),
+        "transformer_params_m": round(nparams / 1e6, 1),
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
+    p.add_argument("--model", default="both",
+                   choices=["both", "resnet", "transformer"])
     p.add_argument("--batch-size", type=int, default=256,
-                   help="per-chip batch size")
+                   help="ResNet per-chip batch size")
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--num-iters", type=int, default=5)
     p.add_argument("--num-batches-per-iter", type=int, default=10)
@@ -50,90 +220,25 @@ def main():
                         "saw ~+2%%; does not reproduce outside noise on "
                         "this chip, so the reference stem stays the "
                         "default for metric fidelity)")
+    p.add_argument("--tf-layers", type=int, default=12)
+    p.add_argument("--tf-d-model", type=int, default=1024)
+    p.add_argument("--tf-heads", type=int, default=16)
+    p.add_argument("--tf-seq-len", type=int, default=1024)
+    p.add_argument("--tf-batch-size", type=int, default=8,
+                   help="transformer per-chip batch size")
+    p.add_argument("--tf-attention", default="flash",
+                   choices=["dense", "flash"])
     args = p.parse_args()
 
     import horovod_tpu as hvd
-    from horovod_tpu.models.resnet import ResNet50
 
     hvd.init()
-    n_chips = hvd.size()
-    platform = jax.devices()[0].platform
-    if platform == "cpu" and args.dtype == "bfloat16":
-        args.dtype = "float32"       # bf16 is emulated (slow) on host CPU
-        if args.image_size == 224:
-            args.image_size = 96     # keep the CPU smoke run tractable
-            args.batch_size = 16
-    log(f"bench: {n_chips} chip(s) on {platform}, "
-        f"batch {args.batch_size}/chip, {args.image_size}px, {args.dtype}")
-
-    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    model = ResNet50(num_classes=1000, dtype=compute_dtype,
-                     space_to_depth=args.space_to_depth)
-
-    def loss_fn(params, batch):
-        logits = model.apply(params, batch["x"], train=False)
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits, batch["y"]).mean()
-
-    step = hvd.DistributedTrainStep(
-        loss_fn, optax.sgd(0.01 * n_chips, momentum=0.9))
-    x0 = jnp.zeros((1, args.image_size, args.image_size, 3), jnp.float32)
-    params, opt_state = step.init(
-        model.init(jax.random.PRNGKey(0), x0, train=False))
-
-    global_bs = args.batch_size * n_chips
-    rng = np.random.RandomState(0)
-    batch = step.shard_batch({
-        "x": jnp.asarray(
-            rng.rand(global_bs, args.image_size, args.image_size, 3),
-            jnp.float32),
-        "y": jnp.asarray(rng.randint(0, 1000, (global_bs,)), jnp.int32),
-    })
-
-    t0 = time.perf_counter()
-    for _ in range(args.num_warmup_batches):
-        params, opt_state, loss = step(params, opt_state, batch)
-    # fence on a host fetch of the loss, not jax.block_until_ready: through
-    # remote-device tunnels block_until_ready can return before the step
-    # finishes, silently inflating rates; a scalar device_get cannot
-    float(loss)
-    log(f"bench: warmup (incl. compile) {time.perf_counter() - t0:.1f}s, "
-        f"loss={float(loss):.3f}")
-
-    img_secs = []
-    for it in range(args.num_iters):
-        t0 = time.perf_counter()
-        for _ in range(args.num_batches_per_iter):
-            params, opt_state, loss = step(params, opt_state, batch)
-        float(loss)
-        dt = time.perf_counter() - t0
-        img_secs.append(global_bs * args.num_batches_per_iter / dt)
-        log(f"bench: iter {it}: {img_secs[-1]:.1f} img/sec total")
-
-    # median across iters: robust to single-iteration tunnel/scheduler
-    # hiccups (observed ±3% run-to-run drift, PERF_NOTES.md)
-    per_chip = float(np.median(img_secs)) / n_chips
-    # MFU: fwd+bwd ≈ 3 × 4.1 GFLOP/img at 224px (scaled for other sizes).
-    # PERF_NOTES.md derives why the structural ceiling for this model on
-    # v5e is ≈26% MFU (HBM-bound).
-    flops_per_img = 3 * 4.1e9 * (args.image_size / 224.0) ** 2
-    mfu = None
-    if platform == "tpu":
-        kind = jax.devices()[0].device_kind.lower()
-        peaks = {"v5 lite": 197e12, "v5e": 197e12, "v4": 275e12,
-                 "v5p": 459e12, "v5": 459e12, "v6 lite": 918e12,
-                 "v6e": 918e12}
-        hw_peak = next((p for k, p in peaks.items() if k in kind), None)
-        if hw_peak:
-            mfu = per_chip * flops_per_img / hw_peak
-    print(json.dumps({
-        "metric": "resnet50_img_sec_per_chip",
-        "value": round(per_chip, 2),
-        "unit": "img/sec/chip",
-        "vs_baseline": round(per_chip / BASELINE_IMG_SEC_PER_ACCEL, 3),
-        "mfu": round(mfu, 4) if mfu is not None else None,
-        "model_tflops_per_sec": round(per_chip * flops_per_img / 1e12, 1),
-    }), flush=True)
+    out = {}
+    if args.model in ("both", "resnet"):
+        out.update(run_resnet(args, hvd))
+    if args.model in ("both", "transformer"):
+        out.update(run_transformer(args, hvd))
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

@@ -507,13 +507,24 @@ class ElasticDriver:
             # overwrites _abort_events entries.
             if removed:
                 with self._lock:
-                    stale_events = [self._abort_events[k] for k in removed
-                                    if k in self._abort_events]
+                    stale_events = {k: self._abort_events[k] for k in removed
+                                    if k in self._abort_events}
 
                 def _reap():
                     self._shutdown.wait(30.0)
-                    for ev in stale_events:
+                    for ev in stale_events.values():
                         ev.set()
+                    # drop bookkeeping for slots that stayed de-assigned
+                    # so host churn doesn't grow these dicts without
+                    # bound; a slot re-spawned at the same key in the
+                    # grace window has fresh entries (identity differs)
+                    # and keeps them
+                    with self._lock:
+                        for k, ev in stale_events.items():
+                            if k not in self._assignments:
+                                if self._abort_events.get(k) is ev:
+                                    self._abort_events.pop(k, None)
+                                    self._spawn_tokens.pop(k, None)
 
                 threading.Thread(target=_reap, daemon=True,
                                  name="hvd_tpu_elastic_reaper").start()

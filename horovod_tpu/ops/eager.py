@@ -933,5 +933,6 @@ def join() -> int:
             # elastic host-update sync: participate in the fixed 3-word
             # exchange with zeros ("nothing to report")
             _allgather_host_metadata(np.zeros((3,), np.int64))
-        # barrier / unsupported kinds: the head exchange was the whole
-        # contribution; loop straight back into the next cycle.
+        # barrier: the head exchange was the whole contribution; loop
+        # straight back into the next cycle.  (Unsupported kinds raised
+        # above — they never reach this point.)
