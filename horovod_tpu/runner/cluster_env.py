@@ -91,6 +91,9 @@ def jsm_identity() -> Optional[dict]:
             ("PMIX_RANK", "PMIX_SIZE", "PMIX_LOCAL_RANK", "PMIX_LOCAL_SIZE"),
             ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE",
              "OMPI_COMM_WORLD_LOCAL_RANK", "OMPI_COMM_WORLD_LOCAL_SIZE"),
+            # MPICH hydra (reference supports MPICH, mpi_run.py:117)
+            ("PMI_RANK", "PMI_SIZE",
+             "MPI_LOCALRANKID", "MPI_LOCALNRANKS"),
     ):
         if rank_var in os.environ and size_var in os.environ:
             return {

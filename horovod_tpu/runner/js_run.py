@@ -48,9 +48,9 @@ def generate_jsrun_rankfile(hosts: List[HostInfo], np: int,
     for h in hosts:
         if h.slots > accels:
             raise ValueError(
-                f"Invalid host input, slot count for host "
-                f"'{h.hostname}:{h.slots}' is greater than number of "
-                f"accelerators per host '{accels}'.")
+                f"host '{h.hostname}' requests {h.slots} slots but each "
+                f"node exposes only {accels} accelerator(s); cap its slot "
+                f"count at the per-node accelerator count")
         needed = min(h.slots, remaining)
         validated.append(HostInfo(h.hostname, needed))
         remaining -= needed
@@ -58,7 +58,8 @@ def generate_jsrun_rankfile(hosts: List[HostInfo], np: int,
             break
     if remaining != 0:
         raise ValueError(
-            f"Not enough slots on the hosts to fulfill the {np} requested.")
+            f"the host list provides too few slots for -np {np}: "
+            f"{np - remaining} available across {len(validated)} host(s)")
 
     if path is None:
         fd, path = tempfile.mkstemp(prefix="hvd_jsrun_", suffix=".erf")

@@ -36,28 +36,43 @@ def is_mpirun_installed() -> bool:
     return shutil.which("mpirun") is not None
 
 
-def mpi_implementation_flags(env: Optional[Dict[str, str]] = None
-                             ) -> List[str]:
-    """Implementation-specific placement flags (reference
-    ``_get_mpi_implementation_flags`` detects OpenMPI/SpectrumMPI and
-    errors on anything else — the composed command uses ``-x``/MCA
-    spellings only those implementations understand, and workers derive
-    identity from the OMPI/PMIx env only they set)."""
+def detect_mpi_implementation() -> str:
+    """Identify the installed MPI from ``mpirun --version`` (reference
+    ``_get_mpi_implementation``, ``mpi_run.py:113-130``): ``"openmpi"``,
+    ``"spectrum"``, ``"mpich"``, or ``"unknown"``."""
     try:
         out = subprocess.run(["mpirun", "--version"],
                              capture_output=True, text=True,
                              timeout=10).stdout
     except (OSError, subprocess.TimeoutExpired):
         out = ""
-    if "Open MPI" in out or "OpenRTE" in out or "Spectrum MPI" in out:
+    if "Open MPI" in out or "OpenRTE" in out:
+        return "openmpi"
+    if "Spectrum MPI" in out:
+        return "spectrum"
+    if "MPICH" in out or "HYDRA" in out:
+        return "mpich"
+    return "unknown"
+
+
+def mpi_implementation_flags(env: Optional[Dict[str, str]] = None,
+                             impl: Optional[str] = None) -> List[str]:
+    """Implementation-specific placement flags (reference
+    ``_get_mpi_implementation_flags`` composes per-implementation flag
+    sets for OpenMPI/Spectrum/MPICH, ``mpi_run.py:112-119``).  MPICH's
+    hydra understands ``-bind-to``/``-map-by`` but none of the OpenMPI
+    MCA/``--tag-output`` spellings."""
+    impl = impl or detect_mpi_implementation()
+    if impl in ("openmpi", "spectrum"):
         return ["--allow-run-as-root", "--tag-output",
                 "-bind-to", "none", "-map-by", "slot",
                 "-mca", "pml", "ob1", "-mca", "btl", "^openib"]
+    if impl == "mpich":
+        return ["-bind-to", "none", "-map-by", "slot"]
     raise RuntimeError(
-        "Unsupported MPI implementation for --mpi (need Open MPI or "
-        "IBM Spectrum MPI: the launch uses their -x env forwarding and "
-        "PMIx rank env). Detected: "
-        + (out.splitlines()[0] if out else "no mpirun version output"))
+        "Unsupported MPI implementation for --mpi (need Open MPI, IBM "
+        "Spectrum MPI, or MPICH — the launch relies on their env "
+        "forwarding and per-rank identity env). Detected: " + impl)
 
 
 def mpi_run_command(np: int, hosts: List[HostInfo], command: List[str],
@@ -66,28 +81,44 @@ def mpi_run_command(np: int, hosts: List[HostInfo], command: List[str],
                     nics: Optional[str] = None,
                     extra_mpi_args: Optional[str] = None,
                     ssh_port: Optional[int] = None,
-                    ssh_identity_file: Optional[str] = None) -> List[str]:
-    """Compose the mpirun argv (reference ``mpi_run.py:122-218``)."""
+                    ssh_identity_file: Optional[str] = None,
+                    impl: Optional[str] = None) -> List[str]:
+    """Compose the mpirun argv (reference ``mpi_run.py:122-218``).
+
+    OpenMPI/Spectrum forward env with repeated ``-x VAR``; MPICH's hydra
+    takes one ``-genvlist V1,V2,…`` and spells the NIC filter ``-iface``
+    instead of an MCA knob.
+    """
     import shlex
 
+    impl = impl or detect_mpi_implementation()
     cmd = ["mpirun"]
     cmd += impl_flags if impl_flags is not None \
-        else mpi_implementation_flags(env)
+        else mpi_implementation_flags(env, impl=impl)
     cmd += ["-np", str(np),
             "-H", ",".join(f"{h.hostname}:{h.slots}" for h in hosts)]
     if nics:
-        cmd += ["-mca", "btl_tcp_if_include", nics]
-    if ssh_port or ssh_identity_file:
+        if impl == "mpich":
+            cmd += ["-iface", nics.split(",")[0]]
+        else:
+            cmd += ["-mca", "btl_tcp_if_include", nics]
+    if (ssh_port or ssh_identity_file) and impl != "mpich":
         # mpirun's rsh agent must dial the same ssh settings the user
-        # gave the launcher (reference forwards them via plm_rsh_args)
+        # gave the launcher (reference forwards them via plm_rsh_args;
+        # hydra has no per-arg rsh passthrough — use ~/.ssh/config there)
         rsh = []
         if ssh_port:
             rsh += ["-p", str(ssh_port)]
         if ssh_identity_file:
             rsh += ["-i", ssh_identity_file]
         cmd += ["-mca", "plm_rsh_args", " ".join(rsh)]
-    for var in sorted(env):
-        if var in _FORWARD_EXACT or var.startswith(_FORWARD_PREFIXES):
+    fwd = [var for var in sorted(env)
+           if var in _FORWARD_EXACT or var.startswith(_FORWARD_PREFIXES)]
+    if impl == "mpich":
+        if fwd:
+            cmd += ["-genvlist", ",".join(fwd)]
+    else:
+        for var in fwd:
             cmd += ["-x", var]
     if extra_mpi_args:
         cmd += shlex.split(extra_mpi_args)

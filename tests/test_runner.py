@@ -231,7 +231,7 @@ class TestJsRun:
     def test_rankfile_rejects_oversubscription(self, tmp_path):
         from horovod_tpu.runner.js_run import generate_jsrun_rankfile
 
-        with pytest.raises(ValueError, match="greater than number"):
+        with pytest.raises(ValueError, match="exposes only"):
             generate_jsrun_rankfile(
                 [HostInfo("h", 8)], np=8, path=str(tmp_path / "rf"),
                 cores_per_node=4, threads_per_core=1,
@@ -240,7 +240,7 @@ class TestJsRun:
     def test_rankfile_rejects_too_few_slots(self, tmp_path):
         from horovod_tpu.runner.js_run import generate_jsrun_rankfile
 
-        with pytest.raises(ValueError, match="Not enough slots"):
+        with pytest.raises(ValueError, match="too few slots"):
             generate_jsrun_rankfile(
                 [HostInfo("h", 2)], np=4, path=str(tmp_path / "rf"),
                 cores_per_node=4, threads_per_core=1,
@@ -286,6 +286,63 @@ class TestMpiRun:
         assert "-x HOME" not in s       # only the forwarding allowlist
         assert "--oversubscribe" in s
         assert s.endswith("python train.py")
+
+    def test_mpich_command_composition(self):
+        from horovod_tpu.runner.mpi_run import (
+            mpi_implementation_flags,
+            mpi_run_command,
+        )
+
+        env = {"HOROVOD_COORDINATOR_ADDR": "10.0.0.1:1234",
+               "PYTHONPATH": "/x", "HOME": "/root"}
+        cmd = mpi_run_command(
+            4, [HostInfo("h1", 2), HostInfo("h2", 2)],
+            ["python", "train.py"], env,
+            impl_flags=mpi_implementation_flags(impl="mpich"),
+            nics="eth0,eth1", ssh_port=2222, impl="mpich")
+        s = " ".join(cmd)
+        # hydra spellings only: no OpenMPI MCA/-x/--tag-output args
+        assert s.startswith("mpirun -bind-to none -map-by slot")
+        assert "-mca" not in s and "--tag-output" not in s
+        assert "-iface eth0" in s
+        assert "-genvlist HOROVOD_COORDINATOR_ADDR,PYTHONPATH" in s
+        assert "-x" not in s.split()
+        assert s.endswith("python train.py")
+
+    def test_implementation_detection(self, monkeypatch):
+        import subprocess as sp
+
+        from horovod_tpu.runner import mpi_run
+
+        outputs = {
+            "openmpi": "mpirun (Open MPI) 4.1.4",
+            "spectrum": "mpirun (IBM Spectrum MPI) 10.3",
+            "mpich": "HYDRA build details:\n    Version: 4.1",
+        }
+        for expect, version_text in outputs.items():
+            monkeypatch.setattr(
+                mpi_run.subprocess, "run",
+                lambda *a, _out=version_text, **k: sp.CompletedProcess(
+                    a, 0, stdout=_out, stderr=""))
+            assert mpi_run.detect_mpi_implementation() == expect
+
+    def test_unknown_implementation_rejected(self):
+        from horovod_tpu.runner.mpi_run import mpi_implementation_flags
+
+        with pytest.raises(RuntimeError, match="Unsupported MPI"):
+            mpi_implementation_flags(impl="unknown")
+
+    def test_mpich_identity_env(self, monkeypatch):
+        from horovod_tpu.runner.cluster_env import jsm_identity
+
+        for var in ("PMIX_RANK", "OMPI_COMM_WORLD_RANK", "PMI_RANK"):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv("PMI_RANK", "3")
+        monkeypatch.setenv("PMI_SIZE", "8")
+        monkeypatch.setenv("MPI_LOCALRANKID", "1")
+        monkeypatch.setenv("MPI_LOCALNRANKS", "4")
+        assert jsm_identity() == {
+            "rank": 3, "size": 8, "local_rank": 1, "local_size": 4}
 
     def test_mpi_flag_without_mpirun_errors(self, monkeypatch):
         from horovod_tpu.runner import mpi_run
