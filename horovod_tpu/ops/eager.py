@@ -486,6 +486,11 @@ def _register(name: str, handle: Handle) -> None:
         st.stall_inspector.record_dispatch(name)
 
 
+def _timeline():
+    st = state.global_state() if state.is_initialized() else None
+    return st.timeline if st else None
+
+
 # ---------------------------------------------------------------------------
 # public eager ops
 # ---------------------------------------------------------------------------
@@ -516,6 +521,13 @@ def allreduce_async(tensor, average: Optional[bool] = None,
     name = name or _next_name("allreduce")
     handle = Handle(name)
     _register(name, handle)
+    # per-tensor negotiation phase (reference timeline.h:77-131: every
+    # tensor walks NEGOTIATING → TOP_LEVEL; NegotiateStart fires when the
+    # request enters the system).  The span opens here at enqueue and
+    # closes in _dispatch_group once the cycle's negotiation agrees.
+    tlobj = _timeline()
+    if tlobj is not None:
+        tlobj.start_activity(name, tl.NEGOTIATE)
     tensor = _localize(tensor)
     ctx = None
     if compression is not None:
@@ -534,50 +546,68 @@ def _dispatch_group(entries) -> None:
     run one jitted reduction over the proc mesh.
     """
     nproc = process_mesh().devices.size
-    with tl.activity(entries[0].name, tl.XLA_ALLREDUCE):
-        try:
-            e0 = entries[0]
-            segments = tuple(int(e.tensor.size) for e in entries) \
-                if e0.op == ReduceOp.ADASUM else ()
-            total = int(sum(e.tensor.size for e in entries))
-            if nproc > 1:
-                # Descriptor carries exactly what a joined rank needs to
-                # issue the identical jitted reduction with zero inputs:
-                # flat length, dtype, op, scales, segments.  ``sig`` is the
-                # human-readable slot signature for mismatch errors.
-                _negotiate({
-                    "kind": "allreduce",
-                    "n": total,
-                    "dtype": str(e0.tensor.dtype),
-                    "op": e0.op.name,
-                    "pre": e0.prescale,
-                    "post": e0.postscale,
-                    "segments": segments,
-                    "sig": "; ".join(
-                        f"{e.name}:{e.tensor.dtype}:{tuple(e.tensor.shape)}:"
-                        f"{e.op.name}:{e.prescale}:{e.postscale}"
-                        for e in entries),
-                })
-            # Always reduce the flattened concatenation — a single entry
-            # too — so the compiled program depends only on (n, dtype, op,
-            # scales, segments) and joined ranks can replay it exactly.
-            from horovod_tpu.ops import op_manager
+    tlobj = _timeline()
 
-            flat = jnp.concatenate(
-                [jnp.ravel(e.tensor) for e in entries]) \
-                if len(entries) > 1 else jnp.ravel(e0.tensor)
-            red = op_manager.active_op().reduce_rows(
-                flat, e0.op, e0.prescale, e0.postscale, segments,
-                nproc, jax.process_index())
-            red = jnp.asarray(red)
-            off = 0
+    def _spans_end():
+        if tlobj is not None:
             for e in entries:
-                n = e.tensor.size
-                e.handle._fulfill(red[off:off + n].reshape(e.tensor.shape))
-                off += n
-        except Exception as err:  # surface as HorovodInternalError for elastic
+                tlobj.end_activity(e.name)
+
+    span_open = True        # each entry's NEGOTIATE span opened at enqueue
+    try:
+        e0 = entries[0]
+        segments = tuple(int(e.tensor.size) for e in entries) \
+            if e0.op == ReduceOp.ADASUM else ()
+        total = int(sum(e.tensor.size for e in entries))
+        if nproc > 1:
+            # Descriptor carries exactly what a joined rank needs to
+            # issue the identical jitted reduction with zero inputs:
+            # flat length, dtype, op, scales, segments.  ``sig`` is the
+            # human-readable slot signature for mismatch errors.
+            _negotiate({
+                "kind": "allreduce",
+                "n": total,
+                "dtype": str(e0.tensor.dtype),
+                "op": e0.op.name,
+                "pre": e0.prescale,
+                "post": e0.postscale,
+                "segments": segments,
+                "sig": "; ".join(
+                    f"{e.name}:{e.tensor.dtype}:{tuple(e.tensor.shape)}:"
+                    f"{e.op.name}:{e.prescale}:{e.postscale}"
+                    for e in entries),
+            })
+        # negotiation agreed: close each tensor's NEGOTIATE span and open
+        # its dispatch span (reference NEGOTIATING → TOP_LEVEL → ACTIVITY
+        # transition, timeline.h:77-131 + controller.cc:845-857)
+        _spans_end()
+        if tlobj is not None:
             for e in entries:
-                e.handle._fail(HorovodInternalError(str(err)))
+                tlobj.start_activity(e.name, tl.XLA_ALLREDUCE)
+        # Always reduce the flattened concatenation — a single entry
+        # too — so the compiled program depends only on (n, dtype, op,
+        # scales, segments) and joined ranks can replay it exactly.
+        from horovod_tpu.ops import op_manager
+
+        flat = jnp.concatenate(
+            [jnp.ravel(e.tensor) for e in entries]) \
+            if len(entries) > 1 else jnp.ravel(e0.tensor)
+        red = op_manager.active_op().reduce_rows(
+            flat, e0.op, e0.prescale, e0.postscale, segments,
+            nproc, jax.process_index())
+        red = jnp.asarray(red)
+        off = 0
+        for e in entries:
+            n = e.tensor.size
+            e.handle._fulfill(red[off:off + n].reshape(e.tensor.shape))
+            off += n
+        _spans_end()
+        span_open = False
+    except Exception as err:  # surface as HorovodInternalError for elastic
+        if span_open:
+            _spans_end()
+        for e in entries:
+            e.handle._fail(HorovodInternalError(str(err)))
 
 
 def _fence(x):
@@ -715,10 +745,11 @@ def allgather_with_sizes(tensor, name: Optional[str] = None):
     try:
         with tl.activity(name, tl.XLA_ALLGATHER):
             # first dims may differ per process; everything else must agree
-            _negotiate({
-                "kind": "allgather",
-                "sig": f"{name}:{tensor.dtype}:{tuple(tensor.shape[1:])}",
-            })
+            with tl.activity(name, tl.NEGOTIATE):
+                _negotiate({
+                    "kind": "allgather",
+                    "sig": f"{name}:{tensor.dtype}:{tuple(tensor.shape[1:])}",
+                })
             # negotiate first-dim sizes (the controller's recvcount exchange)
             sizes = _allgather_host_metadata(
                 np.asarray([tensor.shape[0]], np.int64)).reshape(nproc)
@@ -751,11 +782,12 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
     _register(name, handle)
     try:
         with tl.activity(name, tl.XLA_BROADCAST):
-            _negotiate({
-                "kind": "broadcast",
-                "sig": f"{name}:{tensor.dtype}:{tuple(tensor.shape)}:"
-                       f"{root_rank}",
-            })
+            with tl.activity(name, tl.NEGOTIATE):
+                _negotiate({
+                    "kind": "broadcast",
+                    "sig": f"{name}:{tensor.dtype}:{tuple(tensor.shape)}:"
+                           f"{root_rank}",
+                })
             from horovod_tpu.ops import op_manager
 
             out = op_manager.active_op().bcast(
@@ -789,10 +821,11 @@ def alltoall(tensor, splits=None, name: Optional[str] = None):
     _register(name, handle)
     try:
         with tl.activity(name, tl.XLA_ALLTOALL):
-            _negotiate({
-                "kind": "alltoall",
-                "sig": f"{name}:{tensor.dtype}:{tuple(tensor.shape[1:])}",
-            })
+            with tl.activity(name, tl.NEGOTIATE):
+                _negotiate({
+                    "kind": "alltoall",
+                    "sig": f"{name}:{tensor.dtype}:{tuple(tensor.shape[1:])}",
+                })
             all_splits = _allgather_host_metadata(splits)  # (nproc, nproc)
             all_splits = all_splits.reshape(nproc, nproc)
             max_rows = int(all_splits.max())

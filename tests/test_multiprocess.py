@@ -299,12 +299,20 @@ class TestTwoProcessWorld:
         import json
 
         events = json.loads((tmp_path / "tl.0.json").read_text())
-        neg = [e for e in events if e.get("name") == "NEGOTIATE"]
+        neg = [e for e in events
+               if e.get("name") == "NEGOTIATE" and e["ph"] == "i"]
         assert len(neg) >= 3
         outcomes = {e["args"]["cache"] for e in neg}
         assert outcomes == {"hit", "miss"}, outcomes
         assert all("cycle" in e["args"] and "joined" in e["args"]
                    for e in neg)
+        # per-tensor negotiation phases: each of the 3 allreduces opens a
+        # NEGOTIATE span on the tensor's own timeline row at enqueue and
+        # closes it at agreement (reference timeline.h:77-131)
+        spans = [e for e in events
+                 if e.get("name") == "NEGOTIATE" and e["ph"] == "B"]
+        assert len(spans) == 3
+        assert all(e["tid"] == "obs" for e in spans)
 
     def test_train_step_across_processes(self, tmp_path):
         """DistributedTrainStep on a real 2-process world: host batches

@@ -42,6 +42,31 @@ class TestPythonTimeline:
         # timeline, matching the reference's size>1 gate
         assert {e["ph"] for e in events} <= {"B", "E", "i"}
 
+    def test_per_tensor_negotiation_spans(self, tmp_path, hvd_runtime):
+        """Every named tensor gets its own NEGOTIATE span opening at
+        enqueue and closing at negotiation agreement, followed by its
+        dispatch span — the reference's per-tensor NEGOTIATING →
+        TOP_LEVEL state machine (``timeline.h:77-131``,
+        ``controller.cc:845-857``)."""
+        import horovod_tpu as hvd
+
+        path = tmp_path / "tl.json"
+        hvd.start_timeline(str(path))
+        h1 = hvd.allreduce_async(jnp.ones((4,)), name="neg_a")
+        h2 = hvd.allreduce_async(jnp.ones((8,)), name="neg_b")
+        hvd.synchronize(h1)
+        hvd.synchronize(h2)
+        hvd.stop_timeline()
+        events = json.load(open(path))
+        for name in ("neg_a", "neg_b"):
+            rows = [e for e in events if e.get("tid") == name]
+            phases = [(e["ph"], e.get("name")) for e in rows]
+            # B NEGOTIATE, E, B XLA_ALLREDUCE, E — in order, per tensor
+            assert phases == [("B", "NEGOTIATE"), ("E", None),
+                              ("B", "XLA_ALLREDUCE"), ("E", None)], phases
+            # the NEGOTIATE span closes before the dispatch span opens
+            assert rows[1]["ts"] <= rows[2]["ts"]
+
 
 class TestStallInspector:
     def test_warns_on_stalled_op(self, monkeypatch):
