@@ -5,12 +5,14 @@ cluster executors; :class:`~horovod_tpu.estimator.Estimator` (re-exported
 here as the reference exposes estimators under ``horovod.spark.*``)
 offers the fit/transform Pipeline-style API.
 
-When pyspark is not installed, ``run`` falls back to the localhost
-launcher (same contract, same per-rank results) so the API surface works
-everywhere; the Spark path activates automatically when pyspark is
-importable.
+When pyspark is not installed, ``run`` executes the same task-service
+architecture over :class:`~horovod_tpu.spark.local_executor.LocalSparkContext`
+— local spawned workers behind the identical contract — so the Spark
+path itself runs everywhere; a real SparkContext is used automatically
+when pyspark is importable.
 """
 
+from horovod_tpu.spark.local_executor import LocalSparkContext
 from horovod_tpu.spark.runner import run, run_elastic
 from horovod_tpu.spark.store import (
     FilesystemStore,
@@ -19,8 +21,9 @@ from horovod_tpu.spark.store import (
     Store,
 )
 
-__all__ = ["run", "run_elastic", "Estimator", "TpuModel",
-           "Store", "FilesystemStore", "LocalStore", "HDFSStore"]
+__all__ = ["run", "run_elastic", "Estimator", "TpuModel", "Store",
+           "FilesystemStore", "LocalStore", "HDFSStore",
+           "LocalSparkContext"]
 
 
 def __getattr__(name):

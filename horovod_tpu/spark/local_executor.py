@@ -1,0 +1,114 @@
+"""A ``SparkContext`` contract double backed by local worker processes.
+
+``horovod_tpu.spark.run`` touches exactly this much of the pyspark API:
+
+    sc.defaultParallelism
+    sc.parallelize(data, numSlices).mapPartitionsWithIndex(f).collect()
+
+:class:`LocalSparkContext` implements that surface, executing each
+partition function in its own spawned process — the shape of a Spark
+python worker — with the function shipped by cloudpickle exactly as
+Spark ships it.  It serves two roles:
+
+* the executor pool behind ``horovod_tpu.spark.run`` when pyspark is
+  not installed (same RPC architecture, localhost workers);
+* the contract double the Spark-path tests drive the real
+  ``_run_on_spark`` machinery through, playing the part of the
+  reference's ``local[2]`` test runs and fake task services
+  (``/root/reference/test/test_spark.py``,
+  ``/root/reference/test/spark_common.py``).
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import os
+from typing import Any, Callable, List, Sequence
+
+
+def _partition_worker(conn, fn_payload: bytes, index: int,
+                      items: list) -> None:
+    """Spawned-process body: run the cloudpickled partition function."""
+    import cloudpickle
+
+    try:
+        f = cloudpickle.loads(fn_payload)
+        out = list(f(index, iter(items)))
+        conn.send(("ok", out))
+    except BaseException as e:  # noqa: BLE001 - report, don't swallow
+        try:
+            conn.send(("err", f"{type(e).__name__}: {e}"))
+        except Exception:
+            pass
+    finally:
+        conn.close()
+
+
+class LocalSparkContext:
+    """Drop-in for the slice of ``pyspark.SparkContext`` that
+    ``horovod_tpu.spark.run`` uses (see module docstring)."""
+
+    def __init__(self, parallelism: int = 0):
+        self.defaultParallelism = parallelism or os.cpu_count() or 1
+
+    def parallelize(self, data: Sequence, numSlices: int = 0) -> "_RDD":
+        return _RDD(list(data), numSlices or self.defaultParallelism)
+
+
+class _RDD:
+    def __init__(self, data: list, num_slices: int):
+        self._data = data
+        self._n = max(int(num_slices), 1)
+
+    def _partitions(self) -> List[list]:
+        # Spark's contiguous-chunk partitioner: slice i gets
+        # data[floor(i*L/n) : floor((i+1)*L/n)]
+        length = len(self._data)
+        return [self._data[length * i // self._n:
+                           length * (i + 1) // self._n]
+                for i in range(self._n)]
+
+    def mapPartitionsWithIndex(self, f: Callable) -> "_MappedRDD":
+        return _MappedRDD(self._partitions(), f)
+
+
+class _MappedRDD:
+    def __init__(self, partitions: List[list], f: Callable):
+        self._partitions = partitions
+        self._f = f
+
+    def collect(self) -> List[Any]:
+        import cloudpickle
+
+        payload = cloudpickle.dumps(self._f)
+        ctx = multiprocessing.get_context("spawn")
+        workers = []
+        for i, part in enumerate(self._partitions):
+            recv, send = ctx.Pipe(duplex=False)
+            p = ctx.Process(target=_partition_worker,
+                            args=(send, payload, i, part),
+                            name=f"local-spark-worker-{i}", daemon=True)
+            p.start()
+            send.close()
+            workers.append((p, recv))
+
+        out: List[Any] = []
+        errors: List[str] = []
+        for i, (p, recv) in enumerate(workers):
+            msg = None
+            try:
+                msg = recv.recv()
+            except EOFError:
+                pass
+            p.join()
+            if msg is None:
+                errors.append(f"partition {i}: worker died "
+                              f"(exit code {p.exitcode})")
+            elif msg[0] == "err":
+                errors.append(f"partition {i}: {msg[1]}")
+            else:
+                out.extend(msg[1])
+        if errors:
+            raise RuntimeError(
+                "local executor pool job failed:\n  " + "\n  ".join(errors))
+        return out

@@ -3,42 +3,95 @@
 Reference: ``horovod/spark/runner.py`` — ``run(fn, ...):195`` launches a
 Spark job whose tasks become Horovod slots (``_task_fn:47``): each task
 starts a task service, registers its address + host hash with the driver
-service, the driver groups tasks by host into a host list, and the
-normal launcher takes over with command execution routed through the
-task services instead of ssh.  ``run_elastic:303`` wires the same into
-the elastic driver.
+service, the driver groups tasks by host hash into a host list, computes
+rank assignments, and drives execution through the task services instead
+of ssh; per-rank results flow back to the driver
+(``/root/reference/horovod/spark/driver/driver_service.py``,
+``task_service.py``).
 
-The same architecture here, with the TPU launcher underneath.  Without
-pyspark the executor pool degrades to localhost processes — identical
-contract (pickled fn, per-rank return values in rank order), so code
-written against this API runs anywhere.
+Same architecture here, the launcher's pieces underneath: the HMAC
+``BasicService`` RPC plane (``runner/network.py``), host-hash grouping
+through ``runner.hosts.get_host_assignments``, and the
+``jax.distributed`` coordinator env contract that ``hvd.init`` consumes.
+Without pyspark the executor pool degrades to
+:class:`~horovod_tpu.spark.local_executor.LocalSparkContext` — local
+spawned workers behind the identical contract (pickled fn, task
+registration, per-rank return values in rank order), so code written
+against this API runs anywhere and the Spark path itself is what
+executes everywhere.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional
+import os
+import socket
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from horovod_tpu.utils import logging as hvd_logging
 
+#: Seconds to wait for all Spark tasks to register (the reference's
+#: ``--start-timeout``, default 600: executors may need to spin up).
+_START_TIMEOUT_ENV = "HOROVOD_SPARK_START_TIMEOUT"
+
+
+# -- wire messages (module-level so stdlib pickle — the wire format of
+#    ``runner.network.Wire`` — serializes them by reference on both ends
+#    and driver-side isinstance checks match executor instances) --------
 
 class RegisterTask:
-    """Executor → driver: announce (partition index, hostname).
+    """Executor → driver: announce this task's identity and RPC address."""
 
-    Module-level (not nested in ``_run_on_spark``) so stdlib pickle — the
-    wire format of ``runner.network.Wire`` — can serialize instances by
-    reference on both ends, and driver-side ``isinstance`` checks match
-    the class executors actually instantiate.
-    """
-
-    def __init__(self, index, host):
-        self.index, self.host = index, host
+    def __init__(self, index: int, host: str, host_hash: str,
+                 addr: Tuple[str, int]):
+        self.index = index
+        self.host = host
+        self.host_hash = host_hash
+        self.addr = tuple(addr)
 
 
 class TaskResult:
-    """Executor → driver: per-partition return value (see RegisterTask)."""
+    """Executor → driver: per-partition return value (or _TaskError)."""
 
-    def __init__(self, index, value):
+    def __init__(self, index: int, value: Any):
         self.index, self.value = index, value
+
+
+class _TaskError:
+    """Result payload marking a raised exception in the task's fn."""
+
+    def __init__(self, message: str):
+        self.message = message
+
+
+class RunFunction:
+    """Driver → task: execute the job fn under this worker env."""
+
+    def __init__(self, env: Dict[str, str]):
+        self.env = env
+
+
+class ProbePortRequest:
+    """Driver → rank-0 task: pick a free port for the jax.distributed
+    coordinator on your host (the rendezvous-server address)."""
+
+
+class PortResponse:
+    def __init__(self, port: int):
+        self.port = port
+
+
+class ShutdownTask:
+    """Driver → task: job over, stop your service and finish the
+    partition."""
+
+
+def host_hash() -> str:
+    """Physical-host identity for slot grouping (reference
+    ``runner/common/util/host_hash.py``: tasks with equal hashes share a
+    machine and get consecutive local ranks).  ``HOROVOD_SPARK_HOST_HASH``
+    overrides for tests simulating multi-host executor pools."""
+    return os.environ.get("HOROVOD_SPARK_HOST_HASH") or socket.gethostname()
 
 
 def _spark_available() -> bool:
@@ -55,13 +108,20 @@ def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,
     """Execute ``fn`` on ``num_proc`` distributed workers and return the
     per-rank results (reference ``horovod.spark.run``)."""
     if _spark_available():
-        return _run_on_spark(fn, args, kwargs, num_proc, extra_env, verbose)
-    hvd_logging.debug("pyspark not available; spark.run using localhost "
-                      "launcher")
-    from horovod_tpu.runner import run as local_run
+        from pyspark import SparkContext
 
-    return local_run(fn, args=args, kwargs=kwargs, np=num_proc or 1,
-                     extra_env=extra_env, verbose=verbose)
+        sc = SparkContext._active_spark_context
+        if sc is None:
+            raise RuntimeError("no active SparkContext; create a "
+                               "SparkSession before horovod_tpu.spark.run")
+        return _run_on_spark(sc, fn, args, kwargs, num_proc, extra_env,
+                             verbose)
+    hvd_logging.debug("pyspark not available; spark.run using the local "
+                      "executor pool")
+    from horovod_tpu.spark.local_executor import LocalSparkContext
+
+    return _run_on_spark(LocalSparkContext(), fn, args, kwargs,
+                         num_proc or 1, extra_env, verbose)
 
 
 def run_elastic(fn: Callable, args=(), kwargs=None,
@@ -75,67 +135,238 @@ def run_elastic(fn: Callable, args=(), kwargs=None,
             "horovod_tpu.spark.run_elastic requires pyspark; for elastic "
             "training without Spark use the hvdrun elastic launcher "
             "(python -m horovod_tpu.runner.launch --min-np ...)")
-    return _run_on_spark(fn, args, kwargs, num_proc, None, False,
-                         min_np=min_np, max_np=max_np)
-
-
-def _run_on_spark(fn, args, kwargs, num_proc, extra_env, verbose,
-                  min_np=None, max_np=None) -> List[Any]:
-    """The Spark path (reference ``runner.py:195``): parallelize num_proc
-    tasks; each task registers with the driver service and waits for the
-    launcher to drive it."""
-    import cloudpickle
     from pyspark import SparkContext
-
-    from horovod_tpu.runner.network import BasicService, make_secret_key
 
     sc = SparkContext._active_spark_context
     if sc is None:
         raise RuntimeError("no active SparkContext; create a SparkSession "
-                           "before horovod_tpu.spark.run")
+                           "before horovod_tpu.spark.run_elastic")
+    return _run_on_spark(sc, fn, args, kwargs, num_proc, None, False,
+                         min_np=min_np, max_np=max_np)
+
+
+def plan_assignments(registry: Dict[int, RegisterTask], num_proc: int):
+    """Host-hash grouping → rank plan (reference
+    ``driver_service.py task_host_hash_indices`` +
+    ``get_host_assignments``): tasks sharing a host hash become one
+    host's slots, so consecutive ranks land on one machine.
+
+    Returns ``(assignments, slot_index)`` where ``slot_index[rank]`` is
+    the Spark partition index serving that rank.
+    """
+    from horovod_tpu.runner.hosts import HostInfo, get_host_assignments
+
+    by_hash: Dict[str, List[int]] = {}
+    for idx in sorted(registry):
+        by_hash.setdefault(registry[idx].host_hash, []).append(idx)
+    hosts = [HostInfo(hh, len(idxs)) for hh, idxs in sorted(by_hash.items())]
+    assignments = get_host_assignments(hosts, num_proc, num_proc)
+    slot_index = {
+        slot.rank: by_hash[slot.hostname][slot.local_rank]
+        for slot in assignments
+    }
+    return assignments, slot_index
+
+
+def _make_task_fn(driver_addr: Tuple[str, int], key: str, payload: bytes,
+                  run_timeout_s: float) -> Callable:
+    """The partition function Spark ships to executors (reference
+    ``_task_fn:47`` / ``SparkTaskService``)."""
+
+    def _task(index: int, _iterator):
+        import cloudpickle
+
+        from horovod_tpu.runner.network import (
+            AckResponse,
+            BasicClient,
+            BasicService,
+        )
+        from horovod_tpu.spark import runner as _r
+
+        run_req: list = []
+        run_evt = threading.Event()    # fires on RunFunction OR shutdown
+        stop_evt = threading.Event()
+
+        def handle(req):
+            if isinstance(req, _r.RunFunction):
+                run_req.append(req.env)
+                run_evt.set()
+                return AckResponse()
+            if isinstance(req, _r.ProbePortRequest):
+                with socket.socket() as s:
+                    s.bind(("", 0))
+                    return _r.PortResponse(s.getsockname()[1])
+            if isinstance(req, _r.ShutdownTask):
+                stop_evt.set()
+                run_evt.set()          # release a task still waiting
+                return AckResponse()
+            raise ValueError(type(req).__name__)
+
+        service = BasicService(f"spark_task_{index}", key, handle)
+        service.start()
+        try:
+            client = BasicClient(driver_addr, key)
+            client.request(_r.RegisterTask(
+                index, socket.gethostname(), _r.host_hash(),
+                service.address))
+            run_evt.wait(run_timeout_s)
+            if not run_req:
+                if stop_evt.is_set():    # job aborted before our turn
+                    return [index]
+                raise RuntimeError(
+                    f"spark task {index}: no run command from the driver "
+                    f"within {run_timeout_s:.0f}s")
+            os.environ.update(run_req[0])
+            func, fargs, fkwargs = cloudpickle.loads(payload)
+            try:
+                value = func(*fargs, **fkwargs)
+            except BaseException as e:  # noqa: BLE001 - travels to driver
+                value = _r._TaskError(f"{type(e).__name__}: {e}")
+            client.request(_r.TaskResult(index, value))
+            stop_evt.wait(60.0)
+            return [index]
+        finally:
+            service.shutdown()
+
+    return _task
+
+
+def _run_on_spark(sc, fn, args, kwargs, num_proc, extra_env, verbose,
+                  min_np=None, max_np=None) -> List[Any]:
+    """The Spark path (reference ``runner.py:195``): parallelize
+    ``num_proc`` tasks; each starts a task service and registers with the
+    driver service; the driver groups them by host hash, assigns ranks,
+    and commands execution through the task services."""
+    import cloudpickle
+
+    from horovod_tpu.runner.network import (
+        AckResponse,
+        BasicClient,
+        BasicService,
+        make_secret_key,
+    )
+
     num_proc = num_proc or sc.defaultParallelism
+    start_timeout = float(os.environ.get(_START_TIMEOUT_ENV, "600"))
     key = make_secret_key()
     payload = cloudpickle.dumps((fn, tuple(args), dict(kwargs or {})))
 
-    # driver-side registry: executors report (host, partition) -> addr
-    registry: dict = {}
-    results: dict = {}
+    registry: Dict[int, RegisterTask] = {}
+    results: Dict[int, Any] = {}
+    lock = threading.Lock()
+    all_registered = threading.Event()
+    all_results = threading.Event()
 
     def handle(req):
-        from horovod_tpu.runner.network import AckResponse
-
         if isinstance(req, RegisterTask):
-            registry[req.index] = req.host
+            with lock:
+                registry[req.index] = req
+                if len(registry) == num_proc:
+                    all_registered.set()
             return AckResponse()
         if isinstance(req, TaskResult):
-            results[req.index] = req.value
+            with lock:
+                results[req.index] = req.value
+                if len(results) == num_proc:
+                    all_results.set()
             return AckResponse()
         raise ValueError(type(req).__name__)
 
     service = BasicService("spark_driver", key, handle)
     service.start()
-    driver_addr = service.address
+    job_error: List[BaseException] = []
 
-    def _task(index):
-        import os
-        import pickle
-        import socket
+    def _job():
+        # the Spark job itself runs aside (reference _make_spark_thread):
+        # its tasks block in their service loops until commanded, so
+        # collect() cannot return before the driver below finishes
+        try:
+            sc.parallelize(range(num_proc), num_proc) \
+                .mapPartitionsWithIndex(_make_task_fn(
+                    service.address, key, payload, start_timeout)) \
+                .collect()
+        except BaseException as e:  # noqa: BLE001
+            job_error.append(e)
+            all_registered.set()
+            all_results.set()
 
-        from horovod_tpu.runner.network import BasicClient
+    spark_thread = threading.Thread(target=_job, daemon=True,
+                                    name="hvd_tpu_spark_job")
+    spark_thread.start()
 
-        client = BasicClient(driver_addr, key)
-        client.request(RegisterTask(index, socket.gethostname()))
-        func, fargs, fkwargs = cloudpickle.loads(payload)
-        os.environ.setdefault("HOROVOD_RANK", str(index))
-        os.environ.setdefault("HOROVOD_SIZE", str(num_proc))
-        value = func(*fargs, **fkwargs)
-        client.request(TaskResult(index, pickle.loads(
-            pickle.dumps(value))))
-        return [index]
+    def _shutdown_tasks():
+        with lock:
+            regs = list(registry.values())
+        for reg in regs:
+            try:
+                BasicClient(reg.addr, key).request(ShutdownTask())
+            except Exception:
+                pass
 
     try:
-        sc.parallelize(range(num_proc), num_proc).mapPartitionsWithIndex(
-            lambda i, _: _task(i)).collect()
-        return [results[r] for r in range(num_proc)]
+        if not all_registered.wait(start_timeout):
+            raise RuntimeError(
+                f"only {len(registry)}/{num_proc} Spark tasks registered "
+                f"within {start_timeout:.0f}s — the cluster may lack "
+                f"executor capacity for num_proc={num_proc} "
+                f"({_START_TIMEOUT_ENV} raises the wait)")
+        if job_error:
+            raise RuntimeError(
+                f"Spark job failed during startup: {job_error[0]}")
+
+        assignments, slot_index = plan_assignments(registry, num_proc)
+        rank0 = registry[slot_index[0]]
+        port = BasicClient(rank0.addr, key).request(ProbePortRequest()).port
+        head = rank0.host
+        if head in ("localhost", socket.gethostname()):
+            head = "127.0.0.1"
+        coordinator = f"{head}:{port}"
+        if verbose:
+            import sys
+
+            for slot in assignments:
+                print(f"[spark] rank {slot.rank} -> partition "
+                      f"{slot_index[slot.rank]} on "
+                      f"{registry[slot_index[slot.rank]].host} "
+                      f"(local {slot.local_rank}/{slot.local_size})",
+                      file=sys.stderr)
+
+        for slot in assignments:
+            reg = registry[slot_index[slot.rank]]
+            env = dict(extra_env or {})
+            env.update(slot.to_env())
+            # to_env carries the host hash as HOROVOD_HOSTNAME; workers
+            # want the real hostname
+            env["HOROVOD_HOSTNAME"] = reg.host
+            env["HOROVOD_COORDINATOR_ADDR"] = coordinator
+            env["HOROVOD_CONTROLLER"] = "jax"
+            BasicClient(reg.addr, key).request(RunFunction(env))
+
+        while not all_results.wait(1.0):
+            if job_error:
+                raise RuntimeError(f"Spark job failed: {job_error[0]}")
+            if not spark_thread.is_alive() and not all_results.is_set():
+                missing = sorted(set(range(num_proc)) - set(results))
+                raise RuntimeError(
+                    f"Spark job finished but partitions {missing} "
+                    f"returned no result")
+        if job_error:
+            raise RuntimeError(f"Spark job failed: {job_error[0]}")
+
+        _shutdown_tasks()
+        spark_thread.join(30.0)
+
+        failed = {r: v for r, v in
+                  ((slot.rank, results[slot_index[slot.rank]])
+                   for slot in assignments)
+                  if isinstance(v, _TaskError)}
+        if failed:
+            detail = "; ".join(f"rank {r}: {v.message}"
+                               for r, v in sorted(failed.items()))
+            raise RuntimeError(f"spark.run fn raised on "
+                               f"{len(failed)}/{num_proc} ranks: {detail}")
+        return [results[slot_index[slot.rank]]
+                for slot in sorted(assignments, key=lambda s: s.rank)]
     finally:
+        _shutdown_tasks()
         service.shutdown()
