@@ -22,8 +22,21 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from horovod_tpu.spark.params import (
+    HasParams,
+    Param,
+    ParamError,
+    optional,
+    to_bool,
+    to_fraction,
+    to_int,
+    to_positive_int,
+    to_str,
+    to_str_list,
+)
 from horovod_tpu.spark.store import (
     ColSpec,
+    RowGroupReader,
     Store,
     assemble_features,
     extract_columns,
@@ -51,6 +64,30 @@ def _map_leaves(f, x):
     return jax.tree_util.tree_map(f, x)
 
 
+def _tree_concat(a, b):
+    return jax.tree_util.tree_map(
+        lambda u, v: np.concatenate([u, v], axis=0), a, b)
+
+
+def _slice_rows(df, sl: slice):
+    """Row slice of a DataFrame or column dict — the one place the
+    dict-vs-DataFrame branch lives."""
+    if isinstance(df, dict):
+        return {k: v[sl] for k, v in df.items()}
+    return df.iloc[sl]
+
+
+def _head(df, n: int = 1):
+    """First ``n`` rows (schema probes)."""
+    return _slice_rows(df, slice(None, n))
+
+
+def _num_rows(df) -> int:
+    if isinstance(df, dict):
+        return len(next(iter(df.values()))) if df else 0
+    return len(df)
+
+
 @dataclasses.dataclass
 class _Loop:
     """Duck-typed loop object handed to callbacks."""
@@ -59,8 +96,20 @@ class _Loop:
     opt_state: Any = None
 
 
-class TpuModel:
-    """Fitted model (reference ``HorovodModel`` Transformer)."""
+class TpuModel(HasParams):
+    """Fitted model (reference ``HorovodModel`` Transformer).
+
+    Config is a typed param surface (reference ``ModelParams``,
+    ``spark/common/params.py:258``): misassignment raises
+    :class:`~horovod_tpu.spark.params.ParamError` naming the parameter,
+    and ``explain_params()`` lists everything.
+    """
+
+    feature_cols = Param(None, "feature column names", to_str_list)
+    output_col = Param("prediction", "name of the appended output column",
+                       to_str)
+    batch_size = Param(1024, "transform micro-batch size (bounds peak "
+                       "feature memory)", to_positive_int)
 
     def __init__(self, apply_fn: Callable, params: Any,
                  feature_cols: Sequence[str], output_col: str = "prediction",
@@ -68,39 +117,65 @@ class TpuModel:
                  feature_specs: Optional[Sequence[ColSpec]] = None):
         self._apply = apply_fn
         self.params = params
-        self._feature_cols = list(feature_cols)
         self._specs = list(feature_specs) if feature_specs else None
-        self._output_col = output_col
-        self._batch_size = batch_size
+        self.set_params(feature_cols=feature_cols, output_col=output_col,
+                        batch_size=batch_size)
 
     def transform(self, df):
         """Return ``df`` with the model output column appended (reference
-        ``transform`` adds prediction columns to the DataFrame)."""
-        x = _features(df, self._feature_cols, self._specs)
+        ``transform`` adds prediction columns to the DataFrame).
+
+        Features are extracted chunk by chunk, so peak memory is one
+        ``batch_size`` chunk of assembled features plus the prediction
+        column — not a second full copy of the input columns.
+        """
         outs = []
         apply = jax.jit(self._apply)
-        n = len(jax.tree_util.tree_leaves(x)[0])
-        for i in range(0, n, self._batch_size):
-            xb = _map_leaves(
-                lambda v: jnp.asarray(v[i:i + self._batch_size]), x)
+        n = _num_rows(df)
+        for i in range(0, n, self.batch_size):
+            chunk = _slice_rows(df, slice(i, i + self.batch_size))
+            xb = _features(chunk, self.feature_cols, self._specs)
+            xb = _map_leaves(jnp.asarray, xb)
             outs.append(np.asarray(apply(self.params, xb)))
         preds = np.concatenate(outs, axis=0)
         if isinstance(df, dict):
             out = dict(df)
-            out[self._output_col] = preds
+            out[self.output_col] = preds
             return out
         out = df.copy()
-        out[self._output_col] = list(preds)
+        out[self.output_col] = list(preds)
         return out
 
 
-class Estimator:
+class Estimator(HasParams):
     """Fit a model to a DataFrame (reference ``HorovodEstimator``).
 
     ``model`` is a flax module or an ``apply(params, x) -> out`` callable
     paired with ``initial_params``.  ``loss`` maps (output, label batch)
     to a scalar; defaults to softmax cross-entropy on integer labels.
+
+    Config is a typed, introspectable param surface (reference
+    ``EstimatorParams``, ``spark/common/params.py:24``): every declared
+    parameter carries a doc, default and converter; bad values raise
+    :class:`~horovod_tpu.spark.params.ParamError` naming the parameter;
+    ``explain_params()`` lists the full surface, ``set_params(**kw)``
+    bulk-assigns with unknown-name suggestions.
     """
+
+    feature_cols = Param(None, "feature column names", to_str_list)
+    label_col = Param(None, "label column name", to_str)
+    batch_size = Param(32, "per-chip training batch size",
+                       to_positive_int)
+    epochs = Param(1, "training epochs", to_positive_int)
+    validation_fraction = Param(0.0, "trailing fraction of rows held out "
+                                "for validation", to_fraction)
+    streaming = Param(None, "train from row-group shards of the store's "
+                      "parquet instead of in-memory arrays (default: on "
+                      "whenever a store is set)", optional(to_bool))
+    rows_per_group = Param(None, "parquet row-group size — the unit of "
+                           "shard assignment and streaming IO",
+                           optional(to_positive_int))
+    seed = Param(0, "shuffling/init seed", to_int)
 
     def __init__(self, model, feature_cols: Sequence[str], label_col: str,
                  optimizer: Optional[optax.GradientTransformation] = None,
@@ -111,15 +186,13 @@ class Estimator:
                  store: Optional[Any] = None,
                  store_dir: Optional[str] = None,
                  validation_fraction: float = 0.0,
+                 streaming: Optional[bool] = None,
+                 rows_per_group: Optional[int] = None,
                  seed: int = 0):
         self._model = model
-        self._feature_cols = list(feature_cols)
-        self._label_col = label_col
         self._optimizer = optimizer or optax.adam(1e-3)
         self._loss = loss
         self._initial_params = initial_params
-        self._batch_size = batch_size
-        self._epochs = epochs
         self._callbacks = callbacks or []
         # `store` is the reference Estimator's artifact manager
         # (spark/common/store.py: runs/<id>/{checkpoint,logs,metadata} +
@@ -131,8 +204,19 @@ class Estimator:
             store = Store.create(store)
         self._store = store
         self._legacy_ckpt_dir = store_dir if store is None else None
-        self._validation_fraction = validation_fraction
-        self._seed = seed
+        self.set_params(feature_cols=feature_cols, label_col=label_col,
+                        batch_size=batch_size, epochs=epochs,
+                        validation_fraction=validation_fraction,
+                        streaming=streaming, rows_per_group=rows_per_group,
+                        seed=seed)
+
+    @property
+    def _streaming(self) -> bool:
+        # streaming defaults on whenever a store is present, matching the
+        # reference: estimators always train from the store's parquet via
+        # per-worker readers (``spark/keras/remote.py:336``)
+        return self.streaming if self.streaming is not None \
+            else self._store is not None
 
     def _apply_fn(self):
         if hasattr(self._model, "apply"):
@@ -144,16 +228,23 @@ class Estimator:
         from horovod_tpu.callbacks import CallbackList
 
         hvd.init()
-        cols_x, feature_specs = extract_typed(df, self._feature_cols)
-        cols_y, (label_spec,) = extract_typed(df, [self._label_col])
+        if self.streaming and self._store is None:
+            raise ParamError(
+                "streaming=True requires a store: the streamed shards "
+                "are row groups of the store's parquet (pass store=, or "
+                "use fit_on_parquet on existing parquet)")
+        if self._store is not None and self._streaming:
+            return self._fit_via_store(df, hvd)
+        cols_x, feature_specs = extract_typed(df, self.feature_cols)
+        cols_y, (label_spec,) = extract_typed(df, [self.label_col])
         x = assemble_features(cols_x, feature_specs)
-        y = cols_y[self._label_col]
+        y = cols_y[self.label_col]
 
         def take(data, sl):
             return _map_leaves(lambda v: v[sl], data)
 
         n_rows = len(y)
-        n_val = int(n_rows * self._validation_fraction)
+        n_val = int(n_rows * self.validation_fraction)
         if n_val:
             x, x_val = take(x, slice(None, -n_val)), take(x, slice(-n_val,
                                                                    None))
@@ -201,7 +292,7 @@ class Estimator:
         if params is None:
             if not hasattr(self._model, "init"):
                 raise ValueError("pass initial_params for a bare apply fn")
-            params = self._model.init(jax.random.PRNGKey(self._seed),
+            params = self._model.init(jax.random.PRNGKey(self.seed),
                                       to_dev(take(x, slice(0, 1))))
         params = hvd.broadcast_variables(params, root_rank=0)
         params, opt_state = step.init(params)
@@ -217,11 +308,11 @@ class Estimator:
         cbs = CallbackList(self._callbacks)
         cbs.on_train_begin(loop)
 
-        global_bs = self._batch_size * hvd.size()
+        global_bs = self.batch_size * hvd.size()
         nbatches = max(len(y) // global_bs, 1)
-        rng = np.random.RandomState(self._seed)
+        rng = np.random.RandomState(self.seed)
         logs: dict = {}
-        for epoch in range(self._epochs):
+        for epoch in range(self.epochs):
             cbs.on_epoch_begin(epoch, loop, logs)
             perm = rng.permutation(len(y))
             for b in range(nbatches):
@@ -249,5 +340,221 @@ class Estimator:
                 ckpt.save(epoch, {"params": loop.params,
                                   "opt_state": loop.opt_state})
         cbs.on_train_end(loop, logs)
-        return TpuModel(apply_fn, loop.params, self._feature_cols,
+        return TpuModel(apply_fn, loop.params, self.feature_cols,
                         feature_specs=feature_specs)
+
+    # -- streaming path (petastorm-reader analogue) ---------------------
+
+    def _fit_via_store(self, df, hvd) -> TpuModel:
+        """``fit(df)`` with a store: materialize the DataFrame to
+        multi-row-group parquet once (rank 0), then every process trains
+        from its own row-group shard — the reference's flow, where
+        estimators always train from store parquet through per-worker
+        readers (``spark/keras/remote.py:336``,
+        ``spark/common/util.py:697``), never from an in-memory copy of
+        the full dataset per process."""
+        # schema from a head probe; full-data validation happens
+        # group-by-group at read time (extract_columns)
+        _, feature_specs = extract_typed(_head(df), self.feature_cols)
+        _, (label_spec,) = extract_typed(_head(df), [self.label_col])
+        run_id = hvd.broadcast_object(
+            self._store.new_run_id() if hvd.rank() == 0 else None,
+            root_rank=0)
+        n_rows = _num_rows(df)
+        n_val = int(n_rows * self.validation_fraction)
+        rpg = self.rows_per_group or max(self.batch_size, 1)
+        if hvd.rank() == 0:
+            self._store.makedirs(self._store.get_logs_path(run_id))
+            save_metadata(self._store, run_id, feature_specs, label_spec)
+            split = n_rows - n_val
+
+            self._store.write_dataframe(
+                _slice_rows(df, slice(None, split)),
+                self._store.get_train_data_path(), rows_per_group=rpg)
+            if n_val:
+                self._store.write_dataframe(
+                    _slice_rows(df, slice(split, None)),
+                    self._store.get_val_data_path(), rows_per_group=rpg)
+        hvd.barrier()     # readers must not open before the write lands
+        return self._fit_streaming(
+            self._store.get_train_data_path(),
+            self._store.get_val_data_path() if n_val else None,
+            feature_specs, label_spec, hvd, run_id)
+
+    def fit_on_parquet(self, train_path: str, val_path: Optional[str] = None,
+                       feature_specs: Optional[Sequence[ColSpec]] = None,
+                       label_spec: Optional[ColSpec] = None) -> TpuModel:
+        """Fit directly from parquet a Store wrote — the remote-worker
+        entry, no DataFrame in sight.  Without explicit specs the schema
+        is probed from this process's first shard group.  With a store
+        configured, a run layout is still created (metadata +
+        checkpoints); the parquet stays where it is."""
+        import horovod_tpu as hvd
+
+        hvd.init()
+        if feature_specs is None or label_spec is None:
+            probe = RowGroupReader(train_path)
+            my = probe.shard_groups(hvd.process_rank(),
+                                    hvd.process_count())
+            head = _head(probe.read_group(my[0] if my else 0))
+            if feature_specs is None:
+                _, feature_specs = extract_typed(head, self.feature_cols)
+            if label_spec is None:
+                _, (label_spec,) = extract_typed(head, [self.label_col])
+        run_id = None
+        if self._store is not None:
+            # the configured artifact store must not be silently dropped:
+            # checkpoints + metadata get their run layout as in fit()
+            run_id = hvd.broadcast_object(
+                self._store.new_run_id() if hvd.rank() == 0 else None,
+                root_rank=0)
+            if hvd.rank() == 0:
+                self._store.makedirs(self._store.get_logs_path(run_id))
+                save_metadata(self._store, run_id, feature_specs,
+                              label_spec)
+            hvd.barrier()
+        return self._fit_streaming(train_path, val_path, feature_specs,
+                                   label_spec, hvd, run_id)
+
+    def _fit_streaming(self, train_path: str, val_path: Optional[str],
+                       feature_specs, label_spec, hvd, run_id) -> TpuModel:
+        from horovod_tpu.callbacks import CallbackList
+
+        reader = RowGroupReader(train_path)
+        # the reading/sharding unit is the *process* (each feeds all its
+        # addressable devices), not the chip
+        rank, size = hvd.process_rank(), hvd.process_count()
+        if reader.num_row_groups < size:
+            raise ValueError(
+                f"train data at {train_path!r} has "
+                f"{reader.num_row_groups} row group(s) for {size} "
+                f"processes — rewrite with a smaller rows_per_group so "
+                f"every process gets at least one shard group")
+        my_groups = reader.shard_groups(rank, size)
+        rows = reader.group_rows
+        shard_rows = [sum(rows[g] for g in reader.shard_groups(p, size))
+                      for p in range(size)]
+        # batch_size is per-chip (matching the in-memory path's
+        # global_bs = batch_size * hvd.size()); a process contributes one
+        # slice per addressable device
+        local_bs = self.batch_size * jax.local_device_count()
+        # every process must run the same number of steps (the collective
+        # cadence); footer metadata is identical everywhere, so this
+        # needs no communication
+        nbatches = max(min(shard_rows) // local_bs, 1)
+
+        apply_fn = self._apply_fn()
+        loss = self._loss or (
+            lambda out, batch:
+            optax.softmax_cross_entropy_with_integer_labels(
+                out, batch["y"]).mean())
+
+        def loss_fn(params, batch):
+            return loss(apply_fn(params, batch["x"]), batch)
+
+        step = hvd.DistributedTrainStep(loss_fn, self._optimizer)
+
+        params = self._initial_params
+        if params is None:
+            if not hasattr(self._model, "init"):
+                raise ValueError("pass initial_params for a bare apply fn")
+            probe = _head(reader.read_group(my_groups[0]))
+            x0 = assemble_features(
+                extract_columns(probe, feature_specs), feature_specs)
+            params = self._model.init(jax.random.PRNGKey(self.seed),
+                                      _map_leaves(jnp.asarray, x0))
+        params = hvd.broadcast_variables(params, root_rank=0)
+        params, opt_state = step.init(params)
+
+        if run_id is not None:
+            ckpt = hvd.checkpoint.Checkpointer(
+                self._store.get_checkpoint_path(run_id))
+        elif self._legacy_ckpt_dir:
+            ckpt = hvd.checkpoint.Checkpointer(self._legacy_ckpt_dir)
+        else:
+            ckpt = None
+        loop = _Loop(params, opt_state)
+        cbs = CallbackList(self._callbacks)
+        cbs.on_train_begin(loop)
+
+        # the val data is immutable for the whole fit: open its footers
+        # once, not per epoch
+        val_reader = RowGroupReader(val_path) if val_path else None
+        rng = np.random.RandomState(self.seed + rank * 10007)
+        logs: dict = {}
+        for epoch in range(self.epochs):
+            cbs.on_epoch_begin(epoch, loop, logs)
+            for b, (bx, by) in enumerate(self._shard_batches(
+                    reader, my_groups, feature_specs, label_spec,
+                    local_bs, nbatches, rng)):
+                cbs.on_batch_begin(b, loop, logs)
+                batch = step.shard_local_batch({"x": bx, "y": by})
+                loop.params, loop.opt_state, train_loss = step(
+                    loop.params, loop.opt_state, batch)
+                cbs.on_batch_end(b, loop, logs)
+            logs["loss"] = float(train_loss)
+            if val_reader is not None:
+                logs["val_loss"] = self._streamed_val_loss(
+                    val_reader, loss_fn, loop.params, feature_specs,
+                    label_spec, hvd, epoch)
+            cbs.on_epoch_end(epoch, loop, logs)
+            if ckpt:
+                ckpt.save(epoch, {"params": loop.params,
+                                  "opt_state": loop.opt_state})
+        cbs.on_train_end(loop, logs)
+        return TpuModel(apply_fn, loop.params, self.feature_cols,
+                        feature_specs=feature_specs)
+
+    @staticmethod
+    def _shard_batches(reader, groups, feature_specs, label_spec,
+                       local_bs, nbatches, rng):
+        """Yield ``nbatches`` local (x, y) batches of exactly
+        ``local_bs`` rows, cycling this process's row groups in a
+        shuffled order; at most one row group plus one batch is held in
+        memory."""
+        order = [groups[int(i)] for i in rng.permutation(len(groups))]
+        pend_x, pend_y = None, None
+        gi = 0
+        for _ in range(nbatches):
+            while pend_y is None or len(pend_y) < local_bs:
+                df = reader.read_group(order[gi % len(order)])
+                gi += 1
+                x = assemble_features(
+                    extract_columns(df, feature_specs), feature_specs)
+                y = extract_columns(df, [label_spec])[label_spec.name]
+                perm = rng.permutation(len(y))
+                x = _map_leaves(lambda v: v[perm], x)
+                y = y[perm]
+                pend_x = x if pend_x is None else _tree_concat(pend_x, x)
+                pend_y = y if pend_y is None else np.concatenate(
+                    [pend_y, y])
+            bx = _map_leaves(lambda v: v[:local_bs], pend_x)
+            by = pend_y[:local_bs]
+            pend_x = _map_leaves(lambda v: v[local_bs:], pend_x)
+            pend_y = pend_y[local_bs:]
+            yield bx, by
+
+    @staticmethod
+    def _streamed_val_loss(reader, loss_fn, params, feature_specs,
+                           label_spec, hvd, epoch) -> float:
+        """Group-streamed validation loss on this process's val shard,
+        averaged across processes weighted by row count."""
+        # params are replicated → every leaf is locally addressable
+        host_params = jax.tree_util.tree_map(np.asarray, params)
+        s, n = 0.0, 0
+        for g in reader.shard_groups(hvd.process_rank(),
+                                     hvd.process_count()):
+            df = reader.read_group(g)
+            x = assemble_features(
+                extract_columns(df, feature_specs), feature_specs)
+            y = extract_columns(df, [label_spec])[label_spec.name]
+            s += float(loss_fn(host_params,
+                               {"x": _map_leaves(jnp.asarray, x),
+                                "y": jnp.asarray(y)})) * len(y)
+            n += len(y)
+        if hvd.process_count() > 1:
+            tot = np.asarray(hvd.allreduce(
+                jnp.asarray([s, float(n)]), op=hvd.Sum,
+                name=f"estimator_val_{epoch}"))
+            s, n = float(tot[0]), float(tot[1])
+        return s / max(n, 1.0)

@@ -176,6 +176,25 @@ class DistributedTrainStep:
 
         return jax.tree_util.tree_map(to_global, batch)
 
+    def shard_local_batch(self, batch):
+        """Place per-process rows onto the mesh as one global batch.
+
+        The streaming-reader contract (petastorm analogue): each process
+        contributes only the rows *it* read — its shard — rather than
+        slicing an identical global batch as :meth:`shard_batch` does.
+        Every process must pass the same number of rows per call.
+        """
+        if jax.process_count() == 1:
+            return jax.device_put(batch, self._batch_sharding)
+        sharding = self._batch_sharding
+
+        def to_global(arr):
+            if not isinstance(arr, np.ndarray):
+                arr = np.asarray(arr)
+            return jax.make_array_from_process_local_data(sharding, arr)
+
+        return jax.tree_util.tree_map(to_global, batch)
+
     def __call__(self, params, opt_state, batch):
         return self._step(params, opt_state, batch)
 

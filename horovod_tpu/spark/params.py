@@ -1,0 +1,154 @@
+"""Typed, validated estimator parameters.
+
+Reference ``horovod/spark/common/params.py``: ``EstimatorParams`` gives
+every estimator a shared, introspectable config surface — ``Param``
+entries with docs and type converters, ``setParams``/getters/setters,
+and ``_check_params`` validation.  The reference builds on
+``pyspark.ml.param``; this is the standalone equivalent: ``Param``
+descriptors with converters/validators that raise errors *naming the
+parameter*, and a ``HasParams`` base providing ``set_params``,
+``get_param``, ``param_specs()`` introspection and ``explain_params()``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+
+class ParamError(ValueError):
+    """Invalid parameter value or unknown parameter name."""
+
+
+# -- converters (reference TypeConverters) ----------------------------------
+
+def to_int(name: str, v) -> int:
+    if isinstance(v, bool) or not isinstance(v, (int, float)) or \
+            int(v) != v:
+        raise ParamError(f"{name} must be an integer, got {v!r}")
+    return int(v)
+
+
+def to_positive_int(name: str, v) -> int:
+    v = to_int(name, v)
+    if v <= 0:
+        raise ParamError(f"{name} must be a positive integer, got {v}")
+    return v
+
+
+def to_fraction(name: str, v) -> float:
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        raise ParamError(f"{name} must be a number in [0, 1), got {v!r}")
+    if not 0.0 <= v < 1.0:
+        raise ParamError(f"{name} must be in [0, 1), got {v}")
+    return v
+
+
+def to_str(name: str, v) -> str:
+    if not isinstance(v, str):
+        raise ParamError(f"{name} must be a string, got {type(v).__name__}")
+    return v
+
+
+def to_str_list(name: str, v) -> List[str]:
+    if isinstance(v, str):
+        return [v]
+    try:
+        out = list(v)
+    except TypeError:
+        raise ParamError(
+            f"{name} must be a list of strings, got {type(v).__name__}")
+    bad = [x for x in out if not isinstance(x, str)]
+    if bad:
+        raise ParamError(
+            f"{name} must be a list of strings, got entries {bad!r}")
+    return out
+
+
+def to_bool(name: str, v) -> bool:
+    if not isinstance(v, bool):
+        raise ParamError(f"{name} must be a bool, got {v!r}")
+    return v
+
+
+def optional(conv: Callable) -> Callable:
+    def _conv(name, v):
+        return None if v is None else conv(name, v)
+
+    return _conv
+
+
+class Param:
+    """One declared parameter: default, doc, optional converter.
+
+    A class-attribute descriptor: reading returns the held value (or
+    default), assignment converts + validates, raising ``ParamError``
+    messages that name the parameter (the reference's typed Param +
+    TypeConverters contract)."""
+
+    def __init__(self, default, doc: str,
+                 converter: Optional[Callable] = None):
+        self.default = default
+        self.doc = doc
+        self.converter = converter
+        self.name = None          # bound by __set_name__
+
+    def __set_name__(self, owner, name):
+        self.name = name
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        return obj.__dict__.get(f"_param_{self.name}", self.default)
+
+    def __set__(self, obj, value):
+        if self.converter is not None:
+            value = self.converter(self.name, value)
+        obj.__dict__[f"_param_{self.name}"] = value
+
+
+class HasParams:
+    """Introspection + bulk assignment over declared :class:`Param`\\ s
+    (reference ``Params``/``setParams``)."""
+
+    @classmethod
+    def param_specs(cls) -> Dict[str, Param]:
+        out: Dict[str, Param] = {}
+        for klass in reversed(cls.__mro__):
+            for k, v in vars(klass).items():
+                if isinstance(v, Param):
+                    out[k] = v
+        return out
+
+    def set_params(self, **kwargs) -> "HasParams":
+        declared = self.param_specs()
+        for k, v in kwargs.items():
+            if k not in declared:
+                import difflib
+
+                hint = difflib.get_close_matches(k, declared, n=1)
+                suffix = f"; did you mean {hint[0]!r}?" if hint else ""
+                raise ParamError(
+                    f"unknown parameter {k!r} for "
+                    f"{type(self).__name__}{suffix} (known: "
+                    f"{', '.join(sorted(declared))})")
+            setattr(self, k, v)
+        return self
+
+    def get_param(self, name: str) -> Any:
+        if name not in self.param_specs():
+            raise ParamError(
+                f"unknown parameter {name!r} for {type(self).__name__}")
+        return getattr(self, name)
+
+    def explain_params(self) -> str:
+        """Human-readable table of every param: value, default, doc
+        (reference ``explainParams``)."""
+        lines = []
+        for name, p in sorted(self.param_specs().items()):
+            val = getattr(self, name)
+            mark = "" if val == p.default else " (set)"
+            lines.append(f"{name} = {val!r}{mark} — {p.doc} "
+                         f"[default: {p.default!r}]")
+        return "\n".join(lines)

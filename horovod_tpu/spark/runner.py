@@ -318,7 +318,11 @@ def _run_on_spark(sc, fn, args, kwargs, num_proc, extra_env, verbose,
         rank0 = registry[slot_index[0]]
         port = BasicClient(rank0.addr, key).request(ProbePortRequest()).port
         head = rank0.host
-        if head in ("localhost", socket.gethostname()):
+        single_host = len({r.host_hash for r in registry.values()}) == 1
+        if single_host and head in ("localhost", socket.gethostname()):
+            # every worker shares rank 0's machine, so loopback is both
+            # valid and immune to hostname-resolution quirks; with
+            # workers on other hosts the real hostname must ship
             head = "127.0.0.1"
         coordinator = f"{head}:{port}"
         if verbose:

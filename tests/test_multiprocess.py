@@ -396,6 +396,70 @@ class TestTwoProcessWorld:
         assert (store_dir / "runs/run_001/metadata.json").exists()
         assert (store_dir / "intermediate_train_data").exists()
 
+    def test_estimator_streaming_shards_are_disjoint(self, tmp_path):
+        """fit_on_parquet across 2 processes: each process materializes
+        only its round-robin row groups (read accounting), never the
+        full dataset — the petastorm-reader contract
+        (reference ``spark/keras/remote.py:336``)."""
+        store_dir = tmp_path / "store"
+        # write the sharded parquet once, before the workers launch
+        import numpy as np
+        import pandas as pd
+
+        from horovod_tpu.spark import Store
+
+        rng = np.random.RandomState(0)
+        x = rng.rand(96, 4).astype(np.float32)
+        y = (x @ rng.rand(4, 3)).argmax(1).astype(np.int32)
+        df = pd.DataFrame({"f1": x[:, 0], "f2": x[:, 1], "f3": x[:, 2],
+                           "f4": x[:, 3], "label": y})
+        store = Store.create(str(store_dir))
+        store.write_dataframe(df, store.get_train_data_path(),
+                              rows_per_group=12)   # 8 groups / 2 procs
+
+        out = launch(f"""
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import flax.linen as nn
+            import horovod_tpu as hvd
+            from horovod_tpu.spark import Estimator, Store
+            from horovod_tpu.spark.store import RowGroupReader
+
+            reads = []
+            orig = RowGroupReader.read_group
+            RowGroupReader.read_group = \\
+                lambda self, i: (reads.append(i), orig(self, i))[1]
+
+            class Net(nn.Module):
+                @nn.compact
+                def __call__(self, x):
+                    return nn.Dense(3)(nn.relu(nn.Dense(8)(x)))
+
+            store = Store.create({str(store_dir)!r})
+            est = Estimator(Net(), feature_cols=["f1", "f2", "f3", "f4"],
+                            label_col="label", batch_size=4, epochs=2)
+            model = est.fit_on_parquet(store.get_train_data_path())
+            leaf = np.asarray(jax.tree_util.tree_leaves(model.params)[0],
+                              np.float32)
+            digests = hvd.allgather_object(float(np.abs(leaf).sum()))
+            assert digests[0] == digests[1], digests
+            import json
+            with open({str(tmp_path)!r} +
+                      f"/groups.{{hvd.process_rank()}}.json", "w") as f:
+                json.dump(sorted(set(reads)), f)
+            print("WORKER_OK", hvd.process_rank())
+        """, tmp_path)
+        assert out.returncode == 0, out.stderr[-3000:]
+        assert out.stdout.count("WORKER_OK") == 2
+        import json
+
+        groups = {r: set(json.load(open(tmp_path / f"groups.{r}.json")))
+                  for r in range(2)}
+        # round-robin ownership: disjoint shards covering all 8 groups
+        assert groups[0] == {0, 2, 4, 6}, groups
+        assert groups[1] == {1, 3, 5, 7}, groups
+
     def test_worker_failure_fails_job(self, tmp_path):
         out = launch("""
             import os, sys

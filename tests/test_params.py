@@ -1,0 +1,109 @@
+"""Estimator params layer (reference ``test_spark.py`` param assertions
+over ``spark/common/params.py``: typed converters, defaults, named
+validation errors, introspection)."""
+
+import pytest
+
+from horovod_tpu.spark.params import (
+    HasParams,
+    Param,
+    ParamError,
+    optional,
+    to_fraction,
+    to_positive_int,
+    to_str_list,
+)
+
+
+class Toy(HasParams):
+    batch_size = Param(32, "batch size", to_positive_int)
+    frac = Param(0.0, "fraction", to_fraction)
+    cols = Param(None, "columns", to_str_list)
+    extra = Param(None, "optional int", optional(to_positive_int))
+
+
+class TestParams:
+    def test_defaults_and_set(self):
+        t = Toy()
+        assert t.batch_size == 32 and t.frac == 0.0
+        t.batch_size = 64
+        assert t.batch_size == 64
+        # instances don't share state
+        assert Toy().batch_size == 32
+
+    def test_validation_names_the_param(self):
+        t = Toy()
+        with pytest.raises(ParamError, match="batch_size must be a "
+                                             "positive integer, got -3"):
+            t.batch_size = -3
+        with pytest.raises(ParamError, match=r"frac must be in \[0, 1\)"):
+            t.frac = 1.5
+        with pytest.raises(ParamError, match="cols must be a list of "
+                                             "strings"):
+            t.cols = [1, 2]
+        with pytest.raises(ParamError, match="batch_size must be an "
+                                             "integer"):
+            t.batch_size = "many"
+
+    def test_optional_converter(self):
+        t = Toy()
+        t.extra = None
+        assert t.extra is None
+        t.extra = 5
+        assert t.extra == 5
+        with pytest.raises(ParamError, match="extra"):
+            t.extra = 0
+
+    def test_set_params_unknown_name_suggests(self):
+        with pytest.raises(ParamError,
+                           match="did you mean 'batch_size'"):
+            Toy().set_params(batch_sized=16)
+
+    def test_introspection(self):
+        specs = Toy.param_specs()
+        assert set(specs) == {"batch_size", "frac", "cols", "extra"}
+        assert specs["batch_size"].doc == "batch size"
+        t = Toy().set_params(batch_size=8)
+        out = t.explain_params()
+        assert "batch_size = 8 (set)" in out
+        assert "[default: 32]" in out
+        assert t.get_param("frac") == 0.0
+        with pytest.raises(ParamError, match="unknown parameter"):
+            t.get_param("nope")
+
+
+class TestEstimatorParamSurface:
+    def test_estimator_rejects_bad_config(self):
+        import flax.linen as nn
+
+        from horovod_tpu.estimator import Estimator
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(2)(x)
+
+        with pytest.raises(ParamError, match="batch_size"):
+            Estimator(Net(), feature_cols=["a"], label_col="y",
+                      batch_size=0)
+        with pytest.raises(ParamError, match="epochs"):
+            Estimator(Net(), feature_cols=["a"], label_col="y",
+                      epochs=-1)
+        with pytest.raises(ParamError, match="validation_fraction"):
+            Estimator(Net(), feature_cols=["a"], label_col="y",
+                      validation_fraction=1.0)
+        est = Estimator(Net(), feature_cols="a", label_col="y")
+        assert est.feature_cols == ["a"]      # str → [str] coercion
+        assert "rows_per_group" in est.explain_params()
+
+    def test_tpu_model_params(self):
+        from horovod_tpu.estimator import TpuModel
+
+        m = TpuModel(lambda p, x: x, {}, feature_cols=["f"])
+        assert m.batch_size == 1024 and m.output_col == "prediction"
+        with pytest.raises(ParamError, match="output_col"):
+            m.output_col = 7
+        # model weights and the param surface coexist
+        assert m.params == {}
+        assert set(m.param_specs()) == {"feature_cols", "output_col",
+                                        "batch_size"}
