@@ -9,16 +9,26 @@ wire ops the same way (synthetic tensors, localhost multi-process).
 Usage::
 
     python examples/eager_bandwidth_bench.py --np 2 --mb 64
+    python examples/eager_bandwidth_bench.py --np 1 --device   # real chip
+
+``--device`` keeps the default backend (the real TPU under the driver)
+and runs in-process, measuring the *per-eager-call* cost on device —
+each flush is its own dispatched program, so through a remote tunnel
+this is dominated by dispatch latency (PERF_NOTES.md: 4–18 ms).  The
+printed ``in_jit`` row times the same reduction arithmetic fused inside
+one compiled step, the cost the in-graph plane
+(``DistributedTrainStep``/``ops.collectives``) pays instead.
 """
 
 import argparse
 import time
 
 
-def worker(nbytes: int, iters: int):
+def worker(nbytes: int, iters: int, device: bool = False):
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
+    if not device:
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
 
@@ -38,10 +48,43 @@ def worker(nbytes: int, iters: int):
             fn(x, name=f"{label}_{i}")
         return (time.perf_counter() - t0) / iters
 
-    out["allreduce_MBps"] = nbytes / timed(hvd.allreduce, "ar") / 1e6
+    ar_s = timed(hvd.allreduce, "ar")
+    out["allreduce_sync_ms_per_call"] = ar_s * 1e3
+    out["allreduce_MBps"] = nbytes / ar_s / 1e6
     out["allgather_MBps"] = (nbytes * hvd.size()
                              / timed(hvd.allgather, "ag") / 1e6)
     out["alltoall_MBps"] = nbytes / timed(hvd.alltoall, "a2a") / 1e6
+
+    def burst(r, tag):
+        """Issue ``r`` async allreduces, then synchronize the batch."""
+        t0 = time.perf_counter()
+        handles = [hvd.allreduce_async(x, name=f"b{tag}_{i}")
+                   for i in range(r)]
+        for h in handles:
+            hvd.synchronize(h)
+        return time.perf_counter() - t0
+
+    # marginal per-call cost by slope fit (PERF_NOTES.md metrology:
+    # through a remote tunnel any single burst pays a fixed fence RTT,
+    # so difference two burst sizes instead of trusting one)
+    burst(2, "w")
+    r1, r3 = iters, 3 * iters
+    out["allreduce_async_ms_per_call"] =         (burst(r3, "3") - burst(r1, "1")) / (r3 - r1) * 1e3
+
+    # the same arithmetic fused in one compiled program: what the
+    # in-graph plane pays per reduction instead of a per-call dispatch
+    scale = 1.0 / hvd.size()
+    fused = jax.jit(lambda v: v * scale)
+
+    def jit_burst(r):
+        t0 = time.perf_counter()
+        for _ in range(r):
+            y = fused(x)
+        np.asarray(jnp.ravel(y)[0])     # tunnel-safe fence
+        return time.perf_counter() - t0
+
+    jit_burst(2)
+    out["in_jit_ms_per_call"] =         (jit_burst(r3) - jit_burst(r1)) / (r3 - r1) * 1e3
 
     hvd.shutdown()
     return out
@@ -52,15 +95,25 @@ def main():
     p.add_argument("--np", type=int, default=2)
     p.add_argument("--mb", type=int, default=16, help="payload megabytes")
     p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--device", action="store_true",
+                   help="keep the default backend (real TPU) and run "
+                        "in-process; requires --np 1")
     args = p.parse_args()
 
-    from horovod_tpu.runner import run
+    if args.device:
+        if args.np != 1:
+            raise SystemExit("--device measures the single-chip eager "
+                             "path; use --np 1")
+        r0 = worker(args.mb * 1024 * 1024, args.iters, device=True)
+    else:
+        from horovod_tpu.runner import run
 
-    results = run(worker, args=(args.mb * 1024 * 1024, args.iters),
-                  np=args.np)
-    r0 = results[0]
+        results = run(worker, args=(args.mb * 1024 * 1024, args.iters),
+                      np=args.np)
+        r0 = results[0]
     for k, v in r0.items():
-        print(f"{k}: {v:,.0f} MB/s")
+        unit = "ms" if k.endswith("ms_per_call") else "MB/s"
+        print(f"{k}: {v:,.2f} {unit}")
 
 
 if __name__ == "__main__":

@@ -49,7 +49,10 @@ def build_step(batch_size=256, image_size=224):
         return params, opt_state, loss
 
     x0 = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
-    params = model.init(jax.random.PRNGKey(0), x0, train=False)
+    # jit the init: run eagerly it is hundreds of per-op dispatches,
+    # minutes through the remote tunnel
+    params = jax.jit(lambda k: model.init(k, x0, train=False))(
+        jax.random.PRNGKey(0))
     opt_state = opt.init(params)
     # host copies so donation inside time_variant can't consume them
     params = jax.tree_util.tree_map(np.asarray, params)
