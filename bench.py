@@ -40,6 +40,15 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def tpu_compiler_options(args):
+    """Per-compile XLA options for the bench step on TPU (measured
+    ≈+3% on ResNet-50 from the latency-hiding scheduler; see
+    examples/resnet_compile_experiments.py for the A/B harness)."""
+    if jax.devices()[0].platform != "tpu" or args.no_compiler_options:
+        return None
+    return {"xla_tpu_enable_latency_hiding_scheduler": "true"}
+
+
 def hw_peak_flops():
     """Per-chip peak bf16 TFLOP/s for MFU, or None off-TPU/unknown."""
     if jax.devices()[0].platform != "tpu":
@@ -106,7 +115,9 @@ def run_resnet(args, hvd):
             logits, batch["y"]).mean()
 
     step = hvd.DistributedTrainStep(
-        loss_fn, optax.sgd(0.01 * n_chips, momentum=0.9))
+        loss_fn, optax.sgd(0.01 * n_chips, momentum=0.9),
+        steps_per_call=args.steps_per_call,
+        compiler_options=tpu_compiler_options(args))
     x0 = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
     params, opt_state = step.init(
         model.init(jax.random.PRNGKey(0), x0, train=False))
@@ -122,7 +133,8 @@ def run_resnet(args, hvd):
     per_chip = median_rate(
         lambda s: step(s[0], s[1], batch), (params, opt_state, None),
         args.num_warmup_batches, args.num_iters,
-        args.num_batches_per_iter, global_bs, "resnet") / n_chips
+        args.num_batches_per_iter,
+        global_bs * args.steps_per_call, "resnet") / n_chips
 
     # MFU: fwd+bwd ≈ 3 × 4.1 GFLOP/img at 224px (scaled for other sizes).
     # PERF_NOTES.md derives why the structural ceiling for this model on
@@ -167,7 +179,10 @@ def run_transformer(args, hvd):
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, batch["labels"]).mean()
 
-    step = hvd.DistributedTrainStep(loss_fn, optax.adamw(3e-4))
+    step = hvd.DistributedTrainStep(
+        loss_fn, optax.adamw(3e-4),
+        steps_per_call=args.steps_per_call,
+        compiler_options=tpu_compiler_options(args))
     tokens0 = jnp.zeros((1, seq), jnp.int32)
     variables = model.init(jax.random.PRNGKey(0), tokens0)
     nparams = sum(x.size for x in jax.tree_util.tree_leaves(variables))
@@ -185,7 +200,8 @@ def run_transformer(args, hvd):
     tokens_per_chip_sec = median_rate(
         lambda s: step(s[0], s[1], batch_data), (params, opt_state, None),
         args.num_warmup_batches, args.num_iters,
-        args.num_batches_per_iter, global_bs * seq, "transformer") / n_chips
+        args.num_batches_per_iter,
+        global_bs * seq * args.steps_per_call, "transformer") / n_chips
 
     # fwd+bwd FLOPs/token: 6·P (params incl. the tied embedding head,
     # whose 6·V·d logits share stands in for the lookup) + causal
@@ -212,6 +228,12 @@ def main():
     p.add_argument("--num-iters", type=int, default=5)
     p.add_argument("--num-batches-per-iter", type=int, default=10)
     p.add_argument("--num-warmup-batches", type=int, default=3)
+    p.add_argument("--steps-per-call", type=int, default=10,
+                   help="optimizer steps scanned into one dispatched "
+                        "program (steps_per_execution); amortizes "
+                        "per-call launch overhead")
+    p.add_argument("--no-compiler-options", action="store_true",
+                   help="disable the default TPU XLA compile options")
     p.add_argument("--dtype", default="bfloat16",
                    choices=["bfloat16", "float32"])
     p.add_argument("--space-to-depth", action="store_true",

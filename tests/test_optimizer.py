@@ -80,6 +80,56 @@ class TestDistributedTrainStep:
                 np.asarray(outs["shard_map"][0][k]), rtol=1e-4, atol=1e-6)
         assert abs(outs["pjit"][1] - outs["shard_map"][1]) < 1e-4
 
+    def test_steps_per_call_matches_sequential(self):
+        """k scanned steps in one program == k sequential calls (the
+        Keras steps_per_execution analogue), for both modes."""
+        params0 = make_params(jax.random.PRNGKey(2))
+        batch = make_batch()
+        for mode in ("pjit", "shard_map"):
+            seq = hvd.DistributedTrainStep(loss_fn, optax.sgd(0.1),
+                                           mode=mode, donate=False)
+            p, o = seq.init(params0)
+            b = seq.shard_batch(batch)
+            for _ in range(4):
+                p, o, loss_seq = seq(p, o, b)
+
+            fused = hvd.DistributedTrainStep(loss_fn, optax.sgd(0.1),
+                                             mode=mode, donate=False,
+                                             steps_per_call=4)
+            fp, fo = fused.init(params0)
+            fp, fo, loss_fused = fused(fp, fo, fused.shard_batch(batch))
+            for k in p:
+                np.testing.assert_allclose(np.asarray(p[k]),
+                                           np.asarray(fp[k]),
+                                           rtol=1e-5, atol=1e-6)
+            assert abs(float(loss_seq) - float(loss_fused)) < 1e-5
+
+    def test_steps_per_call_validation(self):
+        with pytest.raises(ValueError, match="steps_per_call"):
+            hvd.DistributedTrainStep(loss_fn, optax.sgd(0.1),
+                                     steps_per_call=0)
+
+    def test_compiler_options_path(self):
+        """compiler_options forces the AOT lower/compile path; results
+        match the default path and the compile is cached per signature."""
+        params0 = make_params(jax.random.PRNGKey(3))
+        batch = make_batch()
+        ref = hvd.DistributedTrainStep(loss_fn, optax.sgd(0.1),
+                                       donate=False)
+        p, o = ref.init(params0)
+        b = ref.shard_batch(batch)
+        p, o, loss_ref = ref(p, o, b)
+
+        opt = hvd.DistributedTrainStep(loss_fn, optax.sgd(0.1),
+                                       donate=False,
+                                       compiler_options={})
+        cp, co = opt.init(params0)
+        cp, co, loss_opt = opt(cp, co, opt.shard_batch(batch))
+        assert abs(float(loss_ref) - float(loss_opt)) < 1e-6
+        assert len(opt._compiled_cache) == 1
+        opt(cp, co, opt.shard_batch(batch))
+        assert len(opt._compiled_cache) == 1
+
     def test_adasum_mode_runs(self):
         step = hvd.DistributedTrainStep(loss_fn, optax.sgd(0.05),
                                         mode="shard_map", op=hvd.Adasum)
