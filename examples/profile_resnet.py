@@ -1,0 +1,127 @@
+"""Per-op device profile of the ResNet-50 bench step (PERF_NOTES tables).
+
+Captures a ``jax.profiler`` trace of the exact ``bench.py`` train step
+on the real chip and prints the top device ops by total time, with
+achieved HBM bandwidth where the op's ``bytes accessed`` stat is
+recorded.  The xplane protobuf is parsed with the proto bundled in
+tensorflow.tsl — no tensorboard UI needed.
+
+Usage::
+
+    python examples/profile_resnet.py --top 30 [--steps-per-call 4]
+        [--no-lhs] [--space-to-depth]
+"""
+
+import argparse
+import collections
+import glob
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def build_step(batch_size, image_size, steps_per_call, lhs, s2d):
+    import horovod_tpu as hvd
+    from horovod_tpu.models.resnet import ResNet50
+
+    hvd.init()
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16,
+                     space_to_depth=s2d)
+
+    def loss_fn(params, batch):
+        logits = model.apply(params, batch["x"], train=False)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    opts = {"xla_tpu_enable_latency_hiding_scheduler": "true"} if lhs \
+        else None
+    step = hvd.DistributedTrainStep(
+        loss_fn, optax.sgd(0.01, momentum=0.9),
+        steps_per_call=steps_per_call, compiler_options=opts)
+    x0 = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+    params, opt_state = step.init(jax.jit(
+        lambda k: model.init(k, x0, train=False))(jax.random.PRNGKey(0)))
+    rng = np.random.RandomState(0)
+    batch = step.shard_batch({
+        "x": jnp.asarray(rng.rand(batch_size, image_size, image_size, 3),
+                         jnp.float32),
+        "y": jnp.asarray(rng.randint(0, 1000, (batch_size,)), jnp.int32),
+    })
+    return step, params, opt_state, batch
+
+
+def collect_op_stats(trace_dir):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = sorted(glob.glob(
+        os.path.join(trace_dir, "plugins/profile/*/*.xplane.pb")))
+    xs = xplane_pb2.XSpace()
+    xs.ParseFromString(open(paths[-1], "rb").read())
+    ops = collections.defaultdict(lambda: [0.0, 0, 0.0])  # ps, count, bytes
+    for plane in xs.planes:
+        if not plane.name.startswith("/device:TPU"):
+            continue
+        stat_names = dict(plane.stat_metadata.items())
+        ev_meta = dict(plane.event_metadata.items())
+        for line in plane.lines:
+            for ev in line.events:
+                name = ev_meta[ev.metadata_id].name \
+                    if ev.metadata_id in ev_meta else "?"
+                rec = ops[name]
+                rec[0] += ev.duration_ps
+                rec[1] += 1
+                for st in ev.stats:
+                    sname = stat_names[st.metadata_id].name \
+                        if st.metadata_id in stat_names else ""
+                    if "bytes accessed" in sname.lower() and \
+                            not sname.lower().rstrip("0123456789}{ ") \
+                                     .endswith("breakdown"):
+                        rec[2] += st.uint64_value or st.int64_value
+    return ops
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--steps-per-call", type=int, default=4)
+    ap.add_argument("--top", type=int, default=30)
+    ap.add_argument("--no-lhs", action="store_true")
+    ap.add_argument("--space-to-depth", action="store_true", default=True)
+    ap.add_argument("--no-space-to-depth", dest="space_to_depth",
+                    action="store_false")
+    ap.add_argument("--trace-dir", default=None)
+    args = ap.parse_args()
+
+    step, params, opt_state, batch = build_step(
+        args.batch_size, args.image_size, args.steps_per_call,
+        not args.no_lhs, args.space_to_depth)
+    p, o, loss = step(params, opt_state, batch)       # compile + warm
+    float(loss)
+
+    trace_dir = args.trace_dir or tempfile.mkdtemp(prefix="rn50prof_")
+    with jax.profiler.trace(trace_dir):
+        p, o, loss = step(p, o, batch)
+        float(loss)
+    print(f"trace: {trace_dir}")
+
+    ops = collect_op_stats(trace_dir)
+    nsteps = args.steps_per_call
+    total_ms = sum(v[0] for v in ops.values()) / 1e9 / nsteps
+    print(f"device op time: {total_ms:.2f} ms/step "
+          f"({len(ops)} distinct ops, {nsteps} steps traced)")
+    print(f"{'op':60s} {'ms/step':>8s} {'%':>5s} {'GB/s':>6s}")
+    ranked = sorted(ops.items(), key=lambda kv: -kv[1][0])
+    for name, (ps, cnt, nbytes) in ranked[:args.top]:
+        ms = ps / 1e9 / nsteps
+        bw = (nbytes / nsteps) / (ms / 1e3) / 1e9 if nbytes else 0
+        print(f"{name[:60]:60s} {ms:8.3f} {ms / total_ms * 100:5.1f} "
+              f"{bw:6.0f}")
+
+
+if __name__ == "__main__":
+    main()
