@@ -12,6 +12,13 @@ from horovod_tpu.models.transformer import (
     TransformerLM,
     lm_loss,
 )
+from horovod_tpu.models.vit import (
+    ViT_B16,
+    ViT_S16,
+    ViTConfig,
+    VisionTransformer,
+)
 
 __all__ = ["ResNet50", "ResNet101", "ResNet152",
-           "TransformerLM", "TransformerConfig", "lm_loss"]
+           "TransformerLM", "TransformerConfig", "lm_loss",
+           "VisionTransformer", "ViTConfig", "ViT_S16", "ViT_B16"]

@@ -52,6 +52,7 @@ class TransformerConfig:
     max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16
     attention_impl: str = "dense"       # dense | flash | ring | ulysses
+    causal: bool = True                 # False: bidirectional (ViT/BERT)
     sp_axis: str = AXIS_SP
     tp_axis: str = AXIS_TP
     remat: bool = False
@@ -107,15 +108,15 @@ class Attention(nn.Module):
         k = rotary_embedding(k, positions)
 
         if cfg.attention_impl == "dense":
-            o = reference_attention(q, k, v, causal=True)
+            o = reference_attention(q, k, v, causal=cfg.causal)
         elif cfg.attention_impl == "flash":
             from horovod_tpu.ops.pallas_kernels import flash_attention
 
-            o = flash_attention(q, k, v, causal=True)
+            o = flash_attention(q, k, v, causal=cfg.causal)
         elif cfg.attention_impl == "ring":
-            o = ring_attention(q, k, v, cfg.sp_axis, causal=True)
+            o = ring_attention(q, k, v, cfg.sp_axis, causal=cfg.causal)
         elif cfg.attention_impl == "ulysses":
-            o = ulysses_attention(q, k, v, cfg.sp_axis, causal=True)
+            o = ulysses_attention(q, k, v, cfg.sp_axis, causal=cfg.causal)
         else:
             raise ValueError(
                 f"unknown attention_impl {cfg.attention_impl!r}")

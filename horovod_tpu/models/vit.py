@@ -1,0 +1,93 @@
+"""Vision Transformer — the third benchmark-family model.
+
+The reference ships no model zoo (its examples train Keras/torchvision
+models); this repo's models play that role for TPU users.  ViT rounds
+out the family: vision like ResNet, but matmul-dense like the
+transformer — patches feed the MXU directly with none of ResNet's
+low-arithmetic-intensity convolutions, so it scales with the same
+:class:`~horovod_tpu.models.transformer.Block` stack (tensor-parallel
+annotations, flash attention, remat) the LM uses.
+
+TPU-first choices: patchify as one strided conv (a dense matmul on the
+MXU), bidirectional attention through the shared blocks
+(``TransformerConfig(causal=False)``), RoPE over the flattened patch
+sequence instead of a learned position table (nothing extra to shard),
+and mean pooling instead of a class token (keeps the sequence length a
+power-of-two-friendly ``(image/patch)²`` for flash-attention tiling).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.models.transformer import Block, RMSNorm, TransformerConfig
+
+
+@dataclasses.dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "dense"       # dense | flash
+    remat: bool = False
+
+    @property
+    def num_patches(self) -> int:
+        if self.image_size % self.patch_size:
+            raise ValueError(
+                f"image_size {self.image_size} is not a multiple of "
+                f"patch_size {self.patch_size}")
+        return (self.image_size // self.patch_size) ** 2
+
+    def transformer(self) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=1,               # unused: inputs are patches
+            num_layers=self.num_layers, num_heads=self.num_heads,
+            d_model=self.d_model, d_ff=self.d_ff,
+            max_seq_len=self.num_patches, dtype=self.dtype,
+            attention_impl=self.attention_impl, causal=False,
+            remat=self.remat)
+
+
+class VisionTransformer(nn.Module):
+    """``apply(variables, images) -> logits`` over (B, H, W, C) inputs."""
+
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        tcfg = cfg.transformer()
+        p = cfg.patch_size
+        x = x.astype(cfg.dtype)
+        # patchify: one strided conv == a dense (p·p·C → d) matmul per
+        # patch, the shape the MXU wants
+        x = nn.Conv(cfg.d_model, (p, p), strides=(p, p), padding="VALID",
+                    dtype=cfg.dtype, name="patch_embed")(x)
+        b, gh, gw, d = x.shape
+        x = x.reshape(b, gh * gw, d)
+        positions = jnp.arange(x.shape[1])
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=())
+        for i in range(cfg.num_layers):
+            x = block(tcfg, name=f"layer_{i}")(x, positions)
+        x = RMSNorm(name="ln_f")(x)
+        x = jnp.mean(x, axis=1)
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                        name="head")(x.astype(jnp.float32))
+
+
+ViT_S16 = lambda **kw: VisionTransformer(ViTConfig(  # noqa: E731
+    num_layers=12, num_heads=6, d_model=384, d_ff=1536, **kw))
+ViT_B16 = lambda **kw: VisionTransformer(ViTConfig(**kw))  # noqa: E731
