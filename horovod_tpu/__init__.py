@@ -43,11 +43,14 @@ from horovod_tpu.ops import (
     ReduceOp,
     Sum,
     allgather,
+    allgather_async,
     allreduce,
     allreduce_async,
     alltoall,
+    alltoall_async,
     barrier,
     broadcast,
+    broadcast_async,
     join,
     poll,
     synchronize,
@@ -265,7 +268,8 @@ __all__ = [
     "rocm_built", "mpi_threads_supported", "current_operations",
     "cache_stats",
     # collectives
-    "allreduce", "allreduce_async", "allgather", "alltoall", "barrier",
+    "allreduce", "allreduce_async", "allgather", "allgather_async",
+    "alltoall", "alltoall_async", "broadcast_async", "barrier",
     "broadcast", "join", "poll", "synchronize",
     "Average", "Sum", "Adasum", "ReduceOp", "Compression", "Handle",
     "HorovodInternalError",

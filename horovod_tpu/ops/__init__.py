@@ -22,11 +22,14 @@ from horovod_tpu.ops.eager import (
     Handle,
     HorovodInternalError,
     allgather,
+    allgather_async,
     allreduce,
     allreduce_async,
     alltoall,
+    alltoall_async,
     barrier,
     broadcast,
+    broadcast_async,
     join,
     poll,
     synchronize,
@@ -35,6 +38,7 @@ from horovod_tpu.ops.eager import (
 __all__ = [
     "Adasum", "Average", "ReduceOp", "Sum", "Compression",
     "Handle", "HorovodInternalError",
-    "allreduce", "allreduce_async", "allgather", "alltoall", "barrier",
+    "allreduce", "allreduce_async", "allgather", "allgather_async",
+    "alltoall", "alltoall_async", "broadcast_async", "barrier",
     "broadcast", "join", "poll", "synchronize",
 ]

@@ -721,6 +721,15 @@ def _alltoall_rows(garr):
     return fn(garr)
 
 
+def _fulfilled(name: str, value) -> Handle:
+    """A pre-completed handle (the nproc==1 short-circuit of the async
+    variants keeps the handle API shape)."""
+    h = Handle(name)
+    h._result = value
+    h._done.set()
+    return h
+
+
 def allgather(tensor, name: Optional[str] = None):
     """Gather tensors from all processes, concatenated on dim 0; first dims
     may differ per process (reference ``EnqueueTensorAllgather``
@@ -729,16 +738,32 @@ def allgather(tensor, name: Optional[str] = None):
     return out
 
 
+def allgather_async(tensor, name: Optional[str] = None) -> Handle:
+    """Async ``allgather`` (reference ``allgather_async``,
+    ``torch/mpi_ops.py:692``): the negotiation head runs inline — eager
+    collectives must hit the wire in program order on every process —
+    but the device computation and result fetch stay asynchronous until
+    ``synchronize``."""
+    handle, _ = _allgather_submit(tensor, name)
+    return handle
+
+
 def allgather_with_sizes(tensor, name: Optional[str] = None):
     """``allgather`` that also returns the negotiated per-process first-dim
     sizes as a host ``np.ndarray`` — callers exchanging variable payloads
     (``allgather_object``) reuse them instead of a second collective."""
+    handle, sizes = _allgather_submit(tensor, name)
+    return synchronize(handle), sizes
+
+
+def _allgather_submit(tensor, name: Optional[str] = None):
     name = name or _next_name("allgather")
     tensor = _localize(tensor)
     mesh = process_mesh()
     nproc = mesh.devices.size
     if nproc == 1:
-        return tensor, np.asarray([tensor.shape[0]], np.int64)
+        return (_fulfilled(name, tensor),
+                np.asarray([tensor.shape[0]], np.int64))
     handle = Handle(name)
     _register(name, handle)
     sizes = None
@@ -766,18 +791,26 @@ def allgather_with_sizes(tensor, name: Optional[str] = None):
             handle._fulfill(out)
     except Exception as err:
         handle._fail(HorovodInternalError(str(err)))
-    return synchronize(handle), sizes
+    return handle, sizes
 
 
 def broadcast(tensor, root_rank: int, name: Optional[str] = None):
     """Broadcast from ``root_rank`` process to all (reference
     ``EnqueueTensorBroadcast``, ``operations.cc:928``)."""
+    return synchronize(broadcast_async(tensor, root_rank, name=name))
+
+
+def broadcast_async(tensor, root_rank: int,
+                    name: Optional[str] = None) -> Handle:
+    """Async ``broadcast`` (reference ``broadcast_async``,
+    ``torch/mpi_ops.py:755``); negotiation inline for program-order
+    alignment, device work asynchronous until ``synchronize``."""
     name = name or _next_name("broadcast")
     tensor = _localize(tensor)
     mesh = process_mesh()
     nproc = mesh.devices.size
     if nproc == 1:
-        return tensor
+        return _fulfilled(name, tensor)
     handle = Handle(name)
     _register(name, handle)
     try:
@@ -795,7 +828,7 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
             handle._fulfill(jnp.asarray(out))
     except Exception as err:
         handle._fail(HorovodInternalError(str(err)))
-    return synchronize(handle)
+    return handle
 
 
 def alltoall(tensor, splits=None, name: Optional[str] = None):
@@ -803,6 +836,14 @@ def alltoall(tensor, splits=None, name: Optional[str] = None):
     ``EnqueueTensorAlltoall``, ``operations.cc:979``).  ``splits[i]`` rows go
     to process i; uniform split when ``splits`` is None.  Returns the
     concatenation of slices received from every process."""
+    return synchronize(alltoall_async(tensor, splits, name=name))
+
+
+def alltoall_async(tensor, splits=None,
+                   name: Optional[str] = None) -> Handle:
+    """Async ``alltoall`` (reference ``alltoall_async``,
+    ``torch/mpi_ops.py:812``); negotiation inline for program-order
+    alignment, device work asynchronous until ``synchronize``."""
     name = name or _next_name("alltoall")
     tensor = _localize(tensor)
     mesh = process_mesh()
@@ -816,7 +857,7 @@ def alltoall(tensor, splits=None, name: Optional[str] = None):
     if splits.sum() != tensor.shape[0]:
         raise ValueError("splits must sum to tensor.shape[0]")
     if nproc == 1:
-        return tensor
+        return _fulfilled(name, tensor)
     handle = Handle(name)
     _register(name, handle)
     try:
@@ -848,7 +889,7 @@ def alltoall(tensor, splits=None, name: Optional[str] = None):
             handle._fulfill(out)
     except Exception as err:
         handle._fail(HorovodInternalError(str(err)))
-    return synchronize(handle)
+    return handle
 
 
 def _allgather_host_metadata(arr: np.ndarray) -> np.ndarray:

@@ -78,6 +78,18 @@ class TestEagerCollectives:
         assert hvd.poll(h)
         np.testing.assert_allclose(np.asarray(out), 1.0)
 
+    def test_async_variants_single_process(self):
+        """allgather/broadcast/alltoall async handles (reference
+        ``*_async`` in ``torch/mpi_ops.py``) resolve through poll +
+        synchronize even on the nproc==1 short-circuit."""
+        x = jnp.arange(4, dtype=jnp.float32)
+        for h in (hvd.allgather_async(x, name="ag_a"),
+                  hvd.broadcast_async(x, 0, name="bc_a"),
+                  hvd.alltoall_async(x, name="a2a_a")):
+            assert hvd.poll(h)
+            np.testing.assert_allclose(np.asarray(hvd.synchronize(h)),
+                                       np.asarray(x))
+
     def test_duplicate_name_rejected(self):
         h1 = hvd.allreduce_async(jnp.ones((2,)), name="dup")
         with pytest.raises(hvd.HorovodInternalError, match="same name"):

@@ -69,6 +69,21 @@ class TestTwoProcessWorld:
                 np.asarray(t), np.asarray(expected, np.float32)
                 if r else np.asarray([0., 1., 10., 11.]))
 
+            # async variants of the non-allreduce collectives: handles
+            # resolve to the same results across a real 2-process world
+            hg = hvd.allgather_async(jnp.full((r + 1, 2), float(r)),
+                                     name="ag_async")
+            hb = hvd.broadcast_async(jnp.full((3,), float(r * 10)),
+                                     root_rank=1, name="bc_async")
+            ht = hvd.alltoall_async(jnp.arange(4.0) + 10 * r,
+                                    splits=[2, 2], name="a2a_async")
+            assert hvd.synchronize(hg).shape == (3, 2)
+            np.testing.assert_allclose(np.asarray(hvd.synchronize(hb)),
+                                       10.0)
+            np.testing.assert_allclose(
+                np.asarray(hvd.synchronize(ht)),
+                np.asarray(t))
+
             # barrier + object exchange
             hvd.barrier()
             objs = hvd.allgather_object({"rank": r})
