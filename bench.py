@@ -102,8 +102,10 @@ def run_resnet(args, hvd):
         if image_size == 224:
             image_size = 96          # keep the CPU smoke run tractable
             batch_size = 16
+    spc = args.steps_per_call if platform == "tpu" else 1
     log(f"bench[resnet]: {n_chips} chip(s) on {platform}, "
-        f"batch {batch_size}/chip, {image_size}px, {dtype}")
+        f"batch {batch_size}/chip, {image_size}px, {dtype}, "
+        f"steps_per_call {spc}")
 
     compute_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
     model = ResNet50(num_classes=1000, dtype=compute_dtype,
@@ -116,7 +118,7 @@ def run_resnet(args, hvd):
 
     step = hvd.DistributedTrainStep(
         loss_fn, optax.sgd(0.01 * n_chips, momentum=0.9),
-        steps_per_call=args.steps_per_call,
+        steps_per_call=spc,
         compiler_options=tpu_compiler_options(args))
     x0 = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
     params, opt_state = step.init(
@@ -134,7 +136,7 @@ def run_resnet(args, hvd):
         lambda s: step(s[0], s[1], batch), (params, opt_state, None),
         args.num_warmup_batches, args.num_iters,
         args.num_batches_per_iter,
-        global_bs * args.steps_per_call, "resnet") / n_chips
+        global_bs * spc, "resnet") / n_chips
 
     # MFU: fwd+bwd ≈ 3 × 4.1 GFLOP/img at 224px (scaled for other sizes).
     # PERF_NOTES.md derives why the structural ceiling for this model on
@@ -164,9 +166,10 @@ def run_transformer(args, hvd):
         layers, d_model, heads, seq, batch, dtype, attn = (
             args.tf_layers, args.tf_d_model, args.tf_heads, args.tf_seq_len,
             args.tf_batch_size, jnp.bfloat16, args.tf_attention)
+    spc = args.steps_per_call if platform == "tpu" else 1
     log(f"bench[transformer]: {n_chips} chip(s) on {platform}, "
         f"{layers}L/{d_model}d, seq {seq}, batch {batch}/chip, "
-        f"attention={attn}")
+        f"attention={attn}, steps_per_call {spc}")
 
     cfg = TransformerConfig(
         vocab_size=32_000, num_layers=layers, num_heads=heads,
@@ -181,7 +184,7 @@ def run_transformer(args, hvd):
 
     step = hvd.DistributedTrainStep(
         loss_fn, optax.adamw(3e-4),
-        steps_per_call=args.steps_per_call,
+        steps_per_call=spc,
         compiler_options=tpu_compiler_options(args))
     tokens0 = jnp.zeros((1, seq), jnp.int32)
     variables = model.init(jax.random.PRNGKey(0), tokens0)
@@ -201,7 +204,7 @@ def run_transformer(args, hvd):
         lambda s: step(s[0], s[1], batch_data), (params, opt_state, None),
         args.num_warmup_batches, args.num_iters,
         args.num_batches_per_iter,
-        global_bs * seq * args.steps_per_call, "transformer") / n_chips
+        global_bs * seq * spc, "transformer") / n_chips
 
     # fwd+bwd FLOPs/token: 6·P (params incl. the tied embedding head,
     # whose 6·V·d logits share stands in for the lookup) + causal
@@ -234,6 +237,10 @@ def main():
                         "per-call launch overhead")
     p.add_argument("--no-compiler-options", action="store_true",
                    help="disable the default TPU XLA compile options")
+    p.add_argument("--platform", default=None,
+                   help="force a jax backend (e.g. cpu) — env "
+                        "JAX_PLATFORMS alone is overridden by this "
+                        "image's sitecustomize")
     p.add_argument("--dtype", default="bfloat16",
                    choices=["bfloat16", "float32"])
     p.add_argument("--space-to-depth", dest="space_to_depth",
@@ -255,6 +262,8 @@ def main():
     p.add_argument("--tf-attention", default="flash",
                    choices=["dense", "flash"])
     args = p.parse_args()
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
 
     import horovod_tpu as hvd
 
