@@ -23,7 +23,8 @@ import optax
 
 def parse_args():
     p = argparse.ArgumentParser()
-    p.add_argument("--model", default="mlp", choices=["mlp", "resnet50"])
+    p.add_argument("--model", default="mlp",
+                   choices=["mlp", "resnet50", "vit"])
     p.add_argument("--batch-size", type=int, default=32,
                    help="per-chip batch size")
     p.add_argument("--num-iters", type=int, default=10)
@@ -59,6 +60,19 @@ def make_model(name: str):
         input_shape = (28, 28, 1)
         return init, apply, input_shape
 
+    if name == "vit":
+        from horovod_tpu.models import ViT_S16
+
+        model = ViT_S16(image_size=224, patch_size=16, num_classes=1000)
+
+        def init(key):
+            return model.init(key, jnp.zeros((1, 224, 224, 3), jnp.float32))
+
+        def apply(params, x):
+            return model.apply(params, x)
+
+        return init, apply, (224, 224, 3)
+
     from horovod_tpu.models.resnet import ResNet50
 
     model = ResNet50(num_classes=1000)
@@ -83,7 +97,7 @@ def main():
     hvd.init()
 
     init, apply, input_shape = make_model(args.model)
-    num_classes = 10 if args.model == "mlp" else 1000
+    num_classes = 10 if args.model == "mlp" else 1000  # vit/resnet: 1000
 
     def loss_fn(params, batch):
         logits = apply(params, batch["x"])
