@@ -199,6 +199,55 @@ class TestStreamingFit:
         with pytest.raises(ValueError, match="row group"):
             est.fit_on_parquet(store.get_train_data_path())
 
+    def test_streaming_false_keeps_in_memory_path_with_store(self):
+        """streaming=False with a store opts back into the in-memory
+        training path while still writing the run layout."""
+        from horovod_tpu import estimator as est_mod
+
+        readers = []
+        orig_init = est_mod.RowGroupReader.__init__
+        est_mod.RowGroupReader.__init__ = \
+            lambda self, path: (orig_init(self, path),
+                                readers.append(self))[0]
+        try:
+            df = make_df(64)
+            import tempfile
+
+            store_dir = tempfile.mkdtemp()
+            est = Estimator(Net(), feature_cols=["f1", "f2", "f3", "f4"],
+                            label_col="label", batch_size=8, epochs=2,
+                            store=store_dir, streaming=False)
+            est.fit(df)
+        finally:
+            est_mod.RowGroupReader.__init__ = orig_init
+        assert not readers, "streaming=False must not open shard readers"
+        import os
+
+        assert os.path.exists(os.path.join(
+            store_dir, "runs", "run_001", "metadata.json"))
+        assert os.path.exists(os.path.join(
+            store_dir, "intermediate_train_data"))
+
+    def test_reader_spans_multiple_parquet_files(self, tmp_path):
+        """RowGroupReader treats all part files of a data dir as one
+        group sequence (Spark writes many part-*.parquet)."""
+        import pandas as pd
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from horovod_tpu.spark.store import RowGroupReader
+
+        for part in range(2):
+            df = pd.DataFrame({"a": np.arange(6) + 10 * part})
+            pq.write_table(pa.Table.from_pandas(df, preserve_index=False),
+                          str(tmp_path / f"part-{part:05d}.parquet"),
+                          row_group_size=3)
+        reader = RowGroupReader(str(tmp_path))
+        assert reader.num_row_groups == 4
+        assert reader.group_rows == [3, 3, 3, 3]
+        # global index 2 = second file's first group
+        assert list(reader.read_group(2)["a"]) == [10, 11, 12]
+
     def test_transform_chunks_match_full(self):
         rng = np.random.RandomState(0)
         data = {"x": rng.rand(50, 4).astype(np.float32),
