@@ -1,21 +1,23 @@
 """Per-op device profile of the ResNet-50 bench step (PERF_NOTES tables).
 
 Captures a ``jax.profiler`` trace of the exact ``bench.py`` train step
-on the real chip and prints the top device ops by total time, with
-achieved HBM bandwidth where the op's ``bytes accessed`` stat is
-recorded.  The xplane protobuf is parsed with the proto bundled in
-tensorflow.tsl — no tensorboard UI needed.
+on the real chip and prints exclusive per-op device times — the "XLA
+Ops" line of the xplane proto (parsed with the proto bundled in
+``tensorflow.tsl``; no tensorboard UI needed), with nested event
+durations subtracted from their parents so wrapper events (the step
+``while``, the jit module) and async copy spans don't double count.
 
 Usage::
 
     python examples/profile_resnet.py --top 30 [--steps-per-call 4]
-        [--no-lhs] [--space-to-depth]
+        [--no-lhs] [--no-space-to-depth]
 """
 
 import argparse
 import collections
 import glob
 import os
+import re
 import tempfile
 
 import jax
@@ -43,6 +45,8 @@ def build_step(batch_size, image_size, steps_per_call, lhs, s2d):
         loss_fn, optax.sgd(0.01, momentum=0.9),
         steps_per_call=steps_per_call, compiler_options=opts)
     x0 = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+    # jit the init: eagerly it is hundreds of per-op dispatches, minutes
+    # through the remote tunnel
     params, opt_state = step.init(jax.jit(
         lambda k: model.init(k, x0, train=False))(jax.random.PRNGKey(0)))
     rng = np.random.RandomState(0)
@@ -54,34 +58,41 @@ def build_step(batch_size, image_size, steps_per_call, lhs, s2d):
     return step, params, opt_state, batch
 
 
-def collect_op_stats(trace_dir):
+def exclusive_op_times(trace_dir):
+    """{op name: self ps} from the device "XLA Ops" line, with child
+    durations subtracted from enclosing events via an interval stack."""
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
     paths = sorted(glob.glob(
         os.path.join(trace_dir, "plugins/profile/*/*.xplane.pb")))
     xs = xplane_pb2.XSpace()
     xs.ParseFromString(open(paths[-1], "rb").read())
-    ops = collections.defaultdict(lambda: [0.0, 0, 0.0])  # ps, count, bytes
+    self_ps: dict = collections.defaultdict(float)
     for plane in xs.planes:
         if not plane.name.startswith("/device:TPU"):
             continue
-        stat_names = dict(plane.stat_metadata.items())
         ev_meta = dict(plane.event_metadata.items())
         for line in plane.lines:
-            for ev in line.events:
-                name = ev_meta[ev.metadata_id].name \
-                    if ev.metadata_id in ev_meta else "?"
-                rec = ops[name]
-                rec[0] += ev.duration_ps
-                rec[1] += 1
-                for st in ev.stats:
-                    sname = stat_names[st.metadata_id].name \
-                        if st.metadata_id in stat_names else ""
-                    if "bytes accessed" in sname.lower() and \
-                            not sname.lower().rstrip("0123456789}{ ") \
-                                     .endswith("breakdown"):
-                        rec[2] += st.uint64_value or st.int64_value
-    return ops
+            if line.name != "XLA Ops":
+                continue
+            evs = sorted(
+                (e.offset_ps, e.offset_ps + e.duration_ps, e.metadata_id)
+                for e in line.events)
+            stack = []
+            for s, t, mid in evs:
+                while stack and stack[-1][1] <= s:
+                    stack.pop()
+                name = ev_meta[mid].name if mid in ev_meta else "?"
+                if stack:
+                    self_ps[stack[-1][2]] -= (t - s)
+                self_ps[name] += (t - s)
+                stack.append((s, t, name))
+    return self_ps
+
+
+def op_kind(name: str) -> str:
+    m = re.match(r"%?([a-zA-Z_\-]+)", name.split(" = ")[0])
+    return m.group(1) if m else name
 
 
 def main():
@@ -109,18 +120,26 @@ def main():
         float(loss)
     print(f"trace: {trace_dir}")
 
-    ops = collect_op_stats(trace_dir)
+    self_ps = exclusive_op_times(trace_dir)
     nsteps = args.steps_per_call
-    total_ms = sum(v[0] for v in ops.values()) / 1e9 / nsteps
-    print(f"device op time: {total_ms:.2f} ms/step "
-          f"({len(ops)} distinct ops, {nsteps} steps traced)")
-    print(f"{'op':60s} {'ms/step':>8s} {'%':>5s} {'GB/s':>6s}")
-    ranked = sorted(ops.items(), key=lambda kv: -kv[1][0])
-    for name, (ps, cnt, nbytes) in ranked[:args.top]:
+    total_ms = sum(self_ps.values()) / 1e9 / nsteps
+    print(f"device exclusive op time: {total_ms:.2f} ms/step "
+          f"({len(self_ps)} distinct ops, {nsteps} steps traced)")
+
+    by_kind = collections.defaultdict(float)
+    for name, ps in self_ps.items():
+        by_kind[op_kind(name)] += ps
+    print("\n-- by op class (ms/step) --")
+    for k, v in sorted(by_kind.items(), key=lambda kv: -kv[1])[:12]:
+        ms = v / 1e9 / nsteps
+        if ms >= 0.005:
+            print(f"{k:36s} {ms:8.2f}  {ms / total_ms * 100:5.1f}%")
+
+    print(f"\n-- top {args.top} ops (self ms/step) --")
+    ranked = sorted(self_ps.items(), key=lambda kv: -kv[1])
+    for name, ps in ranked[:args.top]:
         ms = ps / 1e9 / nsteps
-        bw = (nbytes / nsteps) / (ms / 1e3) / 1e9 if nbytes else 0
-        print(f"{name[:60]:60s} {ms:8.3f} {ms / total_ms * 100:5.1f} "
-              f"{bw:6.0f}")
+        print(f"{name[:84]:84s} {ms:7.3f}")
 
 
 if __name__ == "__main__":
