@@ -84,6 +84,29 @@ class TestTwoProcessWorld:
                 np.asarray(hvd.synchronize(ht)),
                 np.asarray(t))
 
+            # interleaving a bucketed (deferred-dispatch) allreduce with
+            # an immediate-negotiation async collective must not
+            # misalign the negotiation order across processes: both
+            # processes run identical program order, the broadcast
+            # negotiates at submit, the allreduce at its flush — same
+            # wire sequence everywhere, either synchronize order
+            ar_h = hvd.allreduce_async(jnp.full((2,), float(r + 1)),
+                                       op=hvd.Sum, name="ilv_ar")
+            bc_h = hvd.broadcast_async(jnp.full((2,), float(r + 5)),
+                                       root_rank=0, name="ilv_bc")
+            np.testing.assert_allclose(
+                np.asarray(hvd.synchronize(ar_h)), 3.0)
+            np.testing.assert_allclose(
+                np.asarray(hvd.synchronize(bc_h)), 5.0)
+            ar_h = hvd.allreduce_async(jnp.full((2,), float(r + 1)),
+                                       op=hvd.Sum, name="ilv_ar2")
+            bc_h = hvd.broadcast_async(jnp.full((2,), float(r + 6)),
+                                       root_rank=1, name="ilv_bc2")
+            np.testing.assert_allclose(
+                np.asarray(hvd.synchronize(bc_h)), 7.0)
+            np.testing.assert_allclose(
+                np.asarray(hvd.synchronize(ar_h)), 3.0)
+
             # barrier + object exchange
             hvd.barrier()
             objs = hvd.allgather_object({"rank": r})
