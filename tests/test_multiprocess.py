@@ -434,6 +434,45 @@ class TestTwoProcessWorld:
         assert (store_dir / "runs/run_001/metadata.json").exists()
         assert (store_dir / "intermediate_train_data").exists()
 
+    def test_zero_splits_and_integer_dtypes(self, tmp_path):
+        """Reference edge cases: alltoall with zero-row splits
+        (``test_tensorflow.py`` zero-splits cases) and integer-dtype
+        allreduce survive the wire across a real 2-process world."""
+        out = launch("""
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import jax.numpy as jnp
+            import numpy as np
+            import horovod_tpu as hvd
+
+            hvd.init()
+            r = hvd.process_rank()
+
+            # rank 0 sends everything to rank 1; rank 1 sends nothing
+            rows = 3 if r == 0 else 0
+            t = hvd.alltoall(jnp.full((rows, 2), float(r)),
+                             splits=[0, rows], name="z.a2a")
+            if r == 0:
+                assert t.shape == (0, 2), t.shape
+            else:
+                np.testing.assert_allclose(np.asarray(t),
+                                           np.zeros((3, 2)))
+
+            # integer allreduce: SUM of int32 stays exact
+            s = hvd.allreduce(jnp.full((4,), 7 + r, jnp.int32),
+                              op=hvd.Sum, name="z.int")
+            assert s.dtype == jnp.int32
+            np.testing.assert_array_equal(np.asarray(s), 15)
+
+            # int32 variable allgather
+            g = hvd.allgather(jnp.arange(r + 1, dtype=jnp.int32),
+                              name="z.ag")
+            np.testing.assert_array_equal(np.asarray(g), [0, 0, 1])
+            print("WORKER_OK", r)
+        """, tmp_path)
+        assert out.returncode == 0, out.stderr[-3000:]
+        assert out.stdout.count("WORKER_OK") == 2
+
     def test_estimator_streaming_shards_are_disjoint(self, tmp_path):
         """fit_on_parquet across 2 processes: each process materializes
         only its round-robin row groups (read accounting), never the
