@@ -434,6 +434,50 @@ class TestTwoProcessWorld:
         assert (store_dir / "runs/run_001/metadata.json").exists()
         assert (store_dir / "intermediate_train_data").exists()
 
+    def test_multidevice_processes_hierarchical_mesh(self, tmp_path):
+        """2 processes x 2 virtual devices each: the (dcn, ici) = (2, 2)
+        hierarchical mesh with partially-addressable batch arrays —
+        each process feeds only its own devices' shards, training stays
+        bit-identical across ranks."""
+        out = launch("""
+            import os
+            os.environ["XLA_FLAGS"] = \
+                "--xla_force_host_platform_device_count=2"
+            os.environ["HOROVOD_TPU_MESH_SHAPE"] = "2,2"
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import jax.numpy as jnp
+            import numpy as np
+            import optax
+            import horovod_tpu as hvd
+
+            hvd.init()
+            assert hvd.process_count() == 2
+            assert hvd.size() == 4, hvd.size()
+            assert jax.local_device_count() == 2
+
+            def loss_fn(params, batch):
+                pred = batch["x"] @ params
+                return jnp.mean((pred - batch["y"]) ** 2)
+
+            step = hvd.DistributedTrainStep(loss_fn, optax.sgd(0.1))
+            params, opt_state = step.init(jnp.zeros((4,)))
+            rng = np.random.RandomState(0)
+            x = rng.rand(8, 4).astype(np.float32)
+            y = (x @ np.ones(4, np.float32))
+            losses = []
+            for _ in range(3):
+                b = step.shard_batch({"x": x, "y": y})
+                params, opt_state, loss = step(params, opt_state, b)
+                losses.append(float(loss))
+            assert losses[0] > losses[-1] > 0
+            agreed = hvd.allgather_object(losses)
+            assert agreed[0] == agreed[1], agreed
+            print("WORKER_OK", hvd.process_rank())
+        """, tmp_path)
+        assert out.returncode == 0, out.stderr[-3000:]
+        assert out.stdout.count("WORKER_OK") == 2
+
     def test_zero_splits_and_integer_dtypes(self, tmp_path):
         """Reference edge cases: alltoall with zero-row splits
         (``test_tensorflow.py`` zero-splits cases) and integer-dtype
