@@ -172,7 +172,28 @@ class DistributedTrainStep:
         self._compiled_cache: dict = {}
 
     def init(self, params):
-        """Place params on the mesh replicated and build optimizer state."""
+        """Place params on the mesh replicated and build optimizer state.
+
+        Accepts leaves that are already *cross-process* arrays — e.g.
+        the output of ``broadcast_variables``, whose eager plane places
+        one replica per process.  ``device_put`` of such an array onto
+        the full mesh is an illegal cross-host reshard (the device sets
+        differ) whenever processes own more than one device, so
+        fully-replicated cross-process leaves are first dropped to their
+        local host copy.
+        """
+        def localize(x):
+            if isinstance(x, jax.Array) and \
+                    not x.sharding.is_fully_addressable:
+                if not x.is_fully_replicated:
+                    raise ValueError(
+                        "DistributedTrainStep.init expects replicated "
+                        f"params; got a cross-process array sharded as "
+                        f"{x.sharding}")
+                return np.asarray(x)       # local copy of the replica
+            return x
+
+        params = jax.tree_util.tree_map(localize, params)
         params = jax.device_put(params, self._replicated)
         opt_state = jax.device_put(self._optimizer.init(params),
                                    self._replicated)

@@ -478,6 +478,57 @@ class TestTwoProcessWorld:
         assert out.returncode == 0, out.stderr[-3000:]
         assert out.stdout.count("WORKER_OK") == 2
 
+    def test_streaming_fit_on_multidevice_processes(self, tmp_path):
+        """Streaming fit on 2 processes x 2 devices: shard_local_batch
+        must lay each process's locally-read rows across its own two
+        devices (make_array_from_process_local_data path) while row
+        groups stay sharded per process."""
+        store_dir = tmp_path / "store"
+        import numpy as np
+        import pandas as pd
+
+        from horovod_tpu.spark import Store
+
+        rng = np.random.RandomState(0)
+        x = rng.rand(96, 4).astype(np.float32)
+        y = (x @ rng.rand(4, 3)).argmax(1).astype(np.int32)
+        df = pd.DataFrame({"f1": x[:, 0], "f2": x[:, 1], "f3": x[:, 2],
+                           "f4": x[:, 3], "label": y})
+        store = Store.create(str(store_dir))
+        store.write_dataframe(df, store.get_train_data_path(),
+                              rows_per_group=12)
+
+        out = launch(f"""
+            import os
+            os.environ["XLA_FLAGS"] = \
+                "--xla_force_host_platform_device_count=2"
+            os.environ["HOROVOD_TPU_MESH_SHAPE"] = "2,2"
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import flax.linen as nn
+            import horovod_tpu as hvd
+            from horovod_tpu.spark import Estimator, Store
+
+            class Net(nn.Module):
+                @nn.compact
+                def __call__(self, x):
+                    return nn.Dense(3)(nn.relu(nn.Dense(8)(x)))
+
+            store = Store.create({str(store_dir)!r})
+            est = Estimator(Net(), feature_cols=["f1", "f2", "f3", "f4"],
+                            label_col="label", batch_size=4, epochs=2)
+            model = est.fit_on_parquet(store.get_train_data_path())
+            assert jax.local_device_count() == 2
+            leaf = np.asarray(jax.tree_util.tree_leaves(model.params)[0],
+                              np.float32)
+            digests = hvd.allgather_object(float(np.abs(leaf).sum()))
+            assert digests[0] == digests[1], digests
+            print("WORKER_OK", hvd.process_rank())
+        """, tmp_path)
+        assert out.returncode == 0, out.stderr[-3000:]
+        assert out.stdout.count("WORKER_OK") == 2
+
     def test_zero_splits_and_integer_dtypes(self, tmp_path):
         """Reference edge cases: alltoall with zero-row splits
         (``test_tensorflow.py`` zero-splits cases) and integer-dtype
