@@ -296,3 +296,22 @@ class TestElasticEndToEnd:
         # no lost state: failure at (1,0) happened after epoch 0's commit;
         # the survivor restored and re-ran epoch 1 fully
         assert results[2]["w"] == pytest.approx(6.0)
+
+    def test_growth_with_multidevice_workers(self, tmp_path):
+        """Elastic grow 1→2 where every worker owns TWO devices: the
+        world reset must rebuild the (dcn, ici) mesh and the eager
+        process-mesh across multi-device processes (the real pod-host
+        shape) without resharding errors."""
+        schedule = [
+            (0, ["localhost:1"]),
+            (1, ["localhost:1", "127.0.0.1:1"]),
+            (None, ["localhost:1", "127.0.0.1:1"]),
+        ]
+        proc, results = run_elastic(
+            tmp_path, schedule, np=1, min_np=1, max_np=2,
+            extra_env={"XLA_FLAGS":
+                       "--xla_force_host_platform_device_count=2"})
+        assert proc.returncode == 0, (
+            proc.stderr[-3000:] + worker_logs(tmp_path))
+        sizes = [r["size"] for r in results]
+        assert sizes[0] == 1 and sizes[-1] == 2, results
