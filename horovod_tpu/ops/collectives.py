@@ -291,40 +291,68 @@ def alltoall(x: jax.Array, axis: AxisSpec = GLOBAL_AXES,
     """Equal-splits alltoall (reference ``EnqueueTensorAlltoall``,
     ``operations.cc:979``; ``NCCLAlltoall`` P2P impl
     ``nccl_operations.cc:569``).  The variable-``splits`` form of the
-    reference maps to :func:`alltoall_v`."""
+    reference maps to :func:`alltoall_v`.
+
+    Over an axis *tuple* (the reference's GLOBAL communicator over the
+    (dcn, ici) mesh) the exchange decomposes into one per-axis
+    ``all_to_all`` per mesh level: with destination ranks linearized
+    row-major as ``(s, t)``, exchanging the ``t``-index over ici and the
+    ``s``-index over dcn commute and compose to the global permutation
+    ``out[s, t] = in_{(s,t)}[p, q]`` — each level's traffic rides that
+    level's interconnect (ICI stays on ICI; only the dcn-level exchange
+    crosses DCN), which is strictly better than flattening to one big
+    ring the way a rank-linearized NCCL alltoall would.
+    """
     if isinstance(axis, (tuple, list)) and len(axis) == 1:
         axis = axis[0]
-    if isinstance(axis, (tuple, list)):
-        # flatten multi-axis alltoall: gather over dcn then alltoall on ici
-        # covers the common single-slice-axis cases; true 2-level alltoall
-        # is composed by the caller.
-        raise NotImplementedError(
-            "alltoall over a multi-axis tuple: compose per-axis calls or "
-            "use a flat mesh axis")
-    return lax.all_to_all(x, axis, split_axis=split_axis,
-                          concat_axis=concat_axis, tiled=True)
+    if isinstance(axis, str):
+        return lax.all_to_all(x, axis, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+    axes = tuple(axis)
+    sizes = [lax.axis_size(a) for a in axes]
+    n = axis_size(axes)
+    if x.shape[split_axis] % n:
+        raise ValueError(
+            f"alltoall split dim {x.shape[split_axis]} not divisible by "
+            f"world size {n}")
+    chunk = x.shape[split_axis] // n
+    lead, tail = x.shape[:split_axis], x.shape[split_axis + 1:]
+    # expose one dim per mesh level (row-major, matching axis_index), then
+    # exchange each level's index along its own axis
+    y = x.reshape(lead + tuple(sizes) + (chunk,) + tail)
+    for k, a in enumerate(axes):
+        y = lax.all_to_all(y, a, split_axis=split_axis + k,
+                           concat_axis=split_axis + k, tiled=True)
+    if concat_axis == split_axis:
+        return y.reshape(lead + (n * chunk,) + tail)
+    # chunks received from the n peers concatenate along a different dim:
+    # isolate the peer dim, move it to just before the concat target, merge
+    y = y.reshape(lead + (n, chunk) + tail)
+    y = jnp.moveaxis(y, split_axis, concat_axis)
+    out_shape = list(x.shape)
+    out_shape[split_axis] = chunk
+    out_shape[concat_axis] *= n
+    return y.reshape(out_shape)
 
 
 def alltoall_v(x: jax.Array, send_counts: jax.Array, max_count: int,
-               axis: str = AXIS_ICI):
+               axis: AxisSpec = AXIS_ICI):
     """Variable-splits alltoall on top of the equal-tile primitive.
 
     Reference semantics (``AlltoallOp::PrepareOutputAndParams``,
     ``collective_operations.h:206-256``): rank r sends ``send_counts[d]``
     rows to each destination d.  Static-shape formulation: the caller packs
-    rows destined to d into slot d of a ``(world, max_count, ...)`` buffer;
+    rows destined to d into slot d of a ``(world, max_count, ...)`` buffer
+    (d linearized row-major over an axis tuple, matching ``axis_index``);
     we alltoall the slots and return ``(received, recv_counts)`` — the
     recv-splits negotiation (``mpi_controller.cc:212``) becomes one tiny
-    int alltoall.
+    int alltoall.  Works over a single axis or the full (dcn, ici) tuple.
     """
-    world = lax.axis_size(axis)
+    world = int(axis_size(axis))
     assert x.shape[0] == world and x.shape[1] == max_count, (
         "alltoall_v input must be (world, max_count, ...) slot-packed")
-    received = lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
-                              tiled=False)
-    recv_counts = lax.all_to_all(
-        jnp.asarray(send_counts, jnp.int32).reshape(world, 1), axis,
-        split_axis=0, concat_axis=0, tiled=True).reshape(world)
+    received = alltoall(x, axis=axis)
+    recv_counts = alltoall(jnp.asarray(send_counts, jnp.int32), axis=axis)
     return received, recv_counts
 
 

@@ -186,6 +186,80 @@ class TestAlltoall:
             np.testing.assert_array_equal(
                 out[d, :, 0], np.arange(8) * 10 + d)
 
+    def test_global_mesh_tuple(self):
+        """Alltoall over the full (dcn, ici) 2x4 mesh matches a numpy
+        permutation reference: out[r][s] == in[s][r] chunkwise (reference
+        alltoall over the GLOBAL communicator, ``operations.cc:979``)."""
+        chunk = 3
+
+        def f():
+            r = C.axis_index(GLOBAL_AXES)
+            # chunk d of rank r's input = r*100 + d, 2 feature cols
+            x = jnp.repeat(r * 100 + jnp.arange(N, dtype=jnp.int32), chunk)
+            x = x[:, None] * jnp.ones((1, 2), jnp.int32)
+            return C.alltoall(x, axis=GLOBAL_AXES)[None]
+
+        out = np.asarray(run_spmd(f))
+        assert out.shape == (N, N * chunk, 2)
+        for r in range(N):
+            # out[r] = concat over sources s of chunk r of rank s's input
+            expected = np.repeat(np.arange(N) * 100 + r, chunk)
+            np.testing.assert_array_equal(out[r, :, 0], expected)
+            np.testing.assert_array_equal(out[r, :, 1], expected)
+
+    def test_global_mesh_tuple_split_concat_axes(self):
+        """Nonzero split/concat axes over the (dcn, ici) tuple agree with
+        the flat single-axis alltoall on an 8-wide mesh."""
+        def tuple_f():
+            r = C.axis_index(GLOBAL_AXES)
+            x = (r * 1000 + jnp.arange(2 * N * 3, dtype=jnp.int32)
+                 ).reshape(2, N, 3).astype(jnp.float32)
+            return C.alltoall(x, axis=GLOBAL_AXES, split_axis=1,
+                              concat_axis=2)[None]
+
+        out_tuple = np.asarray(run_spmd(tuple_f))
+
+        devs = np.asarray(jax.devices("cpu")[:8])
+        flat_mesh = Mesh(devs, ("ranks",))
+
+        def flat_f():
+            r = jax.lax.axis_index("ranks")
+            x = (r * 1000 + jnp.arange(2 * N * 3, dtype=jnp.int32)
+                 ).reshape(2, N, 3).astype(jnp.float32)
+            return C.alltoall(x, axis="ranks", split_axis=1,
+                              concat_axis=2)[None]
+
+        out_flat = np.asarray(jax.jit(jax.shard_map(
+            flat_f, mesh=flat_mesh, in_specs=(), out_specs=P("ranks"),
+            check_vma=False))())
+        assert out_tuple.shape == (N, 2, 1, 3 * N)
+        np.testing.assert_array_equal(out_tuple, out_flat)
+
+    def test_variable_splits_global_mesh(self):
+        """alltoall_v over the (dcn, ici) tuple: rank r sends (d+1) rows to
+        destination d; every rank's recv_counts name each source's count."""
+        max_count = N
+
+        def f():
+            r = C.axis_index(GLOBAL_AXES)
+            send_counts = jnp.arange(1, N + 1, dtype=jnp.int32)
+            rows = jnp.arange(max_count)[None, :, None]
+            dest = jnp.arange(N)[:, None, None]
+            slots = jnp.where(rows < (dest + 1),
+                              100.0 * r + dest, 0.0).astype(jnp.float32)
+            recv, counts = C.alltoall_v(slots, send_counts, max_count,
+                                        axis=GLOBAL_AXES)
+            return recv[None], counts[None]
+
+        recv, counts = run_spmd(f, out_specs=(P(GLOBAL_AXES),
+                                              P(GLOBAL_AXES)))
+        recv, counts = np.asarray(recv), np.asarray(counts)
+        for me in range(N):
+            np.testing.assert_array_equal(counts[me], me + 1)
+            for src in range(N):
+                np.testing.assert_allclose(
+                    recv[me, src, :me + 1, 0], 100.0 * src + me)
+
     def test_variable_splits(self):
         devs = np.asarray(jax.devices("cpu")[:4])
         mesh = Mesh(devs, ("ranks",))
