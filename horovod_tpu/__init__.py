@@ -248,6 +248,7 @@ from horovod_tpu.functions import (  # noqa: E402
     broadcast_variables,
 )
 from horovod_tpu.optim import (  # noqa: E402
+    DistributedAdasumOptimizer,
     DistributedGradientTape,
     DistributedOptimizer,
     DistributedTrainStep,
@@ -279,7 +280,8 @@ __all__ = [
     "broadcast_variables", "broadcast_parameters", "broadcast_object",
     "broadcast_optimizer_state", "allgather_object",
     # optimizer layer
-    "DistributedOptimizer", "DistributedGradientTape", "DistributedTrainStep",
+    "DistributedOptimizer", "DistributedAdasumOptimizer",
+    "DistributedGradientTape", "DistributedTrainStep",
     "SyncBatchNorm",
     # callbacks + checkpoint + elastic
     "callbacks", "checkpoint", "elastic",

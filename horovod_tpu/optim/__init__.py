@@ -8,8 +8,10 @@ Reference surface being re-created: ``horovod/torch/optimizer.py``
 """
 
 from horovod_tpu.optim.optimizer import (
+    DistributedAdasumOptimizer,
     DistributedGradientTape,
     DistributedOptimizer,
+    adasum_updates,
     distributed_gradients,
 )
 from horovod_tpu.optim.sync_batch_norm import SyncBatchNorm, sync_batch_stats
@@ -17,8 +19,10 @@ from horovod_tpu.optim.train_step import DistributedTrainStep, join_step
 
 __all__ = [
     "DistributedOptimizer",
+    "DistributedAdasumOptimizer",
     "DistributedGradientTape",
     "distributed_gradients",
+    "adasum_updates",
     "DistributedTrainStep",
     "join_step",
     "SyncBatchNorm",

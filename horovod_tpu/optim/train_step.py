@@ -56,6 +56,9 @@ class DistributedTrainStep:
     gradient reduction done by
     :func:`horovod_tpu.ops.collectives.grouped_allreduce` — useful when the
     user wants Adasum (``op=Adasum``), compression, or explicit control.
+    ``op=None`` skips the gradient reduction entirely for optimizers that
+    own their distribution, e.g. the delta-form
+    :func:`~horovod_tpu.optim.DistributedAdasumOptimizer`.
     """
 
     def __init__(self,
@@ -63,7 +66,7 @@ class DistributedTrainStep:
                  optimizer: optax.GradientTransformation,
                  mesh=None,
                  mode: str = "pjit",
-                 op: ReduceOp = Average,
+                 op: Optional[ReduceOp] = Average,
                  compression=None,
                  remat: bool = False,
                  data_axes: AxisSpec = GLOBAL_AXES,
@@ -97,6 +100,12 @@ class DistributedTrainStep:
         repl = NamedSharding(self._mesh, P())
         batch_sharding = NamedSharding(self._mesh, P(self._data_axes))
 
+        if mode == "shard_map" and op is None and compression is not None:
+            raise ValueError(
+                "op=None leaves gradients local, so a train-step "
+                "compression would never run; pass compression to the "
+                "distributing optimizer (e.g. DistributedAdasumOptimizer) "
+                "instead")
         if mode == "pjit" and (op != Average or compression is not None):
             # pjit autodiff performs the (mean) gradient reduction itself;
             # custom reductions/wire formats need the explicit path.
@@ -141,16 +150,22 @@ class DistributedTrainStep:
 
             def per_device(params, opt_state, batch):
                 loss, grads = jax.value_and_grad(self._loss_fn)(params, batch)
-                leaves, td = jax.tree_util.tree_flatten(grads)
-                if self._compression is not None:
-                    pairs = [self._compression.compress(g) for g in leaves]
-                    leaves = [p[0] for p in pairs]
-                    ctxs = [p[1] for p in pairs]
-                reduced = C.grouped_allreduce(leaves, op=self._op, axis=axes)
-                if self._compression is not None:
-                    reduced = [self._compression.decompress(r, c)
-                               for r, c in zip(reduced, ctxs)]
-                grads = jax.tree_util.tree_unflatten(td, reduced)
+                if self._op is not None:
+                    leaves, td = jax.tree_util.tree_flatten(grads)
+                    if self._compression is not None:
+                        pairs = [self._compression.compress(g)
+                                 for g in leaves]
+                        leaves = [p[0] for p in pairs]
+                        ctxs = [p[1] for p in pairs]
+                    reduced = C.grouped_allreduce(leaves, op=self._op,
+                                                  axis=axes)
+                    if self._compression is not None:
+                        reduced = [self._compression.decompress(r, c)
+                                   for r, c in zip(reduced, ctxs)]
+                    grads = jax.tree_util.tree_unflatten(td, reduced)
+                # op=None: gradients stay local — the optimizer chain owns
+                # the cross-shard reduction (the delta-Adasum form, where
+                # hvd.DistributedAdasumOptimizer reduces *updates*)
                 updates, opt_state = self._optimizer.update(
                     grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
