@@ -268,13 +268,15 @@ class Estimator(HasParams):
 
                 if isinstance(df, pd.DataFrame):
                     split = len(df) - n_val
+                    # intermediate data is keyed by run so two fits
+                    # sharing one store never clobber each other
                     self._store.write_dataframe(
                         df.iloc[:split],
-                        self._store.get_train_data_path())
+                        self._store.get_train_data_path(run_id))
                     if n_val:
                         self._store.write_dataframe(
                             df.iloc[split:],
-                            self._store.get_val_data_path())
+                            self._store.get_val_data_path(run_id))
 
         apply_fn = self._apply_fn()
         loss = self._loss or (
@@ -340,6 +342,13 @@ class Estimator(HasParams):
                 ckpt.save(epoch, {"params": loop.params,
                                   "opt_state": loop.opt_state})
         cbs.on_train_end(loop, logs)
+        if self._store is not None and hvd.rank() == 0:
+            # intermediate parquet copies are derived data; the run's
+            # artifacts (checkpoints, metadata, logs) are what persists.
+            # Cleanup happens on success only — a failed fit leaves them
+            # for debugging.
+            self._store.delete(self._store.get_train_data_path(run_id))
+            self._store.delete(self._store.get_val_data_path(run_id))
         return TpuModel(apply_fn, loop.params, self.feature_cols,
                         feature_specs=feature_specs)
 
@@ -368,18 +377,29 @@ class Estimator(HasParams):
             save_metadata(self._store, run_id, feature_specs, label_spec)
             split = n_rows - n_val
 
+            # run-scoped intermediate paths: concurrent fits (or a second
+            # fit while another run's readers are open) must not clobber
+            # each other's training data (reference keys by idx)
             self._store.write_dataframe(
                 _slice_rows(df, slice(None, split)),
-                self._store.get_train_data_path(), rows_per_group=rpg)
+                self._store.get_train_data_path(run_id), rows_per_group=rpg)
             if n_val:
                 self._store.write_dataframe(
                     _slice_rows(df, slice(split, None)),
-                    self._store.get_val_data_path(), rows_per_group=rpg)
+                    self._store.get_val_data_path(run_id), rows_per_group=rpg)
         hvd.barrier()     # readers must not open before the write lands
-        return self._fit_streaming(
-            self._store.get_train_data_path(),
-            self._store.get_val_data_path() if n_val else None,
+        model = self._fit_streaming(
+            self._store.get_train_data_path(run_id),
+            self._store.get_val_data_path(run_id) if n_val else None,
             feature_specs, label_spec, hvd, run_id)
+        hvd.barrier()     # every rank's readers are done
+        if hvd.rank() == 0:
+            # success: drop the run-scoped intermediate copies (a failed
+            # fit leaves them for debugging); persistent prepared data is
+            # the explicit store.prepare_data / fit_on_parquet path
+            self._store.delete(self._store.get_train_data_path(run_id))
+            self._store.delete(self._store.get_val_data_path(run_id))
+        return model
 
     def fit_on_parquet(self, train_path: str, val_path: Optional[str] = None,
                        feature_specs: Optional[Sequence[ColSpec]] = None,
@@ -502,6 +522,13 @@ class Estimator(HasParams):
                 ckpt.save(epoch, {"params": loop.params,
                                   "opt_state": loop.opt_state})
         cbs.on_train_end(loop, logs)
+        if self._store is not None and hvd.rank() == 0:
+            # intermediate parquet copies are derived data; the run's
+            # artifacts (checkpoints, metadata, logs) are what persists.
+            # Cleanup happens on success only — a failed fit leaves them
+            # for debugging.
+            self._store.delete(self._store.get_train_data_path(run_id))
+            self._store.delete(self._store.get_val_data_path(run_id))
         return TpuModel(apply_fn, loop.params, self.feature_cols,
                         feature_specs=feature_specs)
 

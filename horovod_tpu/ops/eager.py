@@ -528,6 +528,10 @@ def allreduce_async(tensor, average: Optional[bool] = None,
     tlobj = _timeline()
     if tlobj is not None:
         tlobj.start_activity(name, tl.NEGOTIATE)
+    # remember which timeline (if any) holds the open NEGOTIATE span so the
+    # flush-time close pairs B/E on the same file even if the timeline is
+    # started/stopped between enqueue and flush
+    handle._tl_neg = tlobj
     tensor = _localize(tensor)
     ctx = None
     if compression is not None:
@@ -548,12 +552,16 @@ def _dispatch_group(entries) -> None:
     nproc = process_mesh().devices.size
     tlobj = _timeline()
 
-    def _spans_end():
-        if tlobj is not None:
-            for e in entries:
-                tlobj.end_activity(e.name)
+    def _end_negotiate():
+        # close each entry's NEGOTIATE span on the timeline it was opened
+        # on at enqueue (None if the timeline was off then)
+        for e in entries:
+            t = getattr(e.handle, "_tl_neg", None)
+            if t is not None:
+                t.end_activity(e.name)
+                e.handle._tl_neg = None
 
-    span_open = True        # each entry's NEGOTIATE span opened at enqueue
+    xla_open = False
     try:
         e0 = entries[0]
         segments = tuple(int(e.tensor.size) for e in entries) \
@@ -580,10 +588,11 @@ def _dispatch_group(entries) -> None:
         # negotiation agreed: close each tensor's NEGOTIATE span and open
         # its dispatch span (reference NEGOTIATING → TOP_LEVEL → ACTIVITY
         # transition, timeline.h:77-131 + controller.cc:845-857)
-        _spans_end()
+        _end_negotiate()
         if tlobj is not None:
             for e in entries:
                 tlobj.start_activity(e.name, tl.XLA_ALLREDUCE)
+            xla_open = True
         # Always reduce the flattened concatenation — a single entry
         # too — so the compiled program depends only on (n, dtype, op,
         # scales, segments) and joined ranks can replay it exactly.
@@ -601,11 +610,15 @@ def _dispatch_group(entries) -> None:
             n = e.tensor.size
             e.handle._fulfill(red[off:off + n].reshape(e.tensor.shape))
             off += n
-        _spans_end()
-        span_open = False
+        if xla_open:
+            for e in entries:
+                tlobj.end_activity(e.name)
+            xla_open = False
     except Exception as err:  # surface as HorovodInternalError for elastic
-        if span_open:
-            _spans_end()
+        _end_negotiate()
+        if xla_open:
+            for e in entries:
+                tlobj.end_activity(e.name)
         for e in entries:
             e.handle._fail(HorovodInternalError(str(err)))
 
@@ -768,16 +781,19 @@ def _allgather_submit(tensor, name: Optional[str] = None):
     _register(name, handle)
     sizes = None
     try:
-        with tl.activity(name, tl.XLA_ALLGATHER):
+        # sequential NEGOTIATE -> XLA_* spans (docs/timeline.md contract;
+        # matches _dispatch_group's transition) so the dispatch span never
+        # absorbs negotiation wait
+        with tl.activity(name, tl.NEGOTIATE):
             # first dims may differ per process; everything else must agree
-            with tl.activity(name, tl.NEGOTIATE):
-                _negotiate({
-                    "kind": "allgather",
-                    "sig": f"{name}:{tensor.dtype}:{tuple(tensor.shape[1:])}",
-                })
+            _negotiate({
+                "kind": "allgather",
+                "sig": f"{name}:{tensor.dtype}:{tuple(tensor.shape[1:])}",
+            })
             # negotiate first-dim sizes (the controller's recvcount exchange)
             sizes = _allgather_host_metadata(
                 np.asarray([tensor.shape[0]], np.int64)).reshape(nproc)
+        with tl.activity(name, tl.XLA_ALLGATHER):
             max_rows = int(sizes.max())
             from horovod_tpu.ops import op_manager
 
@@ -814,13 +830,13 @@ def broadcast_async(tensor, root_rank: int,
     handle = Handle(name)
     _register(name, handle)
     try:
+        with tl.activity(name, tl.NEGOTIATE):
+            _negotiate({
+                "kind": "broadcast",
+                "sig": f"{name}:{tensor.dtype}:{tuple(tensor.shape)}:"
+                       f"{root_rank}",
+            })
         with tl.activity(name, tl.XLA_BROADCAST):
-            with tl.activity(name, tl.NEGOTIATE):
-                _negotiate({
-                    "kind": "broadcast",
-                    "sig": f"{name}:{tensor.dtype}:{tuple(tensor.shape)}:"
-                           f"{root_rank}",
-                })
             from horovod_tpu.ops import op_manager
 
             out = op_manager.active_op().bcast(
@@ -861,14 +877,14 @@ def alltoall_async(tensor, splits=None,
     handle = Handle(name)
     _register(name, handle)
     try:
-        with tl.activity(name, tl.XLA_ALLTOALL):
-            with tl.activity(name, tl.NEGOTIATE):
-                _negotiate({
-                    "kind": "alltoall",
-                    "sig": f"{name}:{tensor.dtype}:{tuple(tensor.shape[1:])}",
-                })
+        with tl.activity(name, tl.NEGOTIATE):
+            _negotiate({
+                "kind": "alltoall",
+                "sig": f"{name}:{tensor.dtype}:{tuple(tensor.shape[1:])}",
+            })
             all_splits = _allgather_host_metadata(splits)  # (nproc, nproc)
             all_splits = all_splits.reshape(nproc, nproc)
+        with tl.activity(name, tl.XLA_ALLTOALL):
             max_rows = int(all_splits.max())
             me = jax.process_index()
             from horovod_tpu.ops import op_manager

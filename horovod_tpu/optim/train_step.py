@@ -100,7 +100,13 @@ class DistributedTrainStep:
         repl = NamedSharding(self._mesh, P())
         batch_sharding = NamedSharding(self._mesh, P(self._data_axes))
 
-        if mode == "shard_map" and op is None and compression is not None:
+        if op is None and mode != "shard_map":
+            raise ValueError(
+                "op=None (gradients stay local; the optimizer chain owns "
+                "the reduction, e.g. DistributedAdasumOptimizer) requires "
+                "mode='shard_map' — pjit autodiff would mean-reduce the "
+                "gradients behind the optimizer's back")
+        if op is None and compression is not None:
             raise ValueError(
                 "op=None leaves gradients local, so a train-step "
                 "compression would never run; pass compression to the "
@@ -148,21 +154,17 @@ class DistributedTrainStep:
 
             axes = self._data_axes
 
+            if op is not None:
+                from horovod_tpu.optim.optimizer import distributed_gradients
+
+                reducer = distributed_gradients(
+                    op=op, axis=axes, mode="shard_map",
+                    compression=compression)
+
             def per_device(params, opt_state, batch):
                 loss, grads = jax.value_and_grad(self._loss_fn)(params, batch)
                 if self._op is not None:
-                    leaves, td = jax.tree_util.tree_flatten(grads)
-                    if self._compression is not None:
-                        pairs = [self._compression.compress(g)
-                                 for g in leaves]
-                        leaves = [p[0] for p in pairs]
-                        ctxs = [p[1] for p in pairs]
-                    reduced = C.grouped_allreduce(leaves, op=self._op,
-                                                  axis=axes)
-                    if self._compression is not None:
-                        reduced = [self._compression.decompress(r, c)
-                                   for r, c in zip(reduced, ctxs)]
-                    grads = jax.tree_util.tree_unflatten(td, reduced)
+                    grads, _ = reducer.update(grads, optax.EmptyState())
                 # op=None: gradients stay local — the optimizer chain owns
                 # the cross-shard reduction (the delta-Adasum form, where
                 # hvd.DistributedAdasumOptimizer reduces *updates*)
@@ -184,7 +186,9 @@ class DistributedTrainStep:
 
         self._batch_sharding = batch_sharding
         self._replicated = repl
-        self._compiled_cache: dict = {}
+        self._compiled_cache: dict = {}      # insertion-ordered LRU
+
+    _COMPILED_CACHE_MAX = 16
 
     def init(self, params):
         """Place params on the mesh replicated and build optimizer state.
@@ -270,18 +274,25 @@ class DistributedTrainStep:
         if self._compiler_options is None:
             return self._step(params, opt_state, batch)
         # per-compile XLA options need the AOT path: lower once per
-        # argument signature, compile with the options, reuse
+        # argument signature, compile with the options, reuse.  The key
+        # covers shardings too — an executable compiled for one input
+        # layout must not be fed same-shape differently-sharded arrays —
+        # and the cache is LRU-bounded so varying batch signatures don't
+        # accumulate executables for the process lifetime.
         leaves, treedef = jax.tree_util.tree_flatten(
             (params, opt_state, batch))
         key = (treedef,
                tuple((np.shape(l), str(getattr(l, "dtype",
-                                               type(l).__name__)))
+                                               type(l).__name__)),
+                      repr(getattr(l, "sharding", None)))
                      for l in leaves))
-        compiled = self._compiled_cache.get(key)
+        compiled = self._compiled_cache.pop(key, None)
         if compiled is None:
             compiled = self._step.lower(params, opt_state, batch).compile(
                 compiler_options=self._compiler_options)
-            self._compiled_cache[key] = compiled
+        self._compiled_cache[key] = compiled     # reinsert = most recent
+        while len(self._compiled_cache) > self._COMPILED_CACHE_MAX:
+            self._compiled_cache.pop(next(iter(self._compiled_cache)))
         return compiled(params, opt_state, batch)
 
 

@@ -102,10 +102,17 @@ def mpi_run_command(np: int, hosts: List[HostInfo], command: List[str],
             cmd += ["-iface", nics.split(",")[0]]
         else:
             cmd += ["-mca", "btl_tcp_if_include", nics]
-    if (ssh_port or ssh_identity_file) and impl != "mpich":
+    if ssh_port or ssh_identity_file:
+        if impl == "mpich":
+            # hydra has no per-arg rsh passthrough; dialing default ssh
+            # settings behind the user's back would connect differently
+            # than requested
+            raise ValueError(
+                "ssh_port/ssh_identity_file cannot be forwarded to MPICH's "
+                "hydra launcher; configure them in ~/.ssh/config for the "
+                "target hosts instead")
         # mpirun's rsh agent must dial the same ssh settings the user
-        # gave the launcher (reference forwards them via plm_rsh_args;
-        # hydra has no per-arg rsh passthrough — use ~/.ssh/config there)
+        # gave the launcher (reference forwards them via plm_rsh_args)
         rsh = []
         if ssh_port:
             rsh += ["-p", str(ssh_port)]

@@ -22,7 +22,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
@@ -33,13 +33,13 @@ class Store:
     def is_parquet_dataset(self, path: str) -> bool:
         raise NotImplementedError
 
-    def get_train_data_path(self, idx: Optional[int] = None) -> str:
+    def get_train_data_path(self, idx: Union[int, str, None] = None) -> str:
         raise NotImplementedError
 
-    def get_val_data_path(self, idx: Optional[int] = None) -> str:
+    def get_val_data_path(self, idx: Union[int, str, None] = None) -> str:
         raise NotImplementedError
 
-    def get_test_data_path(self, idx: Optional[int] = None) -> str:
+    def get_test_data_path(self, idx: Union[int, str, None] = None) -> str:
         raise NotImplementedError
 
     def saving_runs(self) -> bool:
@@ -73,6 +73,9 @@ class Store:
         raise NotImplementedError
 
     def makedirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
         raise NotImplementedError
 
     def new_run_id(self) -> str:
@@ -120,14 +123,16 @@ class FilesystemStore(Store):
         return os.path.isdir(path) and any(
             f.endswith(".parquet") for f in os.listdir(path))
 
-    def get_train_data_path(self, idx: Optional[int] = None) -> str:
+    def get_train_data_path(self, idx: Union[int, str, None] = None) -> str:
+        """``idx`` scopes intermediate data per dataset/run (reference
+        keys by dataset index; the estimator passes the run id)."""
         return self._train_path if idx is None \
             else f"{self._train_path}.{idx}"
 
-    def get_val_data_path(self, idx: Optional[int] = None) -> str:
+    def get_val_data_path(self, idx: Union[int, str, None] = None) -> str:
         return self._val_path if idx is None else f"{self._val_path}.{idx}"
 
-    def get_test_data_path(self, idx: Optional[int] = None) -> str:
+    def get_test_data_path(self, idx: Union[int, str, None] = None) -> str:
         return self._test_path if idx is None \
             else f"{self._test_path}.{idx}"
 
@@ -162,6 +167,14 @@ class FilesystemStore(Store):
 
     def makedirs(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
+
+    def delete(self, path: str) -> None:
+        import shutil
+
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
 
     def new_run_id(self) -> str:
         """Next free ``run_NNN`` under the runs dir, reserved atomically

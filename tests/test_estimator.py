@@ -225,8 +225,10 @@ class TestStreamingFit:
 
         assert os.path.exists(os.path.join(
             store_dir, "runs", "run_001", "metadata.json"))
-        assert os.path.exists(os.path.join(
-            store_dir, "intermediate_train_data"))
+        # run-scoped intermediate copies are cleaned up after a
+        # successful fit; run artifacts persist
+        assert not os.path.exists(os.path.join(
+            store_dir, "intermediate_train_data.run_001"))
 
     def test_reader_spans_multiple_parquet_files(self, tmp_path):
         """RowGroupReader treats all part files of a data dir as one
@@ -310,16 +312,15 @@ class TestStore:
         Estimator(Net(), feature_cols=["f1", "f2", "f3", "f4"],
                   label_col="label", batch_size=4, epochs=1,
                   store=store, validation_fraction=0.25).fit(df)
-        assert store.is_parquet_dataset(store.get_train_data_path())
-        assert store.is_parquet_dataset(store.get_val_data_path())
+        # intermediate parquet is deleted on success; artifacts persist
+        assert not store.exists(store.get_train_data_path("run_001"))
+        assert not store.exists(store.get_val_data_path("run_001"))
         assert store.exists(store.get_checkpoint_path("run_001"))
         assert store.exists(store.get_logs_path("run_001"))
         feats, label = load_metadata(store, "run_001")
         assert [s.name for s in feats] == ["f1", "f2", "f3", "f4"]
         assert label.dtype == "int32"
-        # train parquet holds the 48-row training split
-        assert len(store.read_dataframe(
-            store.get_train_data_path())) == 48
+
 
 
 class TestTypedColumns:

@@ -432,7 +432,8 @@ class TestTwoProcessWorld:
         runs = sorted((store_dir / "runs").iterdir())
         assert [r.name for r in runs] == ["run_001"], runs
         assert (store_dir / "runs/run_001/metadata.json").exists()
-        assert (store_dir / "intermediate_train_data").exists()
+        # run-scoped intermediates are cleaned up after a successful fit
+        assert not (store_dir / "intermediate_train_data.run_001").exists()
 
     def test_multidevice_processes_hierarchical_mesh(self, tmp_path):
         """2 processes x 2 virtual devices each: the (dcn, ici) = (2, 2)

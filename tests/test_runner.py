@@ -299,7 +299,7 @@ class TestMpiRun:
             4, [HostInfo("h1", 2), HostInfo("h2", 2)],
             ["python", "train.py"], env,
             impl_flags=mpi_implementation_flags(impl="mpich"),
-            nics="eth0,eth1", ssh_port=2222, impl="mpich")
+            nics="eth0,eth1", impl="mpich")
         s = " ".join(cmd)
         # hydra spellings only: no OpenMPI MCA/-x/--tag-output args
         assert s.startswith("mpirun -bind-to none -map-by slot")
@@ -308,6 +308,15 @@ class TestMpiRun:
         assert "-genvlist HOROVOD_COORDINATOR_ADDR,PYTHONPATH" in s
         assert "-x" not in s.split()
         assert s.endswith("python train.py")
+        # hydra has no per-arg rsh passthrough: ssh options must fail
+        # loudly, not silently dial default ssh settings
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="hydra"):
+            mpi_run_command(
+                4, [HostInfo("h1", 2), HostInfo("h2", 2)],
+                ["python", "train.py"], env,
+                impl_flags=mpi_implementation_flags(impl="mpich"),
+                ssh_port=2222, impl="mpich")
 
     def test_implementation_detection(self, monkeypatch):
         import subprocess as sp
