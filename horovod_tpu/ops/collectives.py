@@ -28,6 +28,7 @@ from typing import Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from horovod_tpu.runtime.topology import AXIS_DCN, AXIS_ICI, GLOBAL_AXES
@@ -127,7 +128,8 @@ def grouped_allreduce(xs: Sequence[jax.Array],
                       op: ReduceOp = Average,
                       axis: AxisSpec = GLOBAL_AXES,
                       prescale_factor: Optional[float] = None,
-                      postscale_factor: Optional[float] = None) -> list:
+                      postscale_factor: Optional[float] = None,
+                      quantized_bits: Optional[int] = None) -> list:
     """Fused allreduce of many tensors — Tensor Fusion, compiler-era.
 
     The reference packs small gradients into one 64 MiB fusion buffer
@@ -137,9 +139,16 @@ def grouped_allreduce(xs: Sequence[jax.Array],
     memcpy: we flatten-concatenate per dtype and issue one psum per dtype
     group, then split back — one collective per dtype regardless of tensor
     count.
+
+    ``quantized_bits=8`` routes each *float* dtype group through
+    :func:`quantized_allreduce` (int8 wire, shared-scale); integer
+    groups stay on the exact psum.
     """
     if not xs:
         return []
+    if quantized_bits is not None and op not in (ReduceOp.SUM,
+                                                 ReduceOp.AVERAGE):
+        raise ValueError("quantized_bits supports op=Sum/Average")
     if op == ReduceOp.ADASUM:
         from horovod_tpu.ops.adasum import adasum_grouped_allreduce
 
@@ -153,8 +162,16 @@ def grouped_allreduce(xs: Sequence[jax.Array],
     for dtype, idxs in groups.items():
         flat = jnp.concatenate(
             [jnp.ravel(_scale(xs[i], prescale_factor)) for i in idxs])
-        red = allreduce(flat, op=op, axis=axis,
-                        postscale_factor=postscale_factor)
+        if quantized_bits is not None and \
+                jnp.issubdtype(dtype, jnp.floating):
+            red = _scale(
+                quantized_allreduce(
+                    flat, axis=axis, op=op, bits=quantized_bits,
+                    segments=tuple(int(xs[i].size) for i in idxs)),
+                postscale_factor)
+        else:
+            red = allreduce(flat, op=op, axis=axis,
+                            postscale_factor=postscale_factor)
         offset = 0
         for i in idxs:
             n = xs[i].size
@@ -165,22 +182,41 @@ def grouped_allreduce(xs: Sequence[jax.Array],
 
 def quantized_allreduce(x: jax.Array, axis: AxisSpec = GLOBAL_AXES,
                         op: ReduceOp = Average,
-                        bits: int = 8) -> jax.Array:
+                        bits: int = 8,
+                        segments: Sequence[int] = ()) -> jax.Array:
     """Average/sum with an int8-quantized wire (EQuARX-style, arXiv
-    2506.17615): agree on a shared scale via one scalar ``pmax``,
-    quantize to int8, accumulate the psum in int32 (no overflow, exact
-    integer summation), dequantize with the shared scale.  Wire cost of
-    the main reduction is 1 byte/element vs 4 for fp32; accuracy cost is
-    one absmax-scaled rounding, identical on every shard.
+    2506.17615): agree on a shared scale via one ``pmax``, quantize to
+    int8, accumulate the psum in int32 (no overflow, exact integer
+    summation), dequantize with the shared scale.  Wire cost of the main
+    reduction is 1 byte/element vs 4 for fp32; accuracy cost is one
+    absmax-scaled rounding, identical on every shard.
+
+    ``segments`` gives per-tensor lengths of a fused flat buffer: each
+    segment then gets its *own* shared scale (one small-vector ``pmax``),
+    so a small-magnitude gradient fused next to a large one is not
+    rounded to zero — the quantization error is bounded per tensor, and
+    the wire still carries a single fused int8 psum.
     """
     if bits != 8:
         raise ValueError("only 8-bit quantization is supported")
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError("quantized_allreduce supports Sum/Average")
     x32 = x.astype(jnp.float32)
-    local_amax = jnp.max(jnp.abs(x32))
-    scale = lax.pmax(local_amax, axis) / 127.0
-    scale = jnp.maximum(scale, 1e-30)
+    if segments and len(segments) > 1:
+        if x.ndim != 1 or sum(segments) != x.shape[0]:
+            raise ValueError("segments must partition a flat buffer")
+        bounds = np.cumsum([0] + list(segments))
+        local_amax = jnp.stack(
+            [jnp.max(jnp.abs(x32[bounds[i]:bounds[i + 1]]))
+             for i in range(len(segments))])
+        scales = lax.pmax(local_amax, axis) / 127.0
+        scales = jnp.maximum(scales, 1e-30)
+        scale = jnp.repeat(scales, np.asarray(segments),
+                           total_repeat_length=x.shape[0])
+    else:
+        local_amax = jnp.max(jnp.abs(x32))
+        scale = lax.pmax(local_amax, axis) / 127.0
+        scale = jnp.maximum(scale, 1e-30)
     q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
     total = lax.psum(q.astype(jnp.int32), axis)
     y = total.astype(jnp.float32) * scale

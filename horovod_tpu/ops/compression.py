@@ -70,15 +70,25 @@ class BF16Compressor(Compressor):
             else tensor
 
 
-class Compression:
-    """Namespace matching the reference's ``Compression`` selector.
+class Int8WireReduction:
+    """Marker selecting the int8-quantized *wire reduction* — not a
+    ``Compressor``: the reduction runs *between* compress and decompress,
+    and summing int8 payloads with per-shard scales would overflow and
+    mis-scale.  Reduction layers that see this marker
+    (``grouped_allreduce``/``distributed_gradients``/
+    ``DistributedTrainStep``) route through
+    :func:`horovod_tpu.ops.collectives.quantized_allreduce`, which agrees
+    on a shared scale first (EQuARX-style): 1 byte/element on the wire
+    for the main reduction vs 4 for fp32, one absmax-scaled rounding of
+    accuracy cost, identical on every shard."""
 
-    Int8 wire compression is NOT a ``Compressor``: the reduction runs
-    *between* compress and decompress, and summing int8 payloads with
-    per-shard scales would overflow and mis-scale.  Use
-    :func:`horovod_tpu.ops.collectives.quantized_allreduce`, which
-    agrees on a shared scale first (EQuARX-style)."""
+    wire_reduce_bits = 8
+
+
+class Compression:
+    """Namespace matching the reference's ``Compression`` selector."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8WireReduction

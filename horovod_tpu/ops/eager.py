@@ -518,6 +518,13 @@ def allreduce_async(tensor, average: Optional[bool] = None,
 
     if op is None:
         op = Average if (average is None or average) else Sum
+    if compression is not None and not hasattr(compression, "compress"):
+        # validate before the handle registers / spans open: a rejected
+        # call must leave no in-flight handle, stall record, or span
+        raise ValueError(
+            "Compression.int8 is an in-jit wire reduction (shard_map "
+            "mode); the eager plane exchanges whole tensors — use "
+            "Compression.fp16/bf16 here")
     name = name or _next_name("allreduce")
     handle = Handle(name)
     _register(name, handle)

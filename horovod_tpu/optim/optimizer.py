@@ -31,6 +31,7 @@ from __future__ import annotations
 from typing import NamedTuple, Optional, Sequence, Union
 
 import jax
+import jax.numpy as jnp
 import optax
 
 from horovod_tpu.ops import collectives as C
@@ -40,18 +41,92 @@ from horovod_tpu.runtime.topology import GLOBAL_AXES
 AxisSpec = Union[str, Sequence[str]]
 
 
+def _sparse_leaf_reduce(g: jax.Array, max_rows: int, op: ReduceOp,
+                        axis: AxisSpec,
+                        prescale_factor: Optional[float] = None,
+                        postscale_factor: Optional[float] = None
+                        ) -> jax.Array:
+    """Row-sparse reduction of one dense-shaped gradient leaf.
+
+    JAX embedding gradients arrive dense (scatter-add of the used rows),
+    so the IndexedSlices decomposition is recovered in-graph: the leaf's
+    nonzero rows are extracted with a static ``max_rows`` bound
+    (``jnp.nonzero(size=...)`` keeps shapes XLA-static) and exchanged via
+    :func:`~horovod_tpu.ops.collectives.sparse_allreduce` — allgather of
+    ``max_rows`` rows per shard instead of a dense allreduce of the full
+    table (reference IndexedSlices path,
+    ``tensorflow/__init__.py:100-110``).  Fill slots use the
+    out-of-range index ``V``: their gathered values read as zero and the
+    scatter drops them.  Rows beyond ``max_rows`` are silently dropped —
+    the bound is the caller's promise about touched rows per step.
+    """
+    rows = g.shape[0]
+    mask = jnp.any(g.reshape(rows, -1) != 0, axis=1)
+    (idx,) = jnp.nonzero(mask, size=max_rows, fill_value=rows)
+    vals = jnp.take(g, idx, axis=0, mode="fill", fill_value=0)
+    vals = C._scale(vals, prescale_factor)
+    out = C.sparse_allreduce(vals, idx, dense_rows=rows, axis=axis, op=op)
+    return C._scale(out, postscale_factor)
+
+
+def _path_components(path) -> list:
+    """Flattened-path entries as plain strings (dict keys, attr names,
+    sequence indices)."""
+    out = []
+    for entry in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(entry, attr):
+                out.append(str(getattr(entry, attr)))
+                break
+        else:
+            out.append(str(entry))
+    return out
+
+
+def _match_sparse(path, sparse_params) -> Optional[int]:
+    """max_rows for a leaf whose path has a component equal to a
+    configured name (or whose full '/'-joined path equals one), else
+    None.  Whole-component matching: a pattern 'emb' must not
+    accidentally route a dense leaf named 'member' through the
+    truncating sparse path."""
+    if not sparse_params:
+        return None
+    comps = _path_components(path)
+    joined = "/".join(comps)
+    for pat, max_rows in sparse_params.items():
+        if pat == joined or pat in comps:
+            return int(max_rows)
+    return None
+
+
 def distributed_gradients(op: ReduceOp = Average,
                           axis: AxisSpec = GLOBAL_AXES,
                           mode: str = "shard_map",
                           compression=None,
                           prescale_factor: Optional[float] = None,
-                          postscale_factor: Optional[float] = None
+                          postscale_factor: Optional[float] = None,
+                          sparse_params: Optional[dict] = None
                           ) -> optax.GradientTransformation:
     """optax transform that cross-replica-reduces gradients.
 
     The composable core of :func:`DistributedOptimizer`; usable standalone
     in any optax chain.
+
+    ``sparse_params`` maps leaf-path component names (e.g.
+    ``"embedding"``, or a full ``"encoder/embedding"`` path) to a
+    ``max_rows`` bound; matching leaves are reduced through the
+    row-sparse allgather path instead of the dense allreduce — the
+    reference's IndexedSlices routing (``tensorflow/__init__.py:100-110``,
+    ``sparse_as_dense`` being the knob that turns it *off* there; here
+    dense is already the default and ``sparse_params`` is the opt-in).
+    Requires ``mode='shard_map'``.
     """
+    if sparse_params and mode != "shard_map":
+        raise ValueError(
+            "sparse_params requires mode='shard_map' (pjit autodiff "
+            "reduces densely; the process plane exchanges whole tensors)")
+    if sparse_params and op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("sparse_params supports op=Sum/Average")
 
     def init_fn(params):
         del params
@@ -63,19 +138,37 @@ def distributed_gradients(op: ReduceOp = Average,
         if mode == "pjit":
             reduced = leaves  # XLA autodiff already reduced (see docstring)
         elif mode == "shard_map":
-            ins = leaves
+            sparse_rows: dict = {}
+            if sparse_params:
+                paths = jax.tree_util.tree_flatten_with_path(updates)[0]
+                for i, (path, _) in enumerate(paths):
+                    m = _match_sparse(path, sparse_params)
+                    if m is not None:
+                        sparse_rows[i] = m
+            ins = [g for i, g in enumerate(leaves) if i not in sparse_rows]
+            # Compression.int8 is a wire-*reduction* marker, not a
+            # compressor: the shared-scale quantized psum runs inside
+            # grouped_allreduce (see compression.Int8WireReduction)
+            qbits = getattr(compression, "wire_reduce_bits", None)
             ctxs = None
-            if compression is not None:
+            if compression is not None and qbits is None:
                 pairs = [compression.compress(g) for g in ins]
                 ins = [p[0] for p in pairs]
                 ctxs = [p[1] for p in pairs]
-            reduced = C.grouped_allreduce(
+            dense = C.grouped_allreduce(
                 ins, op=op, axis=axis,
                 prescale_factor=prescale_factor,
-                postscale_factor=postscale_factor)
-            if compression is not None:
-                reduced = [compression.decompress(r, c)
-                           for r, c in zip(reduced, ctxs)]
+                postscale_factor=postscale_factor,
+                quantized_bits=qbits)
+            if ctxs is not None:
+                dense = [compression.decompress(r, c)
+                         for r, c in zip(dense, ctxs)]
+            dense_iter = iter(dense)
+            reduced = [
+                _sparse_leaf_reduce(g, sparse_rows[i], op, axis,
+                                    prescale_factor, postscale_factor)
+                if i in sparse_rows else next(dense_iter)
+                for i, g in enumerate(leaves)]
         elif mode == "process":
             from horovod_tpu.ops import eager
 
@@ -101,7 +194,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          compression=None,
                          backward_passes_per_step: int = 1,
                          prescale_factor: Optional[float] = None,
-                         postscale_factor: Optional[float] = None
+                         postscale_factor: Optional[float] = None,
+                         sparse_params: Optional[dict] = None
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so each update uses cross-replica-reduced
     gradients (reference ``DistributedOptimizer`` factory,
@@ -120,7 +214,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         distributed_gradients(op=op, axis=axis, mode=mode,
                               compression=compression,
                               prescale_factor=prescale_factor,
-                              postscale_factor=postscale_factor),
+                              postscale_factor=postscale_factor,
+                              sparse_params=sparse_params),
         optimizer,
     )
     if backward_passes_per_step > 1:

@@ -72,7 +72,8 @@ class DistributedTrainStep:
                  data_axes: AxisSpec = GLOBAL_AXES,
                  donate: bool = True,
                  steps_per_call: int = 1,
-                 compiler_options: Optional[dict] = None):
+                 compiler_options: Optional[dict] = None,
+                 sparse_params: Optional[dict] = None):
         """``steps_per_call > 1`` scans that many optimizer steps inside
         the one compiled program (the Keras ``steps_per_execution``
         knob): one dispatch amortizes per-call host/launch overhead —
@@ -100,12 +101,21 @@ class DistributedTrainStep:
         repl = NamedSharding(self._mesh, P())
         batch_sharding = NamedSharding(self._mesh, P(self._data_axes))
 
+        if sparse_params and mode != "shard_map":
+            raise ValueError(
+                "sparse_params requires mode='shard_map' (pjit autodiff "
+                "reduces every gradient densely)")
         if op is None and mode != "shard_map":
             raise ValueError(
                 "op=None (gradients stay local; the optimizer chain owns "
                 "the reduction, e.g. DistributedAdasumOptimizer) requires "
                 "mode='shard_map' — pjit autodiff would mean-reduce the "
                 "gradients behind the optimizer's back")
+        if op is None and sparse_params:
+            raise ValueError(
+                "op=None leaves gradients local, so train-step "
+                "sparse_params would never route anything; pass "
+                "sparse handling to the distributing optimizer instead")
         if op is None and compression is not None:
             raise ValueError(
                 "op=None leaves gradients local, so a train-step "
@@ -159,7 +169,7 @@ class DistributedTrainStep:
 
                 reducer = distributed_gradients(
                     op=op, axis=axes, mode="shard_map",
-                    compression=compression)
+                    compression=compression, sparse_params=sparse_params)
 
             def per_device(params, opt_state, batch):
                 loss, grads = jax.value_and_grad(self._loss_fn)(params, batch)
