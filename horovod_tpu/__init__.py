@@ -87,20 +87,36 @@ def is_initialized() -> bool:
 
 def start_timeline(file_path: str, mark_cycles: bool = False):
     """Start timeline recording at runtime (reference
-    ``horovod_start_timeline``)."""
+    ``horovod_start_timeline``).
+
+    Every process may pass the same (shared) path: non-root ranks record
+    to ``<file_path>.<rank>`` so two writers never share a file, and
+    :func:`stop_timeline` merges everything back into ``file_path`` on
+    rank 0."""
     from horovod_tpu.utils.timeline import Timeline
 
     st = _state.global_state()
     if st.timeline is not None:
         st.timeline.close()
+    if st.process_count > 1 and st.process_rank:
+        file_path = f"{file_path}.{st.process_rank}"
     st.timeline = Timeline(file_path, mark_cycles=mark_cycles)
 
 
 def stop_timeline():
+    """Stop recording; in a multi-process world rank 0 then gathers every
+    process's events into ONE merged Chrome trace (reference rank-0
+    aggregated timeline, ``timeline.cc``)."""
+    from horovod_tpu.utils.timeline import aggregate_after_close
+
     st = _state.global_state()
     if st.timeline is not None:
+        fname = getattr(st.timeline, "filename", None)
+        origin = getattr(st.timeline, "wall_origin_us", None)
         st.timeline.close()
         st.timeline = None
+        if fname:
+            aggregate_after_close(fname, origin)
 
 
 def rank() -> int:

@@ -522,13 +522,9 @@ class Estimator(HasParams):
                 ckpt.save(epoch, {"params": loop.params,
                                   "opt_state": loop.opt_state})
         cbs.on_train_end(loop, logs)
-        if self._store is not None and hvd.rank() == 0:
-            # intermediate parquet copies are derived data; the run's
-            # artifacts (checkpoints, metadata, logs) are what persists.
-            # Cleanup happens on success only — a failed fit leaves them
-            # for debugging.
-            self._store.delete(self._store.get_train_data_path(run_id))
-            self._store.delete(self._store.get_val_data_path(run_id))
+        # no cleanup here: _fit_via_store owns the run-scoped intermediate
+        # data and deletes it behind a barrier once every rank's readers
+        # are done; fit_on_parquet reads user-owned parquet
         return TpuModel(apply_fn, loop.params, self.feature_cols,
                         feature_specs=feature_specs)
 

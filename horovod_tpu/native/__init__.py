@@ -93,6 +93,12 @@ class NativeTimeline:
             raise RuntimeError("native library unavailable")
         self._lib = lib
         self._mark_cycles = mark_cycles
+        self.filename = filename
+        # native ts are µs since writer start; wall time at construction
+        # is the rebase origin for cross-process aggregation
+        import time as _time
+
+        self.wall_origin_us = _time.time_ns() / 1e3
         self._handle = lib.hvdtl_create(filename.encode(), capacity)
         self._intern_cache: dict = {}
         self._cycle_id = self._intern("CYCLE_START")
@@ -106,18 +112,28 @@ class NativeTimeline:
         return i
 
     def start_activity(self, tensor_name: str, activity: str) -> None:
+        # the _closed guards make post-close events no-ops (dropped, as
+        # the Python writer's dead queue drops them): deferred span closes
+        # (eager handles' _tl_neg) may legally outlive stop_timeline, and
+        # hvdtl_close frees the native writer
+        if self._closed:
+            return
         self._lib.hvdtl_event(self._handle, self._intern(activity),
                               self._intern(tensor_name), b"B")
 
     def end_activity(self, tensor_name: str) -> None:
+        if self._closed:
+            return
         self._lib.hvdtl_event(self._handle, -1,
                               self._intern(tensor_name), b"E")
 
     def instant(self, name: str, args=None) -> None:
+        if self._closed:
+            return
         self._lib.hvdtl_event(self._handle, self._intern(name), -1, b"i")
 
     def mark_cycle_start(self) -> None:
-        if self._mark_cycles:
+        if self._mark_cycles and not self._closed:
             self._lib.hvdtl_event(self._handle, self._cycle_id, -1, b"i")
 
     @property

@@ -86,7 +86,6 @@ class DistributedTrainStep:
         self._mode = mode
         self._optimizer = optimizer
         self._op = op
-        self._compression = compression
         self._data_axes = tuple(data_axes) if not isinstance(data_axes, str) \
             else (data_axes,)
         loss_fn = jax.checkpoint(loss_fn) if remat else loss_fn

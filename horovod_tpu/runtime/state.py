@@ -145,7 +145,8 @@ class GlobalState:
             self.cross_size = cfg.cross_size
 
         if cfg.timeline_filename:
-            self.timeline = _make_timeline(cfg)
+            self.timeline = _make_timeline(cfg, self.process_rank
+                                           if self.process_count > 1 else 0)
         if cfg.stall_check_enabled:
             from horovod_tpu.utils.stall import StallInspector
 
@@ -171,28 +172,43 @@ class GlobalState:
             if self.shut_down:
                 return
             if self.timeline is not None:
+                fname = getattr(self.timeline, "filename", None)
+                origin = getattr(self.timeline, "wall_origin_us", None)
                 self.timeline.close()
+                self.timeline = None
+                if fname:
+                    from horovod_tpu.utils.timeline import \
+                        aggregate_after_close
+
+                    aggregate_after_close(fname, origin)
             if self.stall_inspector is not None:
                 self.stall_inspector.stop()
             self.shut_down = True
             self.initialization_done = False
 
 
-def _make_timeline(cfg: Config):
+def _make_timeline(cfg: Config, process_rank: int = 0):
     """Prefer the native lock-free writer (reference timeline.{h,cc} is
-    C++); fall back to the Python writer when the toolchain is absent."""
+    C++); fall back to the Python writer when the toolchain is absent.
+
+    Non-root processes write a per-rank derived path so a shared
+    ``HOROVOD_TIMELINE`` never has two writers; ``stop_timeline``'s
+    aggregation then merges everything into rank 0's file — the one
+    configured path holds the one trace, the reference's UX."""
+    filename = cfg.timeline_filename
+    if process_rank:
+        filename = f"{filename}.{process_rank}"
     if not os.environ.get("HOROVOD_TIMELINE_PYTHON"):
         try:
             from horovod_tpu.native import NativeTimeline
 
-            return NativeTimeline(cfg.timeline_filename,
+            return NativeTimeline(filename,
                                   mark_cycles=cfg.timeline_mark_cycles)
         except (RuntimeError, OSError):
             pass
     from horovod_tpu.utils.timeline import Timeline
 
-    return Timeline(cfg.timeline_filename,
-                    mark_cycles=cfg.timeline_mark_cycles)
+    return Timeline(filename, mark_cycles=cfg.timeline_mark_cycles)
 
 
 _state: Optional[GlobalState] = None

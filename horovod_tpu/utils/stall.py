@@ -3,23 +3,38 @@
 The reference's ``StallInspector`` (``horovod/common/stall_inspector.{h,cc}``)
 watches the negotiation table for tensors some ranks submitted and others
 did not, warning after 60 s and optionally shutting down after
-``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS`` (``stall_inspector.h:73-81``).
+``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS`` (``stall_inspector.h:73-81``), and
+``CheckForStalledTensors`` names the *ranks* that never submitted each
+stalled tensor.
 
 Under SPMD there is no negotiation table — a "stall" is a collective that
 was dispatched but never completes (a peer process died, or host code
 diverged so a peer never entered the collective).  This inspector tracks
 in-flight eager operations: each dispatched op registers here and clears on
 completion; a watcher thread warns when an op has been pending longer than
-the threshold and names it — the same observable behavior, re-rooted.
+the threshold and names it.
+
+Missing-rank attribution re-rooted: once an op has been pending for half
+the warning threshold, each process best-effort publishes its pending-op
+set to the coordination-service KV (a non-collective write — a stalled
+world can still reach the KV server).  When the warning fires, the warning
+rank lists the directory and names each peer as either co-stalled (it
+published the same pending op), diverged (it published, but without this
+op), or unreported (no publication — it never submitted the op, or died):
+the answer the reference's ``CheckForStalledTensors`` gives from the
+negotiation table.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 import time
-from typing import Dict
+from typing import Dict, Optional
 
 from horovod_tpu.utils import logging as hvd_logging
+
+_STATUS_DIR = "hvdstall/status"
 
 
 class StallInspector:
@@ -27,11 +42,15 @@ class StallInspector:
                  shutdown_time_s: float = 0.0, poll_interval_s: float = 5.0):
         self._warning_time_s = warning_time_s
         self._shutdown_time_s = shutdown_time_s
-        self._poll_interval_s = poll_interval_s
+        self._poll_interval_s = min(poll_interval_s, max(
+            warning_time_s / 4.0, 0.05))
         self._pending: Dict[str, float] = {}
         self._warned: set = set()
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        self._pub_seq = 0
+        self._last_pub_key: Optional[str] = None
+        self._published: Optional[frozenset] = None
         self._thread = threading.Thread(target=self._watch, daemon=True,
                                         name="hvd_tpu_stall_inspector")
         self._thread.start()
@@ -49,25 +68,128 @@ class StallInspector:
         with self._lock:
             return dict(self._pending)
 
+    # -- cross-process attribution -----------------------------------------
+
+    def _cluster(self):
+        """(client, my process index, process count) when a multi-process
+        coordination service is reachable, else None.  Reads
+        ``jax._src.distributed.global_state`` directly — ``jax.process_
+        count()`` would initialize a backend from the watchdog thread."""
+        try:
+            from jax._src import distributed as dist
+
+            gs = dist.global_state
+            if gs.client is None or not gs.num_processes \
+                    or gs.num_processes == 1:
+                return None
+            return gs.client, int(gs.process_id), int(gs.num_processes)
+        except Exception:
+            return None
+
+    def _publish(self, client, me: int, pending) -> None:
+        """Best-effort non-collective status write; re-published only when
+        the pending set changes.  Unique seq keys sidestep the KV store's
+        no-overwrite rule; the previous key is deleted after the new one
+        lands so readers always see at least one."""
+        snapshot = frozenset(pending)
+        if snapshot == self._published:
+            return
+        self._pub_seq += 1
+        key = f"{_STATUS_DIR}/{me}/{self._pub_seq}"
+        try:
+            client.key_value_set_bytes(key, json.dumps(
+                {"pending": sorted(pending)}).encode())
+            if self._last_pub_key is not None:
+                client.key_value_delete(self._last_pub_key)
+            self._last_pub_key = key
+            self._published = snapshot
+        except Exception:  # pragma: no cover - KV unreachable
+            pass
+
+    def _attribute(self, client, me: int, nproc: int, stalled_names):
+        """Name each peer's relation to the stalled ops from the published
+        statuses (reference ``CheckForStalledTensors`` missing-rank
+        report)."""
+        newest: Dict[int, tuple] = {}
+        try:
+            entries = client.key_value_dir_get_bytes(_STATUS_DIR)
+        except Exception:
+            entries = []
+        for k, v in entries:
+            parts = str(k).split("/")
+            try:
+                pid, seq = int(parts[-2]), int(parts[-1])
+            except (ValueError, IndexError):
+                continue
+            if pid != me and (pid not in newest or seq > newest[pid][0]):
+                newest[pid] = (seq, v)
+        unreported, diverged, costalled = [], [], []
+        for p in range(nproc):
+            if p == me:
+                continue
+            if p not in newest:
+                unreported.append(p)
+                continue
+            try:
+                peer_pending = set(json.loads(newest[p][1])["pending"])
+            except Exception:
+                peer_pending = set()
+            missing = sorted(n for n in stalled_names
+                             if n not in peer_pending)
+            if missing:
+                diverged.append((p, missing))
+            else:
+                costalled.append(p)
+        parts = []
+        if unreported:
+            parts.append(
+                "process(es) %s have not submitted the op (no status "
+                "published — never reached it, or failed)"
+                % ", ".join(map(str, unreported)))
+        for p, missing in diverged:
+            parts.append(
+                "process %d is stalled on different op(s) and has not "
+                "submitted %s" % (p, ", ".join(missing)))
+        if costalled:
+            parts.append("process(es) %s are waiting on the same op"
+                         % ", ".join(map(str, costalled)))
+        return "; ".join(parts)
+
+    # -- watcher ------------------------------------------------------------
+
     def _watch(self) -> None:
         while not self._stop.wait(self._poll_interval_s):
             now = time.monotonic()
-            stalled, fatal = [], []
+            stalled, fatal, publish_due = [], [], []
             with self._lock:
                 for name, t0 in self._pending.items():
                     age = now - t0
+                    if age > self._warning_time_s / 2.0:
+                        publish_due.append(name)
                     if age > self._warning_time_s and name not in self._warned:
                         stalled.append((name, age))
                         self._warned.add(name)
                     if self._shutdown_time_s > 0 and age > self._shutdown_time_s:
                         fatal.append((name, age))
+            # _published non-empty with nothing due means the stall
+            # cleared: republish the (empty) set so peers stop blaming us
+            cluster = self._cluster() \
+                if (publish_due or stalled or self._published) else None
+            if cluster is not None:
+                self._publish(cluster[0], cluster[1], publish_due)
             if stalled:
                 names = ", ".join(f"{n} ({a:.0f}s)" for n, a in stalled)
+                who = ""
+                if cluster is not None:
+                    client, me, nproc = cluster
+                    who = self._attribute(client, me, nproc,
+                                          [n for n, _ in stalled])
                 hvd_logging.warning(
                     "One or more collectives submitted but not completed for "
                     "over %.0fs: %s. A peer process may have failed or host "
-                    "control flow may have diverged across processes.",
-                    self._warning_time_s, names)
+                    "control flow may have diverged across processes.%s",
+                    self._warning_time_s, names,
+                    (" Attribution: " + who) if who else "")
             if fatal:
                 hvd_logging.error(
                     "Collective(s) stalled beyond "

@@ -49,10 +49,14 @@ class Timeline:
     """
 
     def __init__(self, filename: str, mark_cycles: bool = False):
+        self.filename = filename
         self._filename = filename
         self._mark_cycles = mark_cycles
         self._queue: "queue.Queue" = queue.Queue()
         self._start_ns = time.monotonic_ns()
+        # wall-clock at the monotonic origin: event wall time =
+        # wall_origin_us + ts, the rebasing key for cross-process merge
+        self.wall_origin_us = time.time_ns() / 1e3
         self._active: dict = {}
         self._closed = False
         self._pid = os.getpid()
@@ -108,6 +112,103 @@ class Timeline:
         self._writer.join(timeout=5)
         self._file.write("\n]\n")
         self._file.close()
+
+
+def merge_traces(blobs) -> list:
+    """Merge per-process Chrome-trace events into one trace.
+
+    ``blobs`` is ``[(proc_index, wall_origin_us, events), ...]``.  Events
+    are rebased onto the earliest wall origin (one consistent time axis),
+    their ``pid`` is remapped to the process index, and ``process_name``
+    metadata rows label each process — the single-file view the
+    reference's rank-0 aggregated timeline gives (``timeline.cc``:
+    the controller forwards every rank's negotiation events to rank 0's
+    writer).
+    """
+    if not blobs:
+        return []
+    base = min(origin for _, origin, _ in blobs)
+    merged = []
+    for p, origin, events in sorted(blobs):
+        merged.append({"ph": "M", "name": "process_name", "pid": p,
+                       "args": {"name": f"process {p}"}})
+        off = origin - base
+        for e in events:
+            e = dict(e)
+            e["pid"] = p
+            if "ts" in e:
+                e["ts"] = e["ts"] + off
+            merged.append(e)
+    return merged
+
+
+_aggregate_seq = 0
+
+
+def aggregate_after_close(filename: str, wall_origin_us) -> None:
+    """Cross-process timeline aggregation, run after the local writer
+    closed its file.
+
+    Non-root processes upload their event file (plus wall origin) to the
+    coordination KV; rank 0 collects every upload, merges with its own
+    events via :func:`merge_traces`, and rewrites its file as the single
+    aggregated trace.  Calls are SPMD-ordered (``stop_timeline`` /
+    ``shutdown`` run in program order on every process), so a per-call
+    sequence number keeps keys unique across repeated start/stop cycles.
+    Best-effort: a missing peer (crashed before upload) is warned about
+    and skipped, never hung on.
+    """
+    global _aggregate_seq
+    try:
+        from jax._src import distributed as dist
+
+        gs = dist.global_state
+        if gs.client is None or not gs.num_processes or \
+                gs.num_processes == 1:
+            return
+        client, me, nproc = gs.client, int(gs.process_id), \
+            int(gs.num_processes)
+    except Exception:
+        return
+    _aggregate_seq += 1
+    seq = _aggregate_seq
+    if wall_origin_us is None:
+        wall_origin_us = time.time_ns() / 1e3
+    if me != 0:
+        try:
+            with open(filename) as f:
+                events = json.load(f)
+        except Exception:
+            events = []
+        client.key_value_set_bytes(
+            f"hvdtl/{seq}/{me}",
+            json.dumps({"origin": wall_origin_us,
+                        "events": events}).encode())
+        return
+    blobs = [(0, wall_origin_us, _load_events(filename))]
+    for p in range(1, nproc):
+        key = f"hvdtl/{seq}/{p}"
+        try:
+            raw = client.blocking_key_value_get_bytes(key, 30_000)
+            payload = json.loads(raw)
+            blobs.append((p, payload["origin"], payload["events"]))
+            client.key_value_delete(key)
+        except Exception:
+            from horovod_tpu.utils import logging as hvd_logging
+
+            hvd_logging.warning(
+                "timeline aggregation: no upload from process %d; "
+                "writing a partial merged trace", p)
+    with open(filename, "w") as f:
+        json.dump(merge_traces(blobs), f)
+
+
+def _load_events(filename: str) -> list:
+    try:
+        with open(filename) as f:
+            return json.load(f)
+    except Exception:
+        return []
 
 
 def activity(tensor_name: str, name: str):

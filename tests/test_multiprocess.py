@@ -346,10 +346,13 @@ class TestTwoProcessWorld:
                    for e in neg)
         # per-tensor negotiation phases: each of the 3 allreduces opens a
         # NEGOTIATE span on the tensor's own timeline row at enqueue and
-        # closes it at agreement (reference timeline.h:77-131)
+        # closes it at agreement (reference timeline.h:77-131).  The
+        # rank-0 file is the AGGREGATED trace, so each process's lane
+        # carries its own 3 spans
         spans = [e for e in events
                  if e.get("name") == "NEGOTIATE" and e["ph"] == "B"]
-        assert len(spans) == 3
+        for pid in (0, 1):
+            assert len([e for e in spans if e["pid"] == pid]) == 3
         assert all(e["tid"] == "obs" for e in spans)
 
     def test_train_step_across_processes(self, tmp_path):
@@ -641,3 +644,82 @@ class TestTwoProcessWorld:
             print("rank0 alive")
         """, tmp_path)
         assert out.returncode != 0
+
+    def test_stall_attribution_names_laggard(self, tmp_path):
+        """When one rank delays a collective past the warning threshold,
+        the waiting rank's stall warning names the laggard process
+        (reference CheckForStalledTensors missing-rank report)."""
+        out = launch("""
+            import os
+            os.environ["HOROVOD_STALL_CHECK_TIME_SECONDS"] = "1"
+            import time
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import jax.numpy as jnp
+            import numpy as np
+            import horovod_tpu as hvd
+
+            hvd.init()
+            r = hvd.process_rank()
+            if r == 1:
+                time.sleep(4.0)   # past rank 0's 1s warning threshold
+            s = hvd.allreduce(jnp.full((4,), float(r + 1)), op=hvd.Sum,
+                              name="late_op")
+            np.testing.assert_allclose(np.asarray(s), 3.0)
+            # both ranks recover and finish normally after the stall
+            print("STALL_TEST_OK", r)
+            hvd.shutdown()
+        """, tmp_path, timeout=240)
+        assert out.returncode == 0, out.stderr[-3000:]
+        assert out.stdout.count("STALL_TEST_OK") == 2
+        blob = out.stdout + out.stderr
+        assert "late_op" in blob and "not completed" in blob, blob[-2000:]
+        # the attribution line names process 1 as not having submitted
+        assert "process(es) 1 have not submitted" in blob, blob[-2000:]
+
+    def test_timeline_aggregates_to_rank0(self, tmp_path):
+        """stop_timeline gathers every process's events into ONE Chrome
+        trace on rank 0 with a consistent time origin (reference rank-0
+        aggregated timeline, timeline.cc)."""
+        tldir = tmp_path / "tl"
+        tldir.mkdir()
+        out = launch(f"""
+            import os
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import jax.numpy as jnp
+            import horovod_tpu as hvd
+
+            hvd.init()
+            r = hvd.process_rank()
+            # every rank passes the SAME shared path; non-root ranks
+            # record to <path>.<rank> and rank 0 merges back into it
+            hvd.start_timeline({str(str(tldir))!r} + "/tl.0.json")
+            hvd.allreduce(jnp.full((4,), float(r + 1)), op=hvd.Sum,
+                          name="agg_ar")
+            hvd.allgather(jnp.ones((2, 2)) * r, name="agg_ag")
+            hvd.stop_timeline()
+            print("TL_OK", r)
+            hvd.shutdown()
+        """, tmp_path)
+        assert out.returncode == 0, out.stderr[-3000:]
+        assert out.stdout.count("TL_OK") == 2
+        import json as _json
+
+        merged = _json.loads((tldir / "tl.0.json").read_text())
+        pids = {e["pid"] for e in merged if e.get("ph") in ("B", "E")}
+        assert pids == {0, 1}, pids
+        names = {e["args"]["name"] for e in merged
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+        assert names == {"process 0", "process 1"}
+        # both processes' spans for the same collectives, one time axis
+        for p in (0, 1):
+            tids = {e["tid"] for e in merged
+                    if e.get("ph") == "B" and e["pid"] == p}
+            assert {"agg_ar", "agg_ag"} <= tids, (p, tids)
+        ts = [e["ts"] for e in merged if "ts" in e]
+        assert min(ts) >= 0
+        # rebased origins: both processes' events interleave within the
+        # same few-second window, not offset by an epoch
+        span_us = max(ts) - min(ts)
+        assert span_us < 60e6, span_us
