@@ -36,6 +36,7 @@ from horovod_tpu.spark.params import (
 )
 from horovod_tpu.spark.store import (
     ColSpec,
+    FilesystemStore,
     RowGroupReader,
     Store,
     assemble_features,
@@ -86,6 +87,23 @@ def _num_rows(df) -> int:
     if isinstance(df, dict):
         return len(next(iter(df.values()))) if df else 0
     return len(df)
+
+
+def _localize_dataset(path: Optional[str]) -> Optional[str]:
+    """Fetch a remote (fsspec URL) dataset directory to a local temp dir;
+    local paths pass through.  RowGroupReader streams from local files,
+    so remote fits download once per process, then shard locally."""
+    if not path or "://" not in path or path.startswith("file://"):
+        return path[len("file://"):] if path and \
+            path.startswith("file://") else path
+    import tempfile
+
+    import fsspec
+
+    fs, _ = fsspec.core.url_to_fs(path)
+    local = tempfile.mkdtemp(prefix="hvd_dataset_")
+    fs.get(path.rstrip("/") + "/", local + "/", recursive=True)
+    return local
 
 
 @dataclasses.dataclass
@@ -226,6 +244,28 @@ class Estimator(HasParams):
     def fit(self, df) -> TpuModel:
         import horovod_tpu as hvd
         from horovod_tpu.callbacks import CallbackList
+        from horovod_tpu.spark.store import PreparedData
+
+        # store-prepared data streams straight from parquet — the
+        # "prepare once on the driver, fit many times from the store"
+        # flow (reference util.py:697 + keras/remote.py reader loop).
+        # Remote (fsspec) datasets are fetched whole to a local temp dir
+        # first: RowGroupReader streams from local files only.
+        if isinstance(df, PreparedData):
+            specs, label_spec = self._reconcile_prepared(df)
+            return self.fit_on_parquet(
+                _localize_dataset(df.train_path),
+                _localize_dataset(df.val_path),
+                specs, label_spec)
+        if isinstance(df, str):
+            prepared = FilesystemStore.load_schema(df)
+            if prepared is not None:
+                specs, label_spec = self._reconcile_prepared(prepared)
+                return self.fit_on_parquet(
+                    _localize_dataset(prepared.train_path),
+                    _localize_dataset(prepared.val_path),
+                    specs, label_spec)
+            return self.fit_on_parquet(_localize_dataset(df))
 
         hvd.init()
         if self.streaming and self._store is None:
@@ -400,6 +440,25 @@ class Estimator(HasParams):
             self._store.delete(self._store.get_train_data_path(run_id))
             self._store.delete(self._store.get_val_data_path(run_id))
         return model
+
+    def _reconcile_prepared(self, prepared):
+        """The Estimator's configured columns rule: prepared-schema specs
+        are selected by ``feature_cols`` (subset training is legal) and a
+        label mismatch fails loudly — silently training on the sidecar's
+        column set would contradict the user's explicit configuration."""
+        by_name = {s.name: s for s in prepared.feature_specs}
+        missing = [c for c in self.feature_cols if c not in by_name]
+        if missing:
+            raise ParamError(
+                f"feature_cols {missing} are not in the prepared "
+                f"dataset's schema (has {sorted(by_name)}); re-prepare "
+                f"with those columns or adjust feature_cols")
+        if self.label_col != prepared.label_spec.name:
+            raise ParamError(
+                f"label_col '{self.label_col}' does not match the "
+                f"prepared dataset's label "
+                f"'{prepared.label_spec.name}'")
+        return [by_name[c] for c in self.feature_cols], prepared.label_spec
 
     def fit_on_parquet(self, train_path: str, val_path: Optional[str] = None,
                        feature_specs: Optional[Sequence[ColSpec]] = None,

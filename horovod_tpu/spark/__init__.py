@@ -18,11 +18,12 @@ from horovod_tpu.spark.store import (
     FilesystemStore,
     HDFSStore,
     LocalStore,
+    PreparedData,
     Store,
 )
 
 __all__ = ["run", "run_elastic", "Estimator", "TpuModel", "Store",
-           "FilesystemStore", "LocalStore", "HDFSStore",
+           "FilesystemStore", "LocalStore", "HDFSStore", "PreparedData",
            "LocalSparkContext"]
 
 

@@ -87,15 +87,29 @@ class Store:
     def read_dataframe(self, path: str):
         raise NotImplementedError
 
+    def prepare_data(self, df, feature_cols, label_col,
+                     validation_fraction: float = 0.0,
+                     rows_per_group: Optional[int] = None,
+                     idx="prepared") -> "PreparedData":
+        raise NotImplementedError
+
     @staticmethod
     def create(prefix_path: str, *args, **kwargs) -> "Store":
         """Factory by path scheme (reference ``Store.create``,
-        ``store.py:141``)."""
-        if prefix_path.startswith(("hdfs://", "gs://", "s3://")):
-            raise NotImplementedError(
-                f"remote store scheme in '{prefix_path}' is not available "
-                f"in this build (no hdfs/gcs/s3 client libraries); mount "
-                f"the filesystem (fuse) and pass a local path instead.")
+        ``store.py:141``): URL-prefixed paths go through the fsspec
+        store when fsspec is importable."""
+        if prefix_path.startswith("file://"):
+            prefix_path = prefix_path[len("file://"):]
+        if "://" in prefix_path:
+            try:
+                import fsspec  # noqa: F401
+            except ImportError:
+                raise NotImplementedError(
+                    f"remote store scheme in '{prefix_path}' needs fsspec "
+                    f"(plus the scheme's client library, e.g. gcsfs/"
+                    f"s3fs/pyarrow-hdfs); install it, or mount the "
+                    f"filesystem (fuse) and pass a local path.")
+            return FsspecStore(prefix_path, *args, **kwargs)
         return LocalStore(prefix_path, *args, **kwargs)
 
 
@@ -176,6 +190,88 @@ class FilesystemStore(Store):
         elif os.path.exists(path):
             os.remove(path)
 
+
+    # -- dataset preparation (reference spark/common/util.py:697
+    #    prepare_data: DataFrame -> store parquet + metadata) -------------
+
+    SCHEMA_FILE = "_hvd_schema.json"
+
+    def prepare_data(self, df, feature_cols, label_col,
+                     validation_fraction: float = 0.0,
+                     rows_per_group: Optional[int] = None,
+                     idx="prepared") -> "PreparedData":
+        """Materialize a DataFrame-shaped source into the store's
+        streaming parquet layout, once, ahead of any number of fits.
+
+        ``df`` may be a pandas DataFrame, any object exposing
+        ``toPandas()`` (a Spark DataFrame) or ``to_pandas()`` (pyarrow
+        Table, polars), or a dict of column arrays.  Schema is inferred
+        and validated through :func:`extract_typed` (the reference's
+        ``_get_metadata`` inference), rows split train/validation, each
+        side written as multi-row-group parquet (the
+        :class:`RowGroupReader` sharding unit), and the schema saved as
+        a ``_hvd_schema.json`` sidecar so ``Estimator.fit(path)``
+        streams without re-probing.  Returns :class:`PreparedData`.
+        """
+        df = _to_pandas_like(df)
+        # validate schema + dtypes column-by-column: each column is
+        # materialized (cast-checked) once and immediately discarded, so
+        # peak memory is one column, not a full casted dataset copy
+        feature_specs = []
+        for c in feature_cols:
+            _, (spec,) = extract_typed(df, [c])
+            feature_specs.append(spec)
+        _, (label_spec,) = extract_typed(df, [label_col])
+        n = len(df)
+        n_val = int(n * validation_fraction)
+        split = n - n_val
+        rpg = rows_per_group or max(split // 8, 1)
+        cols = list(dict.fromkeys(list(feature_cols) + [label_col]))
+        train_path = self.get_train_data_path(idx)
+        self.write_dataframe(df.iloc[:split][cols], train_path,
+                             rows_per_group=rpg)
+        val_path = None
+        if n_val:
+            val_path = self.get_val_data_path(idx)
+            self.write_dataframe(df.iloc[split:][cols], val_path,
+                                 rows_per_group=rpg)
+        schema = json.dumps({
+            "features": [sp.to_json() for sp in feature_specs],
+            "label": label_spec.to_json(),
+            "val_path": val_path,
+        }, indent=2).encode()
+        self.write(os.path.join(train_path, self.SCHEMA_FILE), schema)
+        if val_path:
+            self.write(os.path.join(val_path, self.SCHEMA_FILE), schema)
+        return PreparedData(train_path, val_path, feature_specs,
+                            label_spec)
+
+    @staticmethod
+    def load_schema(path: str) -> Optional["PreparedData"]:
+        """Recover :class:`PreparedData` from a prepared directory's
+        sidecar (local or any fsspec URL), or None when the directory
+        has no sidecar (plain parquet — callers fall back to
+        head-probing)."""
+        sidecar = path.rstrip("/") + "/" + FilesystemStore.SCHEMA_FILE
+        if "://" in path and not path.startswith("file://"):
+            import fsspec
+
+            fs, _ = fsspec.core.url_to_fs(path)
+            if not fs.exists(sidecar):
+                return None
+            with fs.open(sidecar, "r") as f:
+                raw = json.load(f)
+        else:
+            if not os.path.exists(sidecar):
+                return None
+            with open(sidecar) as f:
+                raw = json.load(f)
+        return PreparedData(
+            path, raw.get("val_path"),
+            [ColSpec.from_json(d) for d in raw["features"]],
+            ColSpec.from_json(raw["label"]))
+
+
     def new_run_id(self) -> str:
         """Next free ``run_NNN`` under the runs dir, reserved atomically
         with ``mkdir`` — two jobs sharing a store prefix must never both
@@ -194,6 +290,13 @@ class FilesystemStore(Store):
 
     # -- dataframe materialization (reference util.py prepare_data /
     #    petastorm parquet round-trip) -----------------------------------
+
+    # overridable IO primitives shared by the local and fsspec stores
+    def _open(self, path: str, mode: str):
+        return open(path, mode)
+
+    def _listdir(self, path: str) -> list:
+        return [os.path.join(path, f) for f in os.listdir(path)]
 
     def write_dataframe(self, df, path: str,
                         rows_per_group: Optional[int] = None) -> None:
@@ -214,7 +317,7 @@ class FilesystemStore(Store):
         import pyarrow as pa
         import pyarrow.parquet as pq
 
-        os.makedirs(path, exist_ok=True)
+        self.makedirs(path)
         if not isinstance(df, pd.DataFrame):
             df = pd.DataFrame({k: list(v) for k, v in df.items()})
         shapes = {}
@@ -229,18 +332,26 @@ class FilesystemStore(Store):
                 out[c] = col
         table = pa.Table.from_pandas(pd.DataFrame(out),
                                      preserve_index=False)
-        pq.write_table(table, os.path.join(path, "part-00000.parquet"),
-                       row_group_size=rows_per_group or len(df) or 1)
-        with open(os.path.join(path, "_meta.json"), "w") as f:
+        with self._open(path.rstrip("/") + "/part-00000.parquet",
+                        "wb") as f:
+            pq.write_table(table, f,
+                           row_group_size=rows_per_group or len(df) or 1)
+        with self._open(path.rstrip("/") + "/_meta.json", "w") as f:
             json.dump({"shapes": shapes}, f)
 
     def read_dataframe(self, path: str):
+        import pandas as pd
         import pyarrow.parquet as pq
 
-        df = pq.read_table(path).to_pandas()
-        meta_path = os.path.join(path, "_meta.json")
-        if os.path.exists(meta_path):
-            with open(meta_path) as f:
+        frames = []
+        for part in sorted(p for p in self._listdir(path)
+                           if str(p).endswith(".parquet")):
+            with self._open(part, "rb") as f:
+                frames.append(pq.read_table(f).to_pandas())
+        df = pd.concat(frames, ignore_index=True) if frames else None
+        meta_path = path.rstrip("/") + "/_meta.json"
+        if df is not None and self.exists(meta_path):
+            with self._open(meta_path, "r") as f:
                 shapes = json.load(f).get("shapes", {})
             for c, shape in shapes.items():
                 df[c] = [np.asarray(v).reshape(shape) for v in df[c]]
@@ -313,15 +424,128 @@ class LocalStore(FilesystemStore):
     """Local-disk store (reference ``LocalStore``, ``store.py:251``)."""
 
 
-class HDFSStore(Store):
-    """Gated: the reference's HDFS store needs pyarrow hdfs bindings +
-    a namenode; absent in this build (reference ``store.py:279``)."""
 
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(
-            "HDFSStore requires an HDFS client (libhdfs) which is not "
-            "available in this build; use LocalStore over a mounted "
-            "path.")
+
+class FsspecStore(FilesystemStore):
+    """Store over any fsspec filesystem — ``hdfs://``, ``gs://``,
+    ``s3://``, ``memory://`` ... (reference ``HDFSStore``,
+    ``store.py:279``, pyarrow-libhdfs based; fsspec is the TPU-era
+    equivalent that covers every remote scheme with one code path).
+
+    Inherits the full path layout and :meth:`prepare_data` from
+    :class:`FilesystemStore`; only the IO primitives are rerouted
+    through the filesystem handle.  Soft-gated: constructing without
+    fsspec (or without the scheme's client library) raises with the
+    install hint.  :class:`RowGroupReader` streaming requires a local
+    (or fuse-mounted) path — remote stores read datasets whole via
+    :meth:`read_dataframe`.
+    """
+
+    def __init__(self, prefix_path: str, **kwargs):
+        try:
+            import fsspec
+        except ImportError as e:  # pragma: no cover - fsspec is bundled
+            raise NotImplementedError(
+                "FsspecStore requires fsspec") from e
+        try:
+            self._fs, _ = fsspec.core.url_to_fs(prefix_path)
+        except ImportError as e:
+            raise NotImplementedError(
+                f"remote store scheme in '{prefix_path}' needs the "
+                f"scheme's fsspec client library (gcsfs/s3fs/...): {e}"
+            ) from e
+        except OSError as e:
+            raise NotImplementedError(
+                f"remote store for '{prefix_path}' is not reachable in "
+                f"this environment (client library failed to load: {e})"
+            ) from e
+        super().__init__(prefix_path.rstrip("/"), **kwargs)
+
+    # -- IO primitives over the fsspec handle ---------------------------
+
+    def exists(self, path: str) -> bool:
+        return self._fs.exists(path)
+
+    def read(self, path: str) -> bytes:
+        with self._fs.open(path, "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes) -> None:
+        parent = path.rsplit("/", 1)[0]
+        self._fs.makedirs(parent, exist_ok=True)
+        with self._fs.open(path, "wb") as f:
+            f.write(data)
+
+    def makedirs(self, path: str) -> None:
+        self._fs.makedirs(path, exist_ok=True)
+        # object stores have no empty directories; a marker makes the
+        # path observable (the reference's HDFS mkdir has real dirs)
+        marker = path.rstrip("/") + "/.hvd_dir"
+        if not self._fs.exists(marker):
+            with self._fs.open(marker, "wb") as f:
+                f.write(b"")
+
+    def delete(self, path: str) -> None:
+        if self._fs.exists(path):
+            self._fs.rm(path, recursive=True)
+
+    def is_parquet_dataset(self, path: str) -> bool:
+        if not self._fs.exists(path):
+            return False
+        try:
+            return any(str(f).endswith(".parquet")
+                       for f in self._fs.ls(path, detail=False))
+        except (FileNotFoundError, NotADirectoryError):
+            return False
+
+    def new_run_id(self) -> str:
+        """Next free ``run_NNN``.  Object stores lack an atomic mkdir;
+        the reservation marker narrows, not closes, the race — same
+        contract as the reference HDFSStore (no atomic namenode
+        reservation either)."""
+        self._fs.makedirs(self._runs_path, exist_ok=True)
+        try:
+            existing = [str(d).rstrip("/").rsplit("/", 1)[-1]
+                        for d in self._fs.ls(self._runs_path,
+                                             detail=False)]
+        except FileNotFoundError:
+            existing = []
+        taken = {d for d in existing if d.startswith("run_")}
+        n = 1
+        while f"run_{n:03d}" in taken:
+            n += 1
+        run_id = f"run_{n:03d}"
+        self.makedirs(self.get_run_path(run_id))
+        return run_id
+
+    def _open(self, path: str, mode: str):
+        return self._fs.open(path, mode)
+
+    def _listdir(self, path: str) -> list:
+        return [str(p) for p in self._fs.ls(path, detail=False)]
+
+
+class HDFSStore(FsspecStore):
+    """HDFS store (reference ``HDFSStore``, ``store.py:279``): the
+    fsspec store pinned to the ``hdfs://`` scheme.  Soft-gated — raises
+    with an install hint when fsspec (or the hdfs client behind it,
+    pyarrow libhdfs) is unavailable, exactly as the reference errors
+    without libhdfs."""
+
+    def __init__(self, prefix_path: str, **kwargs):
+        if "://" not in prefix_path:
+            prefix_path = "hdfs://" + prefix_path.lstrip("/")
+        if not prefix_path.startswith("hdfs://"):
+            raise ValueError(
+                f"HDFSStore expects an hdfs:// path, got '{prefix_path}'"
+                " (use Store.create for other schemes)")
+        try:
+            super().__init__(prefix_path, **kwargs)
+        except (ImportError, NotImplementedError) as e:
+            raise NotImplementedError(
+                "HDFSStore requires fsspec + an HDFS client "
+                "(pyarrow libhdfs); install them or use LocalStore over "
+                "a mounted path.") from e
 
 
 # ---------------------------------------------------------------------------
@@ -345,6 +569,40 @@ class ColSpec:
     @staticmethod
     def from_json(d: dict) -> "ColSpec":
         return ColSpec(d["name"], d["dtype"], tuple(d["shape"]))
+
+
+@dataclasses.dataclass
+class PreparedData:
+    """Handle to store-prepared training data: paths + schema (the
+    reference returns (rows, val_rows, metadata, avg_row_size) from its
+    prepare step; paths+specs are the TPU-side equivalent)."""
+
+    train_path: str
+    val_path: Optional[str]
+    feature_specs: List["ColSpec"]
+    label_spec: "ColSpec"
+
+
+def _to_pandas_like(df):
+    """Normalize a DataFrame-shaped source to pandas: pandas passthrough,
+    ``toPandas()`` (Spark), ``to_pandas()`` (pyarrow/polars), or a dict
+    of column arrays."""
+    import pandas as pd
+
+    if isinstance(df, pd.DataFrame):
+        return df
+    for meth in ("toPandas", "to_pandas"):
+        fn = getattr(df, meth, None)
+        if callable(fn):
+            out = fn()
+            if isinstance(out, pd.DataFrame):
+                return out
+    if isinstance(df, dict):
+        return pd.DataFrame({k: list(v) for k, v in df.items()})
+    raise TypeError(
+        f"cannot interpret {type(df).__name__} as a DataFrame: pass "
+        "pandas, an object with toPandas()/to_pandas(), or a dict of "
+        "column arrays")
 
 
 def _column_array(df, name: str) -> np.ndarray:
