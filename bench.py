@@ -16,10 +16,11 @@ throughput the reference publishes: ResNet-101 at 1,656.82 img/sec on 16
 Pascal P100s (``docs/benchmarks.rst:43``) → 103.55 img/sec per GPU.
 (The reference's other numbers are scaling efficiencies; BASELINE.md.)
 
-The transformer entry (183.8M params, 12L/1024d, seq 1024, bf16, Pallas
-flash attention fwd+bwd) is the long-context flagship; it makes the
-flash-backward speedup a driver-scored, re-measurable artifact rather
-than prose in PERF_NOTES.md.
+The transformer entry (870.9M params, 16L/2048d/16h, seq 1024, bf16,
+Pallas flash attention fwd+bwd) is the long-context flagship; the round-4
+model-shape scan (PERF_NOTES.md) found head_dim 128 — the MXU lane width
+— worth ~+13 MFU points over head_dim 64 at every size, and width >>
+depth, landing this config at 57.7% MFU / 113.8 TF/s on one v5e.
 """
 
 import argparse
@@ -121,8 +122,8 @@ def run_resnet(args, hvd):
         steps_per_call=spc,
         compiler_options=tpu_compiler_options(args))
     x0 = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
-    params, opt_state = step.init(
-        model.init(jax.random.PRNGKey(0), x0, train=False))
+    params, opt_state = step.init(jax.jit(
+        lambda k: model.init(k, x0, train=False))(jax.random.PRNGKey(0)))
 
     global_bs = batch_size * n_chips
     rng = np.random.RandomState(0)
@@ -171,10 +172,15 @@ def run_transformer(args, hvd):
         f"{layers}L/{d_model}d, seq {seq}, batch {batch}/chip, "
         f"attention={attn}, steps_per_call {spc}")
 
+    remat = bool(getattr(args, "tf_remat", False))
+    if remat and platform == "cpu":
+        log("bench[transformer]: --tf-remat ignored on the CPU "
+            "smoke-scale config (tiny model, nothing to rematerialize)")
+        remat = False
     cfg = TransformerConfig(
         vocab_size=32_000, num_layers=layers, num_heads=heads,
         d_model=d_model, d_ff=4 * d_model, max_seq_len=seq,
-        dtype=dtype, attention_impl=attn)
+        dtype=dtype, attention_impl=attn, remat=remat)
     model = TransformerLM(cfg)
 
     def loss_fn(params, batch):
@@ -187,7 +193,9 @@ def run_transformer(args, hvd):
         steps_per_call=spc,
         compiler_options=tpu_compiler_options(args))
     tokens0 = jnp.zeros((1, seq), jnp.int32)
-    variables = model.init(jax.random.PRNGKey(0), tokens0)
+    # jit the init: eager flax init dispatches hundreds of per-op calls,
+    # minutes for an ~1B model through a remote-device tunnel
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0), tokens0)
     nparams = sum(x.size for x in jax.tree_util.tree_leaves(variables))
     params, opt_state = step.init(variables)
 
@@ -209,7 +217,8 @@ def run_transformer(args, hvd):
     # fwd+bwd FLOPs/token: 6·P (params incl. the tied embedding head,
     # whose 6·V·d logits share stands in for the lookup) + causal
     # attention ≈ 6·L·T·d (QKᵀ + AV, fwd 4·T·d + bwd 8·T·d, halved by
-    # the causal mask).  Matches PERF_NOTES.md's ≈62 TF/s at 54k tok/s.
+    # the causal mask).  PERF_NOTES.md's flagship table uses this same
+    # accounting (113.8 TF/s at 20,962 tok/s for 16L/2048d).
     flops_per_token = 6 * nparams + 6 * layers * seq * d_model
     peak = hw_peak_flops()
     tf_s = tokens_per_chip_sec * flops_per_token
@@ -253,12 +262,15 @@ def main():
     p.add_argument("--no-space-to-depth", dest="space_to_depth",
                    action="store_false",
                    help="use the reference 7x7 stride-2 stem")
-    p.add_argument("--tf-layers", type=int, default=12)
-    p.add_argument("--tf-d-model", type=int, default=1024)
+    p.add_argument("--tf-layers", type=int, default=16)
+    p.add_argument("--tf-d-model", type=int, default=2048)
     p.add_argument("--tf-heads", type=int, default=16)
     p.add_argument("--tf-seq-len", type=int, default=1024)
-    p.add_argument("--tf-batch-size", type=int, default=8,
+    p.add_argument("--tf-batch-size", type=int, default=4,
                    help="transformer per-chip batch size")
+    p.add_argument("--tf-remat", action="store_true",
+                   help="checkpoint each transformer block (recompute "
+                        "activations in backward)")
     p.add_argument("--tf-attention", default="flash",
                    choices=["dense", "flash"])
     args = p.parse_args()

@@ -1,0 +1,119 @@
+"""Per-op device profile of the transformer-LM bench step (PERF_NOTES).
+
+The transformer counterpart of ``profile_resnet.py``: captures a
+``jax.profiler`` trace of the exact ``bench.py`` transformer step on the
+real chip and prints exclusive per-op device times ("XLA Ops" line,
+nesting-aware — async spans overlap and double-count, so exclusive
+self-time is the honest attribution).
+
+Usage::
+
+    python examples/profile_transformer.py --layers 12 --d-model 1024 \
+        [--batch-size 8] [--seq-len 1024] [--steps-per-call 4] [--remat]
+"""
+
+import argparse
+import collections
+import os
+import sys
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from profile_resnet import exclusive_op_times, op_kind  # noqa: E402
+
+
+def build_step(args):
+    import horovod_tpu as hvd
+    from horovod_tpu.models import TransformerConfig, TransformerLM
+
+    hvd.init()
+    cfg = TransformerConfig(
+        vocab_size=32_000, num_layers=args.layers, num_heads=args.heads,
+        d_model=args.d_model, d_ff=4 * args.d_model,
+        max_seq_len=args.seq_len, dtype=jnp.bfloat16,
+        attention_impl=args.attention, remat=args.remat)
+    model = TransformerLM(cfg)
+
+    def loss_fn(params, batch):
+        logits = model.apply(params, batch["inputs"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["labels"]).mean()
+
+    opts = None if args.no_lhs else \
+        {"xla_tpu_enable_latency_hiding_scheduler": "true"}
+    step = hvd.DistributedTrainStep(
+        loss_fn, optax.adamw(3e-4), steps_per_call=args.steps_per_call,
+        compiler_options=opts)
+    tokens0 = jnp.zeros((1, args.seq_len), jnp.int32)
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0), tokens0)
+    nparams = sum(x.size for x in jax.tree_util.tree_leaves(variables))
+    params, opt_state = step.init(variables)
+    rng = np.random.RandomState(0)
+    raw = rng.randint(0, cfg.vocab_size, (args.batch_size,
+                                          args.seq_len + 1))
+    batch = step.shard_batch({
+        "inputs": jnp.asarray(raw[:, :-1], jnp.int32),
+        "labels": jnp.asarray(raw[:, 1:], jnp.int32),
+    })
+    return step, params, opt_state, batch, nparams
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--d-model", type=int, default=1024)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--seq-len", type=int, default=1024)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--attention", default="flash")
+    ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--steps-per-call", type=int, default=4)
+    ap.add_argument("--top", type=int, default=30)
+    ap.add_argument("--no-lhs", action="store_true")
+    ap.add_argument("--trace-dir", default=None)
+    args = ap.parse_args()
+
+    step, params, opt_state, batch, nparams = build_step(args)
+    p, o, loss = step(params, opt_state, batch)        # compile + warm
+    float(loss)
+
+    trace_dir = args.trace_dir or tempfile.mkdtemp(prefix="tfprof_")
+    with jax.profiler.trace(trace_dir):
+        p, o, loss = step(p, o, batch)
+        float(loss)
+    print(f"trace: {trace_dir}  ({nparams / 1e6:.1f}M params)")
+
+    self_ps = exclusive_op_times(trace_dir)
+    nsteps = args.steps_per_call
+    total_ms = sum(self_ps.values()) / 1e9 / nsteps
+    print(f"device exclusive op time: {total_ms:.2f} ms/step "
+          f"({len(self_ps)} distinct ops, {nsteps} steps traced)")
+    tokens = args.batch_size * args.seq_len
+    flops_per_token = 6 * nparams + 6 * args.layers * args.seq_len \
+        * args.d_model
+    print(f"implied: {tokens / total_ms * 1000:.0f} tok/s, "
+          f"{tokens / total_ms * 1000 * flops_per_token / 1e12:.1f} TF/s")
+
+    by_kind = collections.defaultdict(float)
+    for name, ps in self_ps.items():
+        by_kind[op_kind(name)] += ps
+    print("\n-- by op class (ms/step) --")
+    for k, v in sorted(by_kind.items(), key=lambda kv: -kv[1])[:14]:
+        ms = v / 1e9 / nsteps
+        if ms >= 0.005:
+            print(f"{k:36s} {ms:8.2f}  {ms / total_ms * 100:5.1f}%")
+
+    print(f"\n-- top {args.top} ops (self ms/step) --")
+    ranked = sorted(self_ps.items(), key=lambda kv: -kv[1])
+    for name, ps in ranked[:args.top]:
+        ms = ps / 1e9 / nsteps
+        print(f"{name[:84]:84s} {ms:7.3f}")
+
+
+if __name__ == "__main__":
+    main()

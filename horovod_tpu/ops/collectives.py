@@ -294,6 +294,31 @@ def allgather_v(x: jax.Array, valid_count: jax.Array,
     return gathered, counts
 
 
+def allgather_v_mask(counts: jax.Array, max_count: int) -> jax.Array:
+    """``(world, max_count)`` bool mask of the valid rows in an
+    :func:`allgather_v` result — the in-graph masking idiom, provided
+    once so call sites don't re-derive it::
+
+        gathered, counts = allgather_v(x, n, max_count)
+        mask = allgather_v_mask(counts, max_count)
+        total = jnp.sum(jnp.where(mask[..., None], gathered, 0), (0, 1))
+    """
+    return jnp.arange(max_count)[None, :] < counts[:, None]
+
+
+def allgather_v_compact(gathered, counts) -> "np.ndarray":
+    """Host-side compaction of an :func:`allgather_v` result: drop the
+    padding and concatenate every shard's valid rows along dim 0 —
+    Horovod's variable allgather output layout (``MPI_Allgatherv``
+    displacement packing, ``mpi_operations.cc:96``).  Call *outside*
+    jit: the output's first dim is data-dependent.
+    """
+    g = np.asarray(gathered)
+    c = np.asarray(counts).reshape(-1)
+    return np.concatenate([g[i, :int(c[i])] for i in range(len(c))],
+                          axis=0)
+
+
 def broadcast(x: jax.Array, root_rank: int = 0,
               axis: AxisSpec = GLOBAL_AXES) -> jax.Array:
     """Broadcast the value held by ``root_rank`` (linearized over ``axis``)

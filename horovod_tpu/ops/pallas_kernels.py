@@ -76,7 +76,10 @@ def fused_scale(x: jax.Array, factor: float,
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                       causal: bool, scale: float):
     # blocks: q (1, BQ, D); k/v (1, T, D); o (1, BQ, D)
-    q = q_ref[0].astype(jnp.float32)                  # (BQ, D)
+    # inputs stay in their native dtype (bf16): the MXU runs bf16 x bf16
+    # at full rate with fp32 accumulation via preferred_element_type —
+    # casting to fp32 first would forfeit the systolic-array rate
+    q = q_ref[0]                                      # (BQ, D)
     block_q, d = q.shape
     t = k_ref.shape[1]
     qi = pl.program_id(1)
@@ -85,8 +88,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
     def body(kb, carry):
         o, m, l = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
@@ -99,8 +102,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
             p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + p.sum(axis=-1)
+        # p in the value dtype for the MXU; the o accumulator stays fp32
         o_new = o * corr[:, None] + jnp.dot(
-            p, v_blk, preferred_element_type=jnp.float32)
+            p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32)
         return o_new, m_new, l_new
 
     num_k = t // block_k
@@ -166,8 +171,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     """dQ for one Q block: stream K/V blocks, rebuild p from the saved
     logsumexp, accumulate dq = Σ ds·K·scale (FlashAttention-2 backward,
     dS = P ∘ (dP − delta) with delta = rowsum(dO ∘ O))."""
-    q = q_ref[0].astype(jnp.float32)          # (BQ, D)
-    do = do_ref[0].astype(jnp.float32)        # (BQ, D)
+    q = q_ref[0]                              # (BQ, D) native dtype
+    do = do_ref[0]                            # (BQ, D)
     lse = lse_ref[0, 0]                       # (BQ,) (sublane 0)
     delta = delta_ref[0, 0]                   # (BQ,)
     block_q, d = q.shape
@@ -177,8 +182,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         jnp.int32, (block_q, block_k), 0)
 
     def body(kb, dq):
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
@@ -189,7 +194,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             p = jnp.where(mask, p, 0.0)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = (p * (dp - delta[:, None])).astype(k_blk.dtype)
         return dq + jnp.dot(ds, k_blk,
                             preferred_element_type=jnp.float32) * scale
 
@@ -209,8 +214,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     """dK/dV for one K block: stream Q/dO blocks; dV = Σ pᵀ·dO,
     dK = Σ dsᵀ·Q·scale.  Causal: Q blocks strictly above the diagonal
     contribute nothing and are skipped."""
-    k = k_ref[0].astype(jnp.float32)          # (BK, D)
-    v = v_ref[0].astype(jnp.float32)          # (BK, D)
+    k = k_ref[0]                              # (BK, D) native dtype
+    v = v_ref[0]                              # (BK, D)
     block_k, d = k.shape
     t = q_ref.shape[1]
     ki = pl.program_id(1)
@@ -219,9 +224,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(qb, carry):
         dk, dv = carry
-        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(
-            jnp.float32)
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :]
         lse_blk = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
         delta_blk = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
         s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
@@ -233,10 +237,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse_blk[:, None])
         if causal:
             p = jnp.where(mask, p, 0.0)
-        dv = dv + jnp.dot(p.T, do_blk,
+        dv = dv + jnp.dot(p.astype(do_blk.dtype).T, do_blk,
                           preferred_element_type=jnp.float32)
         dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_blk[:, None])
+        ds = (p * (dp - delta_blk[:, None])).astype(q_blk.dtype)
         dk = dk + jnp.dot(ds.T, q_blk,
                           preferred_element_type=jnp.float32) * scale
         return dk, dv

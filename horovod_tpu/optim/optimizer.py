@@ -195,7 +195,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          backward_passes_per_step: int = 1,
                          prescale_factor: Optional[float] = None,
                          postscale_factor: Optional[float] = None,
-                         sparse_params: Optional[dict] = None
+                         sparse_params: Optional[dict] = None,
+                         gradient_predivide_factor: float = 1.0
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so each update uses cross-replica-reduced
     gradients (reference ``DistributedOptimizer`` factory,
@@ -210,6 +211,19 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     backward_passes_per_step counting).
     """
     del named_parameters
+    if gradient_predivide_factor != 1.0:
+        # reference semantics (torch/optimizer.py:119-123): split the
+        # averaging across the sum — grads scale by 1/f before and f/size
+        # after (our Average already applies the 1/size)
+        if op != Average:
+            raise ValueError(
+                "gradient_predivide_factor requires op=Average")
+        if prescale_factor is not None or postscale_factor is not None:
+            raise ValueError(
+                "pass either gradient_predivide_factor or explicit "
+                "prescale/postscale factors, not both")
+        prescale_factor = 1.0 / gradient_predivide_factor
+        postscale_factor = gradient_predivide_factor
     chained = optax.chain(
         distributed_gradients(op=op, axis=axis, mode=mode,
                               compression=compression,

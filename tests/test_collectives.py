@@ -378,3 +378,35 @@ class TestControlPrimitives:
         np.testing.assert_array_equal(np.asarray(bor).ravel(), expected_or)
         expected_and = np.int32((1 << 30) | 5 | -2**31)
         np.testing.assert_array_equal(np.asarray(band).ravel(), expected_and)
+
+
+class TestAllgatherVHelpers:
+    def test_mask_and_compact(self):
+        """The documented compaction idiom: mask matches validity, host
+        compaction reproduces Horovod's variable-allgather layout."""
+        devs = np.asarray(jax.devices("cpu")[:4])
+        mesh = Mesh(devs, ("ranks",))
+        max_count = 4
+
+        def f():
+            r = jax.lax.axis_index("ranks")
+            rows = jnp.where(jnp.arange(max_count) <= r,
+                             (r + 1) * 1.0, 0.0)[:, None]
+            g, c = C.allgather_v(rows, r + 1, max_count, axis="ranks")
+            mask = C.allgather_v_mask(c, max_count)
+            masked_sum = jnp.sum(jnp.where(mask[..., None], g, 0.0))
+            return g[None], c[None], masked_sum[None]
+
+        g, c, ms = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(),
+            out_specs=(P("ranks"), P("ranks"), P("ranks")),
+            check_vma=False))()
+        g0, c0 = np.asarray(g)[0], np.asarray(c)[0]
+        flat = C.allgather_v_compact(g0, c0)
+        # rank r contributes (r+1) rows of value r+1
+        expected = np.concatenate(
+            [np.full((r + 1, 1), r + 1.0) for r in range(4)])
+        np.testing.assert_allclose(flat, expected)
+        # in-graph masked sum == sum of all valid rows, on every shard
+        np.testing.assert_allclose(np.asarray(ms),
+                                   sum((r + 1) ** 2 for r in range(4)))

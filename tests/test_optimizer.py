@@ -422,3 +422,39 @@ class TestInt8WireReduction:
             hvd.DistributedTrainStep(lambda p, b: 0.0, optax.sgd(0.1),
                                      mode="shard_map", op=None,
                                      sparse_params={"emb": 8})
+
+
+class TestGradientPredivide:
+    def test_split_average_matches_plain(self):
+        """gradient_predivide_factor splits the averaging across the sum
+        (reference torch/optimizer.py:119-123): result identical to the
+        plain average up to fp rounding."""
+        data = np.linspace(-2, 2, 8 * 6).reshape(8, 6).astype(np.float32)
+
+        def f(factor):
+            def inner():
+                r = C.axis_index(GLOBAL_AXES)
+                tx = hvd.DistributedOptimizer(
+                    optax.sgd(1.0), gradient_predivide_factor=factor)
+                params = {"p": jnp.zeros(6)}
+                u, _ = tx.update({"p": jnp.asarray(data)[r]},
+                                 tx.init(params), params)
+                return u["p"][None]
+
+            devs = np.asarray(jax.devices("cpu")[:8]).reshape(2, 4)
+            return np.asarray(jax.jit(jax.shard_map(
+                inner, mesh=Mesh(devs, GLOBAL_AXES), in_specs=(),
+                out_specs=P(GLOBAL_AXES), check_vma=False))())
+
+        np.testing.assert_allclose(f(4.0)[0], f(1.0)[0], rtol=1e-5)
+        np.testing.assert_allclose(f(1.0)[0], -data.mean(axis=0),
+                                   rtol=1e-5)
+
+    def test_guards(self):
+        with pytest.raises(ValueError, match="op=Average"):
+            hvd.DistributedOptimizer(optax.sgd(1.0), op=C.Sum,
+                                     gradient_predivide_factor=2.0)
+        with pytest.raises(ValueError, match="not both"):
+            hvd.DistributedOptimizer(optax.sgd(1.0),
+                                     gradient_predivide_factor=2.0,
+                                     prescale_factor=0.5)
