@@ -94,3 +94,43 @@ class TestTensorParallelGSPMD:
             out = jax.jit(model.apply)(variables, tokens)
         np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestTpuEfficiencyHints:
+    def test_head_dim_hint(self):
+        from horovod_tpu.models import TransformerConfig
+
+        cfg = TransformerConfig(d_model=1024, num_heads=16)  # head_dim 64
+        hints = cfg.tpu_efficiency_hints()
+        assert any("head_dim 64" in h and "num_heads=8" in h
+                   for h in hints), hints
+
+    def test_clean_config_no_hints(self):
+        from horovod_tpu.models import TransformerConfig
+
+        cfg = TransformerConfig(d_model=2048, num_heads=16)  # head_dim 128
+        assert cfg.tpu_efficiency_hints() == []
+
+    def test_non_multiple_d_model(self):
+        from horovod_tpu.models import TransformerConfig
+
+        cfg = TransformerConfig(d_model=1000, num_heads=8)
+        hints = cfg.tpu_efficiency_hints()
+        assert any("multiple of 128" in h for h in hints)
+        # no head-count suggestion when padding is the first problem
+        assert not any("num_heads=" in h for h in hints)
+
+    def test_suggestion_is_always_a_divisor(self):
+        from horovod_tpu.models import TransformerConfig
+
+        import re
+        for d in (256, 768, 1024, 1280, 1536, 2048, 4096):
+            heads = max(d // 64, 2)
+            if d % heads:
+                continue
+            cfg = TransformerConfig(d_model=d, num_heads=heads)
+            for h in cfg.tpu_efficiency_hints():
+                m = re.search(r"num_heads=(\d+)", h)
+                if m:
+                    n = int(m.group(1))
+                    assert d % n == 0 and d // n >= 128, (d, n)
