@@ -198,10 +198,12 @@ class TransformerLM(nn.Module):
         cfg = self.cfg
         shape_key = (cfg.d_model, cfg.num_heads)
         if shape_key not in _hinted_shapes:     # once per process, cheap
-            _hinted_shapes.add(shape_key)
             import horovod_tpu
 
+            # only mark hinted once a TPU was actually present — a CPU
+            # trace before hvd.init() must not suppress the hint forever
             if horovod_tpu.tpu_available():
+                _hinted_shapes.add(shape_key)
                 from horovod_tpu.utils import logging as hvd_logging
 
                 for hint in cfg.tpu_efficiency_hints():

@@ -14,7 +14,11 @@ package goes from that primitive to the strategies themselves, TPU-first:
 * :mod:`~horovod_tpu.parallel.ulysses` — all-to-all sequence↔head
   exchange attention;
 * :mod:`~horovod_tpu.parallel.tensor_parallel` — Megatron-style
-  column/row-parallel Dense layers with a single ``psum`` per block.
+  column/row-parallel Dense layers with a single ``psum`` per block;
+* :mod:`~horovod_tpu.parallel.fsdp` — ZeRO-3-style fully-sharded data
+  parallelism by parameter *placement* (GSPMD inserts the
+  gather/reduce-scatter), wired into ``DistributedTrainStep`` via
+  ``fsdp_axis=``.
 """
 
 from horovod_tpu.parallel.mesh import (
@@ -27,6 +31,12 @@ from horovod_tpu.parallel.mesh import (
     make_parallel_mesh,
 )
 from horovod_tpu.parallel.expert import expert_parallel_ffn, top1_routing
+from horovod_tpu.parallel.fsdp import (
+    fsdp_sharding,
+    resident_bytes,
+    shard_params,
+    sharding_specs,
+)
 from horovod_tpu.parallel.pipeline import gpipe
 from horovod_tpu.parallel.ring_attention import ring_attention
 from horovod_tpu.parallel.ulysses import ulysses_attention
@@ -41,4 +51,5 @@ __all__ = [
     "ring_attention", "ulysses_attention", "gpipe",
     "expert_parallel_ffn", "top1_routing",
     "ColumnParallelDense", "RowParallelDense",
+    "fsdp_sharding", "shard_params", "sharding_specs", "resident_bytes",
 ]

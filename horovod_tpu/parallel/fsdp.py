@@ -1,0 +1,96 @@
+"""Fully-sharded data parallelism (ZeRO-3 analogue), TPU formulation.
+
+The reference framework replicates parameters on every worker and
+allreduces gradients — its memory ceiling is one full model + optimizer
+state per accelerator.  FSDP shards parameters (and, by propagation,
+optimizer state) across a mesh axis; each step all-gathers a parameter
+right before use and reduce-scatters its gradient right after — trading
+one extra all-gather per step for an O(world) reduction in resident
+state.
+
+TPU formulation: there is no wrapper module and no hand-written
+gather/scatter.  Parameters are *placed* sharded (`NamedSharding` over
+the ``fsdp``/``ici`` axis, largest divisible dimension) and the step is
+jitted without replicated-input constraints — GSPMD then inserts
+exactly the all-gather-on-use and reduce-scatter-on-grad collectives
+the hand-rolled ZeRO-3 schedules perform, scheduled and overlapped by
+the compiler (the "sharding is placement" recipe of the scaling book).
+Optimizer state inherits the sharding automatically because
+``optimizer.init`` runs under jit on the sharded parameters.
+
+Entry points: :func:`fsdp_sharding` (per-leaf placement rule),
+:func:`shard_params` (place a pytree), and
+``DistributedTrainStep(fsdp_axis=...)`` which wires both into the
+training step.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# leaves smaller than this stay replicated: sharding a bias vector saves
+# bytes measured in KB but adds a collective to the step
+DEFAULT_MIN_WEIGHT_SIZE = 1 << 14
+
+
+def fsdp_sharding(shape, mesh: Mesh, axis: str,
+                  min_weight_size: int = DEFAULT_MIN_WEIGHT_SIZE
+                  ) -> NamedSharding:
+    """Placement rule for one parameter: partition the largest dimension
+    divisible by the axis size; replicate small or indivisible leaves.
+
+    Partitioning the largest dim maximizes the bytes saved per leaf and
+    keeps every shard's tile contiguous in its minor dims (layout- and
+    MXU-friendly: the minor-most dims stay whole).
+    """
+    n = mesh.shape[axis]
+    size = int(np.prod(shape)) if shape else 1
+    if n == 1 or size < min_weight_size:
+        return NamedSharding(mesh, P())
+    # largest dimension with the needed divisibility
+    candidates = [(d, i) for i, d in enumerate(shape) if d % n == 0]
+    if not candidates:
+        return NamedSharding(mesh, P())
+    _, dim = max(candidates)
+    spec = [None] * len(shape)
+    spec[dim] = axis
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_params(params, mesh: Mesh, axis: str,
+                 min_weight_size: int = DEFAULT_MIN_WEIGHT_SIZE):
+    """``device_put`` a parameter pytree with per-leaf FSDP placement.
+    Returns the sharded tree; leaves keep their values, only residency
+    changes."""
+    def place(x):
+        return jax.device_put(
+            x, fsdp_sharding(np.shape(x), mesh, axis, min_weight_size))
+
+    return jax.tree_util.tree_map(place, params)
+
+
+def sharding_specs(params, mesh: Mesh, axis: str,
+                   min_weight_size: int = DEFAULT_MIN_WEIGHT_SIZE):
+    """The pytree of `NamedSharding`s :func:`shard_params` would use —
+    for inspection/tests and for passing to explicit ``in_shardings``."""
+    return jax.tree_util.tree_map(
+        lambda x: fsdp_sharding(getattr(x, "shape", np.shape(x)), mesh,
+                                axis, min_weight_size),
+        params)
+
+
+def resident_bytes(params) -> int:
+    """Per-device bytes actually resident for a (possibly sharded)
+    pytree — the number FSDP shrinks.  Computed as one shard's bytes per
+    leaf (every device holds exactly one shard; replicated leaves' shard
+    is the whole array)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        if isinstance(leaf, jax.Array) and leaf.addressable_shards:
+            shard = leaf.addressable_shards[0]
+            total += int(np.prod(shard.data.shape)) * leaf.dtype.itemsize
+    return total
