@@ -104,6 +104,12 @@ def _reset_mesh_cache() -> None:
     # HOST-plane KV keys carry a per-call counter that must restart in
     # lock-step with the new world (a fresh process starts at zero)
     op_manager.reset_host_plane()
+    # timeline-aggregation upload keys carry the same kind of SPMD-
+    # ordered counter: surviving processes must restart it so it stays
+    # aligned with freshly-joined workers (which start at zero)
+    from horovod_tpu.utils import timeline as _tl
+
+    _tl._aggregate_seq = 0
 
 
 _validated_signatures: set = set()
