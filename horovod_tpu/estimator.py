@@ -15,6 +15,7 @@ The loop underneath is :class:`~horovod_tpu.optim.DistributedTrainStep`
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Callable, List, Optional, Sequence
 
 import jax
@@ -104,6 +105,73 @@ def _localize_dataset(path: Optional[str]) -> Optional[str]:
     local = tempfile.mkdtemp(prefix="hvd_dataset_")
     fs.get(path.rstrip("/") + "/", local + "/", recursive=True)
     return local
+
+
+def _wrap_apply(model):
+    """``apply(params, x)`` callable from a flax module or a bare apply
+    fn — the one place the wrapping lives (fitted and loaded models must
+    not diverge)."""
+    if hasattr(model, "apply"):
+        return lambda params, x: model.apply(params, x)
+    return model
+
+
+def _save_model_object(store, run_id: str, model) -> None:
+    """Best-effort pickle of the model architecture into the run layout
+    (reference estimators serialize the model so ``Model.load`` works
+    without re-declaring it; flax modules are plain dataclasses and
+    usually pickle fine).  Unpicklable models are skipped — load_model
+    then needs the model passed explicitly."""
+    import pickle
+
+    try:
+        payload = pickle.dumps(model)
+    except Exception:
+        return
+    store.write(os.path.join(store.get_run_path(run_id), "model.pkl"),
+                payload)
+
+
+def load_model(store, run_id: Optional[str] = None, model=None,
+               step: Optional[int] = None, batch_size: int = 1024,
+               output_col: str = "prediction") -> TpuModel:
+    """Reconstruct a fitted :class:`TpuModel` from a store run — the
+    reference's ``Model.load`` round trip (``spark/common/estimator.py``
+    model deserialization + checkpoint restore).
+
+    ``run_id`` defaults to the newest run.  ``model`` overrides the
+    pickled architecture (required if the fit-time model was not
+    picklable).  ``step`` picks a checkpoint (default: latest).
+    """
+    import pickle
+
+    from horovod_tpu.checkpoint import Checkpointer
+    from horovod_tpu.spark.store import Store, load_metadata
+
+    if isinstance(store, str):
+        store = Store.create(store)
+    if run_id is None:
+        runs = store.list_runs(complete_only=True)
+        if not runs:
+            raise FileNotFoundError(
+                f"no completed runs in {store.get_runs_path()}")
+        run_id = runs[-1]
+    feature_specs, _label = load_metadata(store, run_id)
+    if model is None:
+        pkl = os.path.join(store.get_run_path(run_id), "model.pkl")
+        if not store.exists(pkl):
+            raise FileNotFoundError(
+                f"{pkl} missing (the fit-time model was not picklable); "
+                f"pass model= explicitly")
+        model = pickle.loads(store.read(pkl))
+    apply_fn = _wrap_apply(model)
+    state = Checkpointer(store.get_checkpoint_path(run_id)).restore(
+        None, step=step)
+    params = state["params"] if isinstance(state, dict) and \
+        "params" in state else state
+    return TpuModel(apply_fn, params, [sp.name for sp in feature_specs],
+                    output_col=output_col, batch_size=batch_size,
+                    feature_specs=feature_specs)
 
 
 @dataclasses.dataclass
@@ -237,9 +305,7 @@ class Estimator(HasParams):
             else self._store is not None
 
     def _apply_fn(self):
-        if hasattr(self._model, "apply"):
-            return lambda params, x: self._model.apply(params, x)
-        return self._model
+        return _wrap_apply(self._model)
 
     def fit(self, df) -> TpuModel:
         import horovod_tpu as hvd
@@ -304,6 +370,7 @@ class Estimator(HasParams):
                 self._store.makedirs(self._store.get_logs_path(run_id))
                 save_metadata(self._store, run_id, feature_specs,
                               label_spec)
+                _save_model_object(self._store, run_id, self._model)
                 import pandas as pd
 
                 if isinstance(df, pd.DataFrame):
@@ -415,6 +482,7 @@ class Estimator(HasParams):
         if hvd.rank() == 0:
             self._store.makedirs(self._store.get_logs_path(run_id))
             save_metadata(self._store, run_id, feature_specs, label_spec)
+            _save_model_object(self._store, run_id, self._model)
             split = n_rows - n_val
 
             # run-scoped intermediate paths: concurrent fits (or a second
@@ -491,6 +559,7 @@ class Estimator(HasParams):
                 self._store.makedirs(self._store.get_logs_path(run_id))
                 save_metadata(self._store, run_id, feature_specs,
                               label_spec)
+                _save_model_object(self._store, run_id, self._model)
             hvd.barrier()
         return self._fit_streaming(train_path, val_path, feature_specs,
                                    label_spec, hvd, run_id)

@@ -22,7 +22,8 @@ from horovod_tpu.spark.store import (
     Store,
 )
 
-__all__ = ["run", "run_elastic", "Estimator", "TpuModel", "Store",
+__all__ = ["run", "run_elastic", "Estimator", "TpuModel", "load_model",
+           "Store",
            "FilesystemStore", "LocalStore", "HDFSStore", "PreparedData",
            "LocalSparkContext"]
 
@@ -30,7 +31,7 @@ __all__ = ["run", "run_elastic", "Estimator", "TpuModel", "Store",
 def __getattr__(name):
     # estimator imports spark.store; resolving Estimator lazily keeps
     # `horovod_tpu.spark.Estimator` importable without a module cycle
-    if name in ("Estimator", "TpuModel"):
+    if name in ("Estimator", "TpuModel", "load_model"):
         from horovod_tpu import estimator
 
         return getattr(estimator, name)

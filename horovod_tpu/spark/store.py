@@ -93,6 +93,9 @@ class Store:
                      idx="prepared") -> "PreparedData":
         raise NotImplementedError
 
+    def list_runs(self, complete_only: bool = False) -> list:
+        raise NotImplementedError
+
     @staticmethod
     def create(prefix_path: str, *args, **kwargs) -> "Store":
         """Factory by path scheme (reference ``Store.create``,
@@ -271,6 +274,32 @@ class FilesystemStore(Store):
             [ColSpec.from_json(d) for d in raw["features"]],
             ColSpec.from_json(raw["label"]))
 
+
+    def list_runs(self, complete_only: bool = False) -> list:
+        """Run ids under the runs dir, newest last (numeric sort — ids
+        grow past the zero padding after run_999).  ``complete_only``
+        keeps only runs whose metadata landed: ``new_run_id`` reserves
+        the directory before any artifact exists, so an in-progress or
+        crashed fit otherwise shows up as the "newest" run."""
+        try:
+            entries = self._listdir(self._runs_path)
+        except (FileNotFoundError, NotADirectoryError, OSError):
+            return []
+        names = [str(e).rstrip("/").rsplit("/", 1)[-1] for e in entries]
+
+        def run_no(n):
+            try:
+                return int(n[4:])
+            except ValueError:
+                return -1
+
+        runs = sorted((n for n in names
+                       if n.startswith("run_") and run_no(n) >= 0),
+                      key=run_no)
+        if complete_only:
+            runs = [r for r in runs if self.exists(
+                os.path.join(self.get_run_path(r), "metadata.json"))]
+        return runs
 
     def new_run_id(self) -> str:
         """Next free ``run_NNN`` under the runs dir, reserved atomically

@@ -594,3 +594,76 @@ class TestFsspecStore:
         st.makedirs(st.get_runs_path())
         import os
         assert os.path.isdir(str(tmp_path / "s" / "runs"))
+
+
+class TestModelLoadRoundTrip:
+    """Model save/load round trip (reference Model.load: deserialize the
+    architecture + restore the checkpoint from the store run)."""
+
+    def test_load_latest_run(self, tmp_path):
+        import numpy as np
+
+        from horovod_tpu.spark import load_model
+        from horovod_tpu.spark.store import Store
+
+        store = Store.create(str(tmp_path / "s"))
+        df = make_df(48)
+        est = Estimator(Net(), feature_cols=["f1", "f2", "f3", "f4"],
+                        label_col="label", batch_size=8, epochs=1,
+                        store=store)
+        fitted = est.fit(df)
+        loaded = load_model(store)        # newest run, pickled model
+        a = np.stack(fitted.transform(df.head(8))["prediction"])
+        b = np.stack(loaded.transform(df.head(8))["prediction"])
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_load_by_path_and_run_id(self, tmp_path):
+        from horovod_tpu.spark import load_model
+        from horovod_tpu.spark.store import Store
+
+        store = Store.create(str(tmp_path / "s"))
+        Estimator(Net(), feature_cols=["f1", "f2", "f3", "f4"],
+                  label_col="label", batch_size=8, epochs=1,
+                  store=store).fit(make_df(32))
+        m = load_model(str(tmp_path / "s"), run_id="run_001")
+        assert m.feature_cols == ["f1", "f2", "f3", "f4"]
+
+    def test_unpicklable_model_needs_explicit(self, tmp_path):
+        from horovod_tpu.spark import load_model
+        from horovod_tpu.spark.store import Store
+
+        store = Store.create(str(tmp_path / "s"))
+        apply_fn = lambda params, x: x @ params["w"]  # noqa: E731
+        import jax.numpy as jnp
+
+        est = Estimator(apply_fn,
+                        feature_cols=["f1", "f2", "f3", "f4"],
+                        label_col="label", batch_size=8, epochs=1,
+                        store=store,
+                        initial_params={"w": jnp.zeros((4, 3))},
+                        loss=lambda out, b: ((out - 0.0) ** 2).mean())
+        est.fit(make_df(32))
+        with pytest.raises(FileNotFoundError, match="model"):
+            load_model(store)
+        m = load_model(store, model=apply_fn)
+        assert m.transform(make_df(4))["prediction"] is not None
+
+    def test_incomplete_run_skipped(self, tmp_path):
+        """A reserved-but-unfinished run must not shadow the completed
+        one, and run_1000 sorts after run_999 (numeric, not lexical)."""
+        from horovod_tpu.spark import load_model
+        from horovod_tpu.spark.store import Store
+
+        store = Store.create(str(tmp_path / "s"))
+        fitted = Estimator(Net(), feature_cols=["f1", "f2", "f3", "f4"],
+                           label_col="label", batch_size=8, epochs=1,
+                           store=store).fit(make_df(32))
+        # crashed/concurrent fit: reserved dir, no metadata
+        store.makedirs(store.get_run_path("run_002"))
+        m = load_model(store)
+        assert m.feature_cols == fitted.feature_cols
+        assert store.list_runs() == ["run_001", "run_002"]
+        assert store.list_runs(complete_only=True) == ["run_001"]
+        store.makedirs(store.get_run_path("run_999"))
+        store.makedirs(store.get_run_path("run_1000"))
+        assert store.list_runs()[-1] == "run_1000"
