@@ -16,6 +16,17 @@ Usage::
 import argparse
 import tempfile
 
+import flax.linen as nn
+
+
+class Net(nn.Module):
+    """Module-level so the store's model.pkl round trip works (locally
+    defined classes don't pickle; load_model would then need model=)."""
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(3)(nn.relu(nn.Dense(32)(x)))
+
 
 def build_frame(n=512, seed=0):
     import numpy as np
@@ -36,15 +47,9 @@ def train(store_path, platform=None):
 
         jax.config.update("jax_platforms", platform)
     import numpy as np
-    import flax.linen as nn
     import optax
 
     from horovod_tpu.spark import Estimator, Store
-
-    class Net(nn.Module):
-        @nn.compact
-        def __call__(self, x):
-            return nn.Dense(3)(nn.relu(nn.Dense(32)(x)))
 
     df = build_frame()
     est = Estimator(
@@ -62,6 +67,23 @@ def train(store_path, platform=None):
     out = model.transform(df)
     preds = np.stack(out["prediction"]).argmax(axis=1)
     acc = float((preds == df["label"].to_numpy()).mean())
+
+    # the save/load round trip: a fresh process reconstructs the fitted
+    # model straight from the store run (pickled architecture +
+    # checkpoint + schema metadata)
+    from horovod_tpu.spark import load_model
+
+    reloaded = load_model(store_path)
+    re_preds = np.stack(
+        reloaded.transform(df)["prediction"]).argmax(axis=1)
+    assert (re_preds == preds).all(), "loaded model diverged"
+
+    # prepare-once / fit-many: materialize the DataFrame into the store
+    # a single time, then any number of fits stream from the shards
+    prepared = Store.create(store_path).prepare_data(
+        df, [f"f{i}" for i in range(8)], "label",
+        validation_fraction=0.125, rows_per_group=64)
+    est.fit(prepared)
     return acc
 
 
