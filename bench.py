@@ -20,7 +20,8 @@ The transformer entry (870.9M params, 16L/2048d/16h, seq 1024, bf16,
 Pallas flash attention fwd+bwd) is the long-context flagship; the round-4
 model-shape scan (PERF_NOTES.md) found head_dim 128 — the MXU lane width
 — worth ~+13 MFU points over head_dim 64 at every size, and width >>
-depth, landing this config at 57.7% MFU / 113.8 TF/s on one v5e.
+depth, landing this config at 59.1% MFU / 116.4 TF/s (batch 6) on one
+v5e.
 """
 
 import argparse
@@ -218,7 +219,7 @@ def run_transformer(args, hvd):
     # whose 6·V·d logits share stands in for the lookup) + causal
     # attention ≈ 6·L·T·d (QKᵀ + AV, fwd 4·T·d + bwd 8·T·d, halved by
     # the causal mask).  PERF_NOTES.md's flagship table uses this same
-    # accounting (113.8 TF/s at 20,962 tok/s for 16L/2048d).
+    # accounting (116.4 TF/s at 21,443 tok/s for 16L/2048d, batch 6).
     flops_per_token = 6 * nparams + 6 * layers * seq * d_model
     peak = hw_peak_flops()
     tf_s = tokens_per_chip_sec * flops_per_token
@@ -266,7 +267,7 @@ def main():
     p.add_argument("--tf-d-model", type=int, default=2048)
     p.add_argument("--tf-heads", type=int, default=16)
     p.add_argument("--tf-seq-len", type=int, default=1024)
-    p.add_argument("--tf-batch-size", type=int, default=4,
+    p.add_argument("--tf-batch-size", type=int, default=6,
                    help="transformer per-chip batch size")
     p.add_argument("--tf-remat", action="store_true",
                    help="checkpoint each transformer block (recompute "
