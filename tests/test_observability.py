@@ -92,3 +92,15 @@ class TestStallInspector:
         si.stop()
         assert not warnings
         assert si.pending_ops() == {}
+
+
+def test_timeline_aggregate_seq_resets_with_world():
+    """The aggregation upload counter is SPMD-ordered like the HOST-plane
+    call counter: an elastic world resize must restart it in lock-step
+    so survivors' keys align with freshly-joined workers'."""
+    from horovod_tpu.ops import eager
+    from horovod_tpu.utils import timeline as tl
+
+    tl._aggregate_seq = 5
+    eager._reset_mesh_cache()
+    assert tl._aggregate_seq == 0
