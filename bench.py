@@ -20,8 +20,9 @@ The transformer entry (870.9M params, 16L/2048d/16h, seq 1024, bf16,
 Pallas flash attention fwd+bwd) is the long-context flagship; the round-4
 model-shape scan (PERF_NOTES.md) found head_dim 128 — the MXU lane width
 — worth ~+13 MFU points over head_dim 64 at every size, and width >>
-depth, landing this config at 59.1% MFU / 116.4 TF/s (batch 6) on one
-v5e.
+depth; 512-lane flash blocks then collapsed the online-softmax
+overhead, landing this config at 69.4% MFU / 136.8 model-TF/s
+(batch 6) on one v5e — level with the chip's measured matmul envelope.
 """
 
 import argparse
@@ -219,7 +220,7 @@ def run_transformer(args, hvd):
     # whose 6·V·d logits share stands in for the lookup) + causal
     # attention ≈ 6·L·T·d (QKᵀ + AV, fwd 4·T·d + bwd 8·T·d, halved by
     # the causal mask).  PERF_NOTES.md's flagship table uses this same
-    # accounting (116.4 TF/s at 21,443 tok/s for 16L/2048d, batch 6).
+    # accounting (136.8 TF/s at 25,209 tok/s for 16L/2048d, batch 6).
     flops_per_token = 6 * nparams + 6 * layers * seq * d_model
     peak = hw_peak_flops()
     tf_s = tokens_per_chip_sec * flops_per_token

@@ -315,7 +315,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False, scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 512, block_k: int = 512,
                     interpret: bool = False) -> jax.Array:
     """Blocked attention over ``(batch, seq, heads, head_dim)`` inputs.
 
@@ -330,10 +330,21 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     b, t, h, d = q.shape
     scale = d ** -0.5 if scale is None else scale
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
+
+    def fit_block(requested: int) -> Optional[int]:
+        """Largest power-of-two block ≤ requested that divides ``t`` —
+        a seq len that is a multiple of 128 but not of the (large)
+        default must shrink the block, not fall back to the dense
+        O(T²) path."""
+        for cand in (requested, 512, 256, 128, 64, 32, 16, 8):
+            if cand <= min(requested, t) and t % cand == 0:
+                return cand
+        return None
+
+    block_q = fit_block(block_q)
+    block_k = fit_block(block_k)
     usable = (interpret or _on_tpu()) and \
-        t % block_q == 0 and t % block_k == 0
+        block_q is not None and block_k is not None
     if not usable:
         return reference_attention(q, k, v, causal=causal, scale=scale)
 

@@ -147,3 +147,19 @@ class TestFlashAttentionBf16:
             np.testing.assert_allclose(
                 np.asarray(a, np.float32), np.asarray(b, np.float32),
                 rtol=0.1, atol=0.1, err_msg=f"d{name}")
+
+
+class TestBlockFitting:
+    """Seq lens that are multiples of 128 but not of the 512 default must
+    shrink the block and stay on the flash kernel, never fall back to
+    the dense O(T^2) path."""
+
+    @pytest.mark.parametrize("t", [640, 1280, 384])
+    def test_non_512_multiple_seq_uses_flash(self, t):
+        ks = jax.random.split(jax.random.PRNGKey(4), 3)
+        shape = (1, t, 1, 16)
+        q, k, v = (jax.random.normal(kk, shape, jnp.float32) for kk in ks)
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        expected = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   rtol=2e-5, atol=2e-5)
