@@ -236,7 +236,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="both",
                    choices=["both", "resnet", "transformer"])
-    p.add_argument("--batch-size", type=int, default=256,
+    p.add_argument("--batch-size", type=int, default=128,
                    help="ResNet per-chip batch size")
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--num-iters", type=int, default=5)
