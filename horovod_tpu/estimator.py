@@ -90,13 +90,23 @@ def _num_rows(df) -> int:
     return len(df)
 
 
+_localized_cache: dict = {}   # remote URL -> local copy (per process)
+
+
 def _localize_dataset(path: Optional[str]) -> Optional[str]:
     """Fetch a remote (fsspec URL) dataset directory to a local temp dir;
     local paths pass through.  RowGroupReader streams from local files,
-    so remote fits download once per process, then shard locally."""
+    so remote fits download once per process, then shard locally.
+    Downloads are cached per URL for the process lifetime (repeated fits
+    must not re-transfer or accumulate copies) and removed at exit."""
     if not path or "://" not in path or path.startswith("file://"):
         return path[len("file://"):] if path and \
             path.startswith("file://") else path
+    cached = _localized_cache.get(path)
+    if cached is not None and os.path.isdir(cached):
+        return cached
+    import atexit
+    import shutil
     import tempfile
 
     import fsspec
@@ -104,7 +114,31 @@ def _localize_dataset(path: Optional[str]) -> Optional[str]:
     fs, _ = fsspec.core.url_to_fs(path)
     local = tempfile.mkdtemp(prefix="hvd_dataset_")
     fs.get(path.rstrip("/") + "/", local + "/", recursive=True)
+    _localized_cache[path] = local
+    atexit.register(shutil.rmtree, local, ignore_errors=True)
     return local
+
+
+def _checkpointer_for(store, run_id: str):
+    """Checkpointer bound to a store run.  Remote stores stage locally
+    (the checkpoint writers are filesystem code); the staging dir is
+    uploaded by :func:`_sync_checkpoint_to_store` after training — a raw
+    remote URL handed to the local writer would silently land under
+    ``$CWD/<scheme>:/...``."""
+    import tempfile
+
+    from horovod_tpu import checkpoint as _checkpoint
+
+    remote = store.get_checkpoint_path(run_id)
+    if not getattr(store, "is_remote", False):
+        return _checkpoint.Checkpointer(remote), None
+    staging = tempfile.mkdtemp(prefix="hvd_ckpt_stage_")
+    return _checkpoint.Checkpointer(staging), (staging, remote)
+
+
+def _sync_checkpoint_to_store(store, staging) -> None:
+    if staging is not None:
+        store.upload_dir(staging[0], staging[1])
 
 
 def _wrap_apply(model):
@@ -165,8 +199,14 @@ def load_model(store, run_id: Optional[str] = None, model=None,
                 f"pass model= explicitly")
         model = pickle.loads(store.read(pkl))
     apply_fn = _wrap_apply(model)
-    state = Checkpointer(store.get_checkpoint_path(run_id)).restore(
-        None, step=step)
+    ckpt_path = store.get_checkpoint_path(run_id)
+    if getattr(store, "is_remote", False):
+        import tempfile
+
+        local = tempfile.mkdtemp(prefix="hvd_ckpt_fetch_")
+        store.download_dir(ckpt_path, local)
+        ckpt_path = local
+    state = Checkpointer(ckpt_path).restore(None, step=step)
     params = state["params"] if isinstance(state, dict) and \
         "params" in state else state
     return TpuModel(apply_fn, params, [sp.name for sp in feature_specs],
@@ -406,9 +446,9 @@ class Estimator(HasParams):
         params = hvd.broadcast_variables(params, root_rank=0)
         params, opt_state = step.init(params)
 
+        ckpt_staging = None
         if self._store is not None:
-            ckpt = hvd.checkpoint.Checkpointer(
-                self._store.get_checkpoint_path(run_id))
+            ckpt, ckpt_staging = _checkpointer_for(self._store, run_id)
         elif self._legacy_ckpt_dir:
             ckpt = hvd.checkpoint.Checkpointer(self._legacy_ckpt_dir)
         else:
@@ -450,6 +490,7 @@ class Estimator(HasParams):
                                   "opt_state": loop.opt_state})
         cbs.on_train_end(loop, logs)
         if self._store is not None and hvd.rank() == 0:
+            _sync_checkpoint_to_store(self._store, ckpt_staging)
             # intermediate parquet copies are derived data; the run's
             # artifacts (checkpoints, metadata, logs) are what persists.
             # Cleanup happens on success only — a failed fit leaves them
@@ -496,9 +537,12 @@ class Estimator(HasParams):
                     _slice_rows(df, slice(split, None)),
                     self._store.get_val_data_path(run_id), rows_per_group=rpg)
         hvd.barrier()     # readers must not open before the write lands
+        # remote stores: RowGroupReader streams local files only, so
+        # each process fetches the intermediates before reading
         model = self._fit_streaming(
-            self._store.get_train_data_path(run_id),
-            self._store.get_val_data_path(run_id) if n_val else None,
+            _localize_dataset(self._store.get_train_data_path(run_id)),
+            _localize_dataset(self._store.get_val_data_path(run_id))
+            if n_val else None,
             feature_specs, label_spec, hvd, run_id)
         hvd.barrier()     # every rank's readers are done
         if hvd.rank() == 0:
@@ -614,9 +658,9 @@ class Estimator(HasParams):
         params = hvd.broadcast_variables(params, root_rank=0)
         params, opt_state = step.init(params)
 
+        ckpt_staging = None
         if run_id is not None:
-            ckpt = hvd.checkpoint.Checkpointer(
-                self._store.get_checkpoint_path(run_id))
+            ckpt, ckpt_staging = _checkpointer_for(self._store, run_id)
         elif self._legacy_ckpt_dir:
             ckpt = hvd.checkpoint.Checkpointer(self._legacy_ckpt_dir)
         else:
@@ -650,6 +694,8 @@ class Estimator(HasParams):
                 ckpt.save(epoch, {"params": loop.params,
                                   "opt_state": loop.opt_state})
         cbs.on_train_end(loop, logs)
+        if self._store is not None and hvd.rank() == 0:
+            _sync_checkpoint_to_store(self._store, ckpt_staging)
         # no cleanup here: _fit_via_store owns the run-scoped intermediate
         # data and deletes it behind a barrier once every rank's readers
         # are done; fit_on_parquet reads user-owned parquet

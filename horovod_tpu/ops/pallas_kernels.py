@@ -332,12 +332,21 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     scale = d ** -0.5 if scale is None else scale
 
     def fit_block(requested: int) -> Optional[int]:
-        """Largest power-of-two block ≤ requested that divides ``t`` —
-        a seq len that is a multiple of 128 but not of the (large)
-        default must shrink the block, not fall back to the dense
-        O(T²) path."""
-        for cand in (requested, 512, 256, 128, 64, 32, 16, 8):
-            if cand <= min(requested, t) and t % cand == 0:
+        """Largest block ≤ requested that divides ``t`` — a seq len that
+        is a multiple of 128 but not of the (large) default must shrink
+        the block, not fall back to the dense O(T²) path.  Sequences
+        shorter than one tile run as a single block (small-shape tests
+        and probes); other non-128-multiples keep the dense fallback —
+        sub-tile blocks on real bf16 inputs are Mosaic-lowering risk."""
+        if t <= 128:
+            b = min(requested, t)
+            if t % b == 0:
+                return b
+            # ragged small seq: a single whole-sequence block if it
+            # tiles, else the dense fallback
+            return t if t % 8 == 0 else None
+        for cand in (requested, 512, 256, 128):
+            if cand <= t and t % cand == 0:
                 return cand
         return None
 

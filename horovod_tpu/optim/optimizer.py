@@ -30,6 +30,8 @@ from __future__ import annotations
 
 from typing import NamedTuple, Optional, Sequence, Union
 
+import os
+
 import jax
 import jax.numpy as jnp
 import optax
@@ -62,6 +64,17 @@ def _sparse_leaf_reduce(g: jax.Array, max_rows: int, op: ReduceOp,
     """
     rows = g.shape[0]
     mask = jnp.any(g.reshape(rows, -1) != 0, axis=1)
+    if os.environ.get("HOROVOD_DEBUG_SPARSE"):
+        # opt-in: surface silent gradient truncation (rows beyond the
+        # bound are dropped by design; misconfigured bounds degrade
+        # training with no other signal)
+        touched = jnp.sum(mask)
+        jax.lax.cond(
+            touched > max_rows,
+            lambda: jax.debug.print(
+                "sparse_params: {} touched rows exceed max_rows={}; "
+                "excess gradients dropped", touched, max_rows),
+            lambda: None)
     (idx,) = jnp.nonzero(mask, size=max_rows, fill_value=rows)
     vals = jnp.take(g, idx, axis=0, mode="fill", fill_value=0)
     vals = C._scale(vals, prescale_factor)

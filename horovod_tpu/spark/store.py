@@ -120,6 +120,8 @@ class FilesystemStore(Store):
     """Store over a (possibly network-mounted) filesystem (reference
     ``FilesystemStore``, ``store.py:148`` — same path layout)."""
 
+    is_remote = False
+
     def __init__(self, prefix_path: str,
                  train_path: Optional[str] = None,
                  val_path: Optional[str] = None,
@@ -238,14 +240,19 @@ class FilesystemStore(Store):
             val_path = self.get_val_data_path(idx)
             self.write_dataframe(df.iloc[split:][cols], val_path,
                                  rows_per_group=rpg)
-        schema = json.dumps({
-            "features": [sp.to_json() for sp in feature_specs],
-            "label": label_spec.to_json(),
-            "val_path": val_path,
-        }, indent=2).encode()
-        self.write(os.path.join(train_path, self.SCHEMA_FILE), schema)
+        def schema_json(role):
+            return json.dumps({
+                "features": [sp.to_json() for sp in feature_specs],
+                "label": label_spec.to_json(),
+                "val_path": val_path,
+                "role": role,
+            }, indent=2).encode()
+
+        self.write(os.path.join(train_path, self.SCHEMA_FILE),
+                   schema_json("train"))
         if val_path:
-            self.write(os.path.join(val_path, self.SCHEMA_FILE), schema)
+            self.write(os.path.join(val_path, self.SCHEMA_FILE),
+                       schema_json("val"))
         return PreparedData(train_path, val_path, feature_specs,
                             label_spec)
 
@@ -269,8 +276,13 @@ class FilesystemStore(Store):
                 return None
             with open(sidecar) as f:
                 raw = json.load(f)
+        # a val-side sidecar must not re-propagate its own dir as the
+        # validation split — fitting on it directly would train AND
+        # validate on the identical rows with no signal
+        val = raw.get("val_path") if raw.get("role", "train") == "train" \
+            else None
         return PreparedData(
-            path, raw.get("val_path"),
+            path, val,
             [ColSpec.from_json(d) for d in raw["features"]],
             ColSpec.from_json(raw["label"]))
 
@@ -377,7 +389,9 @@ class FilesystemStore(Store):
                            if str(p).endswith(".parquet")):
             with self._open(part, "rb") as f:
                 frames.append(pq.read_table(f).to_pandas())
-        df = pd.concat(frames, ignore_index=True) if frames else None
+        if not frames:
+            raise FileNotFoundError(f"no parquet files under {path}")
+        df = pd.concat(frames, ignore_index=True)
         meta_path = path.rstrip("/") + "/_meta.json"
         if df is not None and self.exists(meta_path):
             with self._open(meta_path, "r") as f:
@@ -547,6 +561,21 @@ class FsspecStore(FilesystemStore):
         self.makedirs(self.get_run_path(run_id))
         return run_id
 
+    is_remote = True
+
+    def download_dir(self, remote: str, local: str) -> None:
+        """Fetch a remote directory tree to a local path (checkpoint
+        restore staging)."""
+        self._fs.get(remote.rstrip("/") + "/", local.rstrip("/") + "/",
+                     recursive=True)
+
+    def upload_dir(self, local: str, remote: str) -> None:
+        """Push a local directory tree into the store (checkpoint
+        staging upload)."""
+        self._fs.makedirs(remote, exist_ok=True)
+        self._fs.put(local.rstrip("/") + "/", remote.rstrip("/") + "/",
+                     recursive=True)
+
     def _open(self, path: str, mode: str):
         return self._fs.open(path, mode)
 
@@ -563,7 +592,10 @@ class HDFSStore(FsspecStore):
 
     def __init__(self, prefix_path: str, **kwargs):
         if "://" not in prefix_path:
-            prefix_path = "hdfs://" + prefix_path.lstrip("/")
+            # bare path -> path on the default namenode; stripping the
+            # leading slash would make the first component the host
+            prefix_path = "hdfs://" + ("" if prefix_path.startswith("/")
+                                       else "/") + prefix_path
         if not prefix_path.startswith("hdfs://"):
             raise ValueError(
                 f"HDFSStore expects an hdfs:// path, got '{prefix_path}'"

@@ -136,15 +136,21 @@ class StallInspector:
                 peer_pending = set()
             missing = sorted(n for n in stalled_names
                              if n not in peer_pending)
-            if missing:
+            if not peer_pending:
+                # published an empty set: nothing pending on its side —
+                # it has not submitted the op (or cleared an earlier
+                # stall); calling it "stalled on different ops" would
+                # send the operator to debug a healthy rank
+                unreported.append(p)
+            elif missing:
                 diverged.append((p, missing))
             else:
                 costalled.append(p)
         parts = []
         if unreported:
             parts.append(
-                "process(es) %s have not submitted the op (no status "
-                "published — never reached it, or failed)"
+                "process(es) %s have not submitted the op (no pending "
+                "work published — not reached it yet, or failed)"
                 % ", ".join(map(str, unreported)))
         for p, missing in diverged:
             parts.append(
