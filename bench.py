@@ -232,10 +232,83 @@ def run_transformer(args, hvd):
     }
 
 
+def run_vit(args, hvd):
+    """Opt-in (--model vit) third benchmark family: ViT-B/16-class.
+
+    Not part of the default driver run; exists to bracket the ResNet
+    MFU question — ViT is vision like ResNet but matmul-dense like the
+    LM, so its MFU shows whether the vision gap is conv/BN-specific.
+    """
+    from horovod_tpu.models.vit import ViTConfig, VisionTransformer
+
+    n_chips = hvd.size()
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        batch, image, heads, dtype = 4, 32, 4, jnp.float32
+        cfg = ViTConfig(image_size=image, patch_size=16, num_layers=2,
+                        num_heads=heads, d_model=128, d_ff=512, dtype=dtype)
+    else:
+        batch, image, heads = \
+            args.vit_batch_size, args.image_size, args.vit_heads
+        cfg = ViTConfig(image_size=image, patch_size=16,
+                        num_heads=heads, dtype=jnp.bfloat16)
+    spc = args.steps_per_call if platform == "tpu" else 1
+    tokens = cfg.num_patches
+    log(f"bench[vit]: {n_chips} chip(s) on {platform}, "
+        f"{cfg.num_layers}L/{cfg.d_model}d/{heads}h "
+        f"(head_dim {cfg.d_model // heads}), {image}px -> {tokens} patches, "
+        f"batch {batch}/chip, steps_per_call {spc}")
+
+    model = VisionTransformer(cfg)
+
+    def loss_fn(params, batch):
+        logits = model.apply(params, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    step = hvd.DistributedTrainStep(
+        loss_fn, optax.adamw(3e-4),
+        steps_per_call=spc,
+        compiler_options=tpu_compiler_options(args))
+    x0 = jnp.zeros((1, image, image, 3), jnp.float32)
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0), x0)
+    nparams = sum(x.size for x in jax.tree_util.tree_leaves(variables))
+    params, opt_state = step.init(variables)
+
+    global_bs = batch * n_chips
+    rng = np.random.RandomState(0)
+    batch_data = step.shard_batch({
+        "x": jnp.asarray(rng.rand(global_bs, image, image, 3), jnp.float32),
+        "y": jnp.asarray(rng.randint(0, 1000, (global_bs,)), jnp.int32),
+    })
+
+    log(f"bench[vit]: {nparams / 1e6:.1f}M params")
+    per_chip = median_rate(
+        lambda s: step(s[0], s[1], batch_data), (params, opt_state, None),
+        args.num_warmup_batches, args.num_iters,
+        args.num_batches_per_iter,
+        global_bs * spc, "vit") / n_chips
+
+    # fwd+bwd FLOPs/img: every param matmul applies per patch token
+    # (6·P·T; the classifier head applies once per image — <1%
+    # over-count) plus bidirectional attention 12·L·T²·d.  Same 6·P
+    # accounting as the transformer entry, without the causal halving.
+    flops_per_img = (6 * nparams * tokens
+                     + 12 * cfg.num_layers * tokens ** 2 * cfg.d_model)
+    peak = hw_peak_flops()
+    tf_s = per_chip * flops_per_img
+    return {
+        "vit_img_sec_per_chip": round(per_chip, 1),
+        "vit_mfu": round(tf_s / peak, 4) if peak else None,
+        "vit_tflops_per_sec": round(tf_s / 1e12, 1),
+        "vit_params_m": round(nparams / 1e6, 1),
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="both",
-                   choices=["both", "resnet", "transformer"])
+                   choices=["both", "resnet", "transformer", "vit"])
     p.add_argument("--batch-size", type=int, default=128,
                    help="ResNet per-chip batch size")
     p.add_argument("--image-size", type=int, default=224)
@@ -275,6 +348,11 @@ def main():
                         "activations in backward)")
     p.add_argument("--tf-attention", default="flash",
                    choices=["dense", "flash"])
+    p.add_argument("--vit-batch-size", type=int, default=128,
+                   help="ViT per-chip batch size (--model vit only)")
+    p.add_argument("--vit-heads", type=int, default=12,
+                   help="ViT heads: 12 = standard ViT-B head_dim 64; "
+                        "6 = TPU-shaped head_dim 128 (MXU lane width)")
     args = p.parse_args()
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
@@ -287,6 +365,8 @@ def main():
         out.update(run_resnet(args, hvd))
     if args.model in ("both", "transformer"):
         out.update(run_transformer(args, hvd))
+    if args.model == "vit":
+        out.update(run_vit(args, hvd))
     print(json.dumps(out), flush=True)
 
 

@@ -119,12 +119,93 @@ def _localize_dataset(path: Optional[str]) -> Optional[str]:
     return local
 
 
+def _evict_localized(*paths: Optional[str]) -> None:
+    """Drop local copies of run-scoped remote paths.  Run-scoped
+    intermediates use distinct URLs per fit, so without eviction every
+    remote ``_fit_via_store`` fit leaves one more full dataset copy on
+    each worker until exit (the remote source is deleted after the fit
+    anyway, so the cache entry could never be reused)."""
+    import shutil
+
+    for path in paths:
+        local = _localized_cache.pop(path, None) if path else None
+        if local is not None:
+            shutil.rmtree(local, ignore_errors=True)
+
+
+class _SyncingCheckpointer:
+    """Checkpointer that mirrors its staging dir to the remote store
+    after every successful save — a crash mid-fit leaves the epochs
+    already trained in the store (the reference estimator persists
+    per-epoch), not zero checkpoints.
+
+    The mirror is incremental per file: only files new or changed since
+    the last sync are uploaded (not the whole retained-step set every
+    epoch), and files the local retention gc pruned are deleted
+    remotely, so the store honors ``max_to_keep`` instead of growing
+    with epoch count."""
+
+    def __init__(self, inner, store, staging: str, remote: str):
+        self._inner, self._store = inner, store
+        self._staging, self._remote = staging, remote
+        self._mirrored: dict = {}     # relpath -> (mtime_ns, size)
+
+    def save(self, step, state) -> bool:
+        wrote = self._inner.save(step, state)
+        if wrote:
+            try:
+                self.mirror()
+            except Exception as exc:
+                # a transient store blip must not abort the training
+                # loop; _mirrored only advances on a fully successful
+                # pass, so the next save (or the strict final sync)
+                # retries everything still pending
+                from horovod_tpu.utils import logging as hvd_logging
+
+                hvd_logging.warning(
+                    "checkpoint mirror to store failed (will retry on "
+                    "the next save / final sync): %s", exc)
+        return wrote
+
+    def mirror(self) -> None:
+        current = {}
+        for root, _dirs, files in os.walk(self._staging):
+            for fn in files:
+                full = os.path.join(root, fn)
+                st = os.stat(full)
+                current[os.path.relpath(full, self._staging)] = \
+                    (st.st_mtime_ns, st.st_size)
+        base = self._remote.rstrip("/")
+        # streamed per-file upload when the store offers it — reading
+        # a multi-GB state.pkl into a bytes object per epoch is a host
+        # OOM with large models
+        upload = getattr(self._store, "upload_file", None)
+        for rel, sig in current.items():
+            if self._mirrored.get(rel) != sig:
+                full = os.path.join(self._staging, rel)
+                dest = base + "/" + rel.replace(os.sep, "/")
+                if upload is not None:
+                    upload(full, dest)
+                else:
+                    with open(full, "rb") as f:
+                        self._store.write(dest, f.read())
+        for rel in set(self._mirrored) - set(current):
+            self._store.delete(base + "/" + rel.replace(os.sep, "/"))
+        self._mirrored = current
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
 def _checkpointer_for(store, run_id: str):
     """Checkpointer bound to a store run.  Remote stores stage locally
-    (the checkpoint writers are filesystem code); the staging dir is
-    uploaded by :func:`_sync_checkpoint_to_store` after training — a raw
-    remote URL handed to the local writer would silently land under
-    ``$CWD/<scheme>:/...``."""
+    (the checkpoint writers are filesystem code) and mirror to the store
+    per save via :class:`_SyncingCheckpointer` — a raw remote URL handed
+    to the local writer would silently land under ``$CWD/<scheme>:/...``.
+    Staging dirs (every rank creates one; only rank 0's gets writes) are
+    removed at process exit."""
+    import atexit
+    import shutil
     import tempfile
 
     from horovod_tpu import checkpoint as _checkpoint
@@ -133,12 +214,26 @@ def _checkpointer_for(store, run_id: str):
     if not getattr(store, "is_remote", False):
         return _checkpoint.Checkpointer(remote), None
     staging = tempfile.mkdtemp(prefix="hvd_ckpt_stage_")
-    return _checkpoint.Checkpointer(staging), (staging, remote)
+    atexit.register(shutil.rmtree, staging, ignore_errors=True)
+    ckpt = _SyncingCheckpointer(
+        _checkpoint.Checkpointer(staging), store, staging, remote)
+    return ckpt, staging
 
 
-def _sync_checkpoint_to_store(store, staging) -> None:
-    if staging is not None:
-        store.upload_dir(staging[0], staging[1])
+def _sync_checkpoint_to_store(store, staging, ckpt) -> None:
+    """Final strict mirror of the staging dir (incremental — a fit
+    whose last save already mirrored uploads nothing; unlike the
+    per-save mirror this one propagates store errors: a fit must not
+    report success while the store silently lacks its checkpoints).
+    The staging copy is then dropped — it is redundant once mirrored,
+    and a long-lived driver otherwise accumulates one staging dir of
+    full checkpoints per fit."""
+    import shutil
+
+    if staging is None:
+        return
+    ckpt.mirror()
+    shutil.rmtree(staging, ignore_errors=True)
 
 
 def _wrap_apply(model):
@@ -490,7 +585,7 @@ class Estimator(HasParams):
                                   "opt_state": loop.opt_state})
         cbs.on_train_end(loop, logs)
         if self._store is not None and hvd.rank() == 0:
-            _sync_checkpoint_to_store(self._store, ckpt_staging)
+            _sync_checkpoint_to_store(self._store, ckpt_staging, ckpt)
             # intermediate parquet copies are derived data; the run's
             # artifacts (checkpoints, metadata, logs) are what persists.
             # Cleanup happens on success only — a failed fit leaves them
@@ -545,6 +640,11 @@ class Estimator(HasParams):
             if n_val else None,
             feature_specs, label_spec, hvd, run_id)
         hvd.barrier()     # every rank's readers are done
+        # the localized copies are run-scoped (their source is deleted
+        # below) — evict so repeated fits don't accumulate one dataset
+        # copy per fit per worker
+        _evict_localized(self._store.get_train_data_path(run_id),
+                         self._store.get_val_data_path(run_id))
         if hvd.rank() == 0:
             # success: drop the run-scoped intermediate copies (a failed
             # fit leaves them for debugging); persistent prepared data is
@@ -695,7 +795,7 @@ class Estimator(HasParams):
                                   "opt_state": loop.opt_state})
         cbs.on_train_end(loop, logs)
         if self._store is not None and hvd.rank() == 0:
-            _sync_checkpoint_to_store(self._store, ckpt_staging)
+            _sync_checkpoint_to_store(self._store, ckpt_staging, ckpt)
         # no cleanup here: _fit_via_store owns the run-scoped intermediate
         # data and deletes it behind a barrier once every rank's readers
         # are done; fit_on_parquet reads user-owned parquet

@@ -306,6 +306,12 @@ def DistributedAdasumOptimizer(optimizer: optax.GradientTransformation,
 
     Hierarchical dispatch over the (dcn, ici) mesh averages deltas within
     ici and Adasums across dcn (``adasum_gpu_operations.cc:38``).
+
+    Note the state semantics this implies: because momenta evolve from
+    *local* gradients, optimizer state is per-rank, not replicated.
+    Host reads and checkpoints capture rank 0's (device 0's) state — the
+    reference's rank-0-checkpoint convention — and restore follows the
+    broadcast-restore pattern (every rank resumes from rank 0's state).
     """
     del named_parameters  # JAX pytrees carry structure; parity-only arg
     chained = optax.chain(

@@ -220,6 +220,16 @@ class DistributedTrainStep:
                 loss = C.allreduce(loss, op=Average, axis=axes)
                 return params, opt_state, loss
 
+            # out_specs=P() with check_vma=False: params come out
+            # genuinely replicated (the reducer or the delta-form
+            # optimizer chain makes every shard's update identical), but
+            # with op=None the *optimizer state* (e.g. Adasum-wrapped
+            # momenta) is per-rank by construction.  Host reads and
+            # checkpoints of that state then capture device 0's copy —
+            # deliberately matching the reference's rank-0-checkpoint
+            # semantics (save on rank 0, broadcast on restore); a
+            # reshard of a restored checkpoint replicates rank 0's
+            # momenta, which is exactly what broadcast-restore does.
             smapped = shard_map(
                 per_device, mesh=self._mesh,
                 in_specs=(P(), P(), P(self._data_axes)),

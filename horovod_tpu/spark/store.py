@@ -116,6 +116,16 @@ class Store:
         return LocalStore(prefix_path, *args, **kwargs)
 
 
+def _run_no(name: str) -> int:
+    """Numeric part of a run id — ``run_007`` and the remote
+    uuid-suffixed ``run_007_3fa2b1c4`` both parse to 7; -1 if not a run
+    id."""
+    try:
+        return int(name[4:].split("_", 1)[0])
+    except (ValueError, IndexError):
+        return -1
+
+
 class FilesystemStore(Store):
     """Store over a (possibly network-mounted) filesystem (reference
     ``FilesystemStore``, ``store.py:148`` — same path layout)."""
@@ -289,25 +299,20 @@ class FilesystemStore(Store):
 
     def list_runs(self, complete_only: bool = False) -> list:
         """Run ids under the runs dir, newest last (numeric sort — ids
-        grow past the zero padding after run_999).  ``complete_only``
-        keeps only runs whose metadata landed: ``new_run_id`` reserves
-        the directory before any artifact exists, so an in-progress or
-        crashed fit otherwise shows up as the "newest" run."""
+        grow past the zero padding after run_999; remote uuid-suffixed
+        ids ``run_NNN_xxxxxxxx`` order by NNN, ties lexically).
+        ``complete_only`` keeps only runs whose metadata landed:
+        ``new_run_id`` reserves the directory before any artifact
+        exists, so an in-progress or crashed fit otherwise shows up as
+        the "newest" run."""
         try:
             entries = self._listdir(self._runs_path)
         except (FileNotFoundError, NotADirectoryError, OSError):
             return []
         names = [str(e).rstrip("/").rsplit("/", 1)[-1] for e in entries]
-
-        def run_no(n):
-            try:
-                return int(n[4:])
-            except ValueError:
-                return -1
-
         runs = sorted((n for n in names
-                       if n.startswith("run_") and run_no(n) >= 0),
-                      key=run_no)
+                       if n.startswith("run_") and _run_no(n) >= 0),
+                      key=lambda n: (_run_no(n), n))
         if complete_only:
             runs = [r for r in runs if self.exists(
                 os.path.join(self.get_run_path(r), "metadata.json"))]
@@ -321,7 +326,7 @@ class FilesystemStore(Store):
         while True:
             existing = [d for d in os.listdir(self._runs_path)
                         if d.startswith("run_")]
-            nums = [int(d[4:]) for d in existing if d[4:].isdigit()]
+            nums = [v for v in map(_run_no, existing) if v >= 0]
             rid = f"run_{(max(nums) + 1) if nums else 1:03d}"
             try:
                 os.mkdir(os.path.join(self._runs_path, rid))
@@ -542,22 +547,27 @@ class FsspecStore(FilesystemStore):
             return False
 
     def new_run_id(self) -> str:
-        """Next free ``run_NNN``.  Object stores lack an atomic mkdir;
-        the reservation marker narrows, not closes, the race — same
-        contract as the reference HDFSStore (no atomic namenode
-        reservation either)."""
+        """Next run id, ``run_NNN_<uuid8>``.  Object stores lack an
+        atomic mkdir, so the number alone cannot be a reservation — any
+        write-then-list protocol leaves a window where two drivers both
+        claim one id.  Remote run ids therefore embed a uuid: two
+        drivers sharing a store prefix may both pick the next *number*,
+        but their run directories are distinct and artifacts never
+        interleave.  ``list_runs`` orders by the numeric part (ties —
+        concurrent claims — lexically by suffix)."""
+        import uuid
+
         self._fs.makedirs(self._runs_path, exist_ok=True)
+        self._fs.invalidate_cache(self._runs_path)
         try:
             existing = [str(d).rstrip("/").rsplit("/", 1)[-1]
                         for d in self._fs.ls(self._runs_path,
                                              detail=False)]
         except FileNotFoundError:
             existing = []
-        taken = {d for d in existing if d.startswith("run_")}
-        n = 1
-        while f"run_{n:03d}" in taken:
-            n += 1
-        run_id = f"run_{n:03d}"
+        nums = [_run_no(d) for d in existing if d.startswith("run_")]
+        n = max((v for v in nums if v >= 0), default=0) + 1
+        run_id = f"run_{n:03d}_{uuid.uuid4().hex[:8]}"
         self.makedirs(self.get_run_path(run_id))
         return run_id
 
@@ -568,6 +578,13 @@ class FsspecStore(FilesystemStore):
         restore staging)."""
         self._fs.get(remote.rstrip("/") + "/", local.rstrip("/") + "/",
                      recursive=True)
+
+    def upload_file(self, local: str, remote: str) -> None:
+        """Streamed single-file upload — ``put_file`` transfers in
+        chunks, so multi-GB checkpoint files never materialize as one
+        host bytes object (the incremental estimator mirror's path)."""
+        self._fs.makedirs(remote.rsplit("/", 1)[0], exist_ok=True)
+        self._fs.put_file(local, remote)
 
     def upload_dir(self, local: str, remote: str) -> None:
         """Push a local directory tree into the store (checkpoint

@@ -186,10 +186,14 @@ def aggregate_after_close(filename: str, wall_origin_us) -> None:
                         "events": events}).encode())
         return
     blobs = [(0, wall_origin_us, _load_events(filename))]
+    # One shared deadline across all peers: shutdown with k crashed
+    # peers must cost at most ~30s total, not k*30s sequentially.
+    deadline = time.monotonic() + 30.0
     for p in range(1, nproc):
         key = f"hvdtl/{seq}/{p}"
         try:
-            raw = client.blocking_key_value_get_bytes(key, 30_000)
+            timeout_ms = max(1, int((deadline - time.monotonic()) * 1e3))
+            raw = client.blocking_key_value_get_bytes(key, timeout_ms)
             payload = json.loads(raw)
             blobs.append((p, payload["origin"], payload["events"]))
             client.key_value_delete(key)

@@ -497,14 +497,69 @@ class TestFsspecStore:
 
         assert isinstance(self._store(), FsspecStore)
 
+    def test_syncing_checkpointer_incremental_mirror(self, tmp_path):
+        """Per-save mirroring is incremental: each sync uploads only
+        new/changed files (not the whole retained set every epoch) and
+        deletes remotely what the local retention gc pruned — the store
+        honors max_to_keep instead of growing with epoch count."""
+        from horovod_tpu.estimator import _SyncingCheckpointer
+
+        class RecordingStore:
+            def __init__(self):
+                self.files: dict = {}
+                self.writes: list = []
+
+            def write(self, path, data):
+                self.files[path] = data
+                self.writes.append(path)
+
+            def delete(self, path):
+                self.files.pop(path, None)
+
+        class NullInner:
+            def save(self, step, state):
+                return True
+
+        store = RecordingStore()
+        staging = tmp_path / "stage"
+        staging.mkdir()
+        sync = _SyncingCheckpointer(NullInner(), store, str(staging),
+                                    "memory://b/ckpt")
+        (staging / "step_0").mkdir()
+        (staging / "step_0" / "state.pkl").write_bytes(b"s0")
+        sync.mirror()
+        assert store.writes == ["memory://b/ckpt/step_0/state.pkl"]
+        # second save: only the new step uploads, step_0 is not re-sent
+        (staging / "step_1").mkdir()
+        (staging / "step_1" / "state.pkl").write_bytes(b"s1")
+        sync.mirror()
+        assert store.writes[1:] == ["memory://b/ckpt/step_1/state.pkl"]
+        # local gc pruned step_0 -> remote follows the retention
+        import shutil
+
+        shutil.rmtree(staging / "step_0")
+        sync.mirror()
+        assert set(store.files) == {"memory://b/ckpt/step_1/state.pkl"}
+        # idempotent final sync: nothing changed, nothing uploaded
+        n = len(store.writes)
+        sync.mirror()
+        assert len(store.writes) == n
+
     def test_run_artifact_layout(self):
         from horovod_tpu.spark.store import (ColSpec, load_metadata,
                                              save_metadata)
 
+        import re
+
         store = self._store()
         run_id = store.new_run_id()
-        assert run_id == "run_001"
-        assert store.new_run_id() == "run_002"   # reservation visible
+        # remote ids embed a uuid — object stores lack atomic mkdir, so
+        # the number alone can't be a reservation; distinct suffixes
+        # make concurrent drivers' runs distinct instead
+        assert re.fullmatch(r"run_001_[0-9a-f]{8}", run_id), run_id
+        second = store.new_run_id()
+        assert re.fullmatch(r"run_002_[0-9a-f]{8}", second), second
+        assert store.list_runs() == [run_id, second]   # numeric order
         store.makedirs(store.get_logs_path(run_id))
         save_metadata(store, run_id,
                       [ColSpec("f1", "float32", ())],
@@ -688,7 +743,7 @@ class TestModelLoadRoundTrip:
                            label_col="label", batch_size=8, epochs=1,
                            store=store, rows_per_group=8).fit(df)
         # checkpoint artifacts live in the STORE, not a bogus local dir
-        ckpt = store.get_checkpoint_path("run_001")
+        ckpt = store.get_checkpoint_path(store.list_runs()[-1])
         assert store.exists(ckpt), ckpt
         assert not os.path.exists(os.path.join(os.getcwd(), "memory:")), \
             "checkpoint leaked to a literal local 'memory:/...' path"
