@@ -344,6 +344,15 @@ class DistributedTrainStep:
 
         return jax.tree_util.tree_map(to_global, batch)
 
+    def compiled_text(self, params, opt_state, batch) -> str:
+        """Optimized-HLO dump of the step for these arguments — the
+        artifact the collective-fusion guard tests and the
+        ``docs/scaling.md`` bytes-on-wire model inspect (see
+        :mod:`horovod_tpu.utils.hlo`).  Uses the same compile options
+        as execution."""
+        return self._step.lower(params, opt_state, batch).compile(
+            compiler_options=self._compiler_options).as_text()
+
     def __call__(self, params, opt_state, batch):
         if self._compiler_options is None:
             return self._step(params, opt_state, batch)

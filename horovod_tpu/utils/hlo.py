@@ -1,0 +1,138 @@
+"""Compiled-HLO collective inspection.
+
+The reference's gradient fusion is *runtime*-observable: the controller
+merges pending tensors into one fused buffer per cycle
+(``controller.cc:686 FuseResponses``, fusion-buffer threshold
+``HOROVOD_FUSION_THRESHOLD``).  Here fusion happens at *compile* time —
+autodiff inserts one psum per gradient leaf and XLA's all-reduce
+combiner merges them into one grouped collective — so the observable
+artifact is the optimized HLO module.  This module parses collectives
+out of compiled HLO text so tests can guard the fusion invariant (a
+regression that silently de-fuses into per-leaf collectives would pass
+every numerics test and only show up as wire overhead on a real pod)
+and so the scaling model can count bytes on the wire per step
+(``docs/scaling.md``).
+
+Usage::
+
+    txt = step.compiled_text(params, opt_state, batch)
+    ops = collective_ops(txt)
+    [o for o in ops if o.kind == "all-reduce"]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List, Optional, Tuple
+
+# HLO primitive byte widths (token/opaque excluded — they never carry
+# payload).
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+_KINDS = ("all-reduce", "reduce-scatter", "all-gather", "all-to-all",
+          "collective-permute", "collective-broadcast")
+
+# one result tensor: dtype[dims]{layout} — layout block optional
+_SHAPE_RE = re.compile(r"([a-z]\w*)\[([\d,]*)\](?:\{[^}]*\})?")
+# op-definition line: "%name = <result-type> <kind>[-start](operands...)"
+_OP_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.+?)\s+("
+    + "|".join(_KINDS) + r")(-start)?\(")
+
+
+@dataclasses.dataclass
+class CollectiveOp:
+    """One collective in an optimized HLO module."""
+
+    kind: str                      # e.g. "all-reduce"
+    shapes: List[Tuple[str, Tuple[int, ...]]]   # (dtype, dims) per operand
+    bytes: int                     # payload bytes (sum over operands)
+    replica_groups: Optional[str]  # raw attribute text, None if absent
+    group_size: Optional[int]      # devices per group, None if unknown
+    line: str                      # the full HLO line (diagnostics)
+
+    @property
+    def dtypes(self) -> set:
+        return {d for d, _ in self.shapes}
+
+
+def _parse_shapes(result_type: str) -> List[Tuple[str, Tuple[int, ...]]]:
+    shapes = []
+    for dt, dims in _SHAPE_RE.findall(result_type):
+        if dt not in _DTYPE_BYTES:
+            continue                    # token/opaque/etc
+        shape = tuple(int(d) for d in dims.split(",") if d) \
+            if dims else ()
+        shapes.append((dt, shape))
+    return shapes
+
+
+def _nbytes(shapes) -> int:
+    total = 0
+    for dt, dims in shapes:
+        n = 1
+        for d in dims:
+            n *= d
+        total += n * _DTYPE_BYTES[dt]
+    return total
+
+
+def _replica_groups(line: str):
+    """Return (raw_attr, group_size) from either the explicit
+    ``{{0,1},{2,3}}`` form or the iota ``[2,4]<=[8]`` form."""
+    m = re.search(r"replica_groups=(\{\{[^=]*?\}\}|\{\}|\[[\d,]+\]<=\[[\d,]+\](?:T\([\d,]+\))?)",
+                  line)
+    if not m:
+        return None, None
+    raw = m.group(1)
+    if raw.startswith("{{"):
+        first = raw[2:].split("}", 1)[0]
+        return raw, len([x for x in first.split(",") if x.strip() != ""])
+    if raw == "{}":
+        return raw, None
+    dims = raw[1:].split("]", 1)[0]     # iota: [G,S]<=[N] — S per group
+    parts = [int(x) for x in dims.split(",")]
+    return raw, parts[-1]
+
+
+def collective_ops(hlo_text: str) -> List[CollectiveOp]:
+    """All collective ops in an (optimized) HLO module dump.
+
+    Async pairs (``all-reduce-start``/``-done``) count once, under the
+    start op.  Shapes come from the op's *result* type — for
+    ``all-reduce`` the result equals the reduced payload; for
+    ``all-gather`` it is the gathered (output) size; for
+    ``reduce-scatter`` the scattered (per-shard output) size.
+    """
+    ops = []
+    for line in hlo_text.splitlines():
+        m = _OP_RE.match(line)
+        if m is None:
+            continue
+        result_type, kind, is_async = m.group(1), m.group(2), m.group(3)
+        shapes = _parse_shapes(result_type)
+        # async starts of gather/permute carry `(input, output, ...)`
+        # tuples (plus scalar context values on TPU); the payload is the
+        # output alone — summing the whole tuple double-counts
+        if is_async and kind in ("all-gather", "collective-permute") \
+                and len(shapes) >= 2:
+            shapes = [shapes[1]]
+        raw, gsize = _replica_groups(line)
+        ops.append(CollectiveOp(kind=kind, shapes=shapes,
+                                bytes=_nbytes(shapes),
+                                replica_groups=raw, group_size=gsize,
+                                line=line.strip()))
+    return ops
+
+
+def count_by_kind(ops: List[CollectiveOp]) -> dict:
+    out: dict = {}
+    for o in ops:
+        out[o.kind] = out.get(o.kind, 0) + 1
+    return out

@@ -1,0 +1,181 @@
+"""Compiled-collective fusion guards.
+
+The reference's fusion is runtime-observable (``controller.cc:686
+FuseResponses`` merges pending tensors into one fused buffer per
+negotiation cycle); here fusion is a *compile-time* artifact — autodiff
+inserts one psum per gradient leaf and XLA's combiner merges them — so
+these tests lower the real train step on the 8-device mesh and assert
+on the optimized HLO module.  A regression that silently de-fused into
+per-leaf collectives would pass every numerics test and the dryrun, and
+only show up as wire overhead on a real pod; these guards fail instead.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.utils import hlo as H
+
+
+class Net(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(256)(x)
+        x = nn.relu(x)
+        x = nn.Dense(256)(x)
+        return nn.Dense(10)(x)
+
+
+def _loss_fn(model):
+    def loss_fn(params, batch):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            model.apply(params, batch["x"]), batch["y"]).mean()
+    return loss_fn
+
+
+def _grad_bytes(params) -> int:
+    return sum(x.size * x.dtype.itemsize
+               for x in jax.tree_util.tree_leaves(params))
+
+
+@pytest.fixture
+def net_setup(hvd_runtime):
+    hvd = hvd_runtime
+    model = Net()
+    init = jax.jit(model.init)(jax.random.PRNGKey(0), jnp.zeros((1, 64)))
+    batch = {"x": jnp.zeros((16, 64), jnp.float32),
+             "y": jnp.zeros((16,), jnp.int32)}
+    return hvd, model, init, batch
+
+
+class TestTrainStepFusion:
+    def test_pjit_step_has_one_grouped_allreduce(self, net_setup):
+        """The whole gradient pytree (6 leaves) + the scalar loss reduce
+        in EXACTLY one combined all-reduce over all 8 devices — the
+        compiled equivalent of the reference's fused-buffer cycle."""
+        hvd, model, init, bdata = net_setup
+        step = hvd.DistributedTrainStep(_loss_fn(model), optax.adamw(1e-3))
+        params, opt = step.init(init)
+        batch = step.shard_batch(bdata)
+        ops = H.collective_ops(step.compiled_text(params, opt, batch))
+        assert H.count_by_kind(ops) == {"all-reduce": 1}, \
+            [o.line for o in ops]
+        (ar,) = ops
+        # payload = every gradient leaf + the 4-byte scalar loss; a
+        # de-fusion regression changes the op count, a lost leaf the sum
+        assert ar.bytes == _grad_bytes(init) + 4
+        assert ar.group_size == 8      # one group spanning (dcn, ici)
+
+    def test_shard_map_step_has_one_grouped_allreduce(self, net_setup):
+        """The explicit path (grouped_allreduce under shard_map) also
+        lowers to one combined all-reduce — grouping survives the whole
+        pipeline, not just GSPMD's combiner."""
+        hvd, model, init, bdata = net_setup
+        step = hvd.DistributedTrainStep(_loss_fn(model), optax.adamw(1e-3),
+                                        mode="shard_map")
+        params, opt = step.init(init)
+        batch = step.shard_batch(bdata)
+        ops = H.collective_ops(step.compiled_text(params, opt, batch))
+        assert H.count_by_kind(ops) == {"all-reduce": 1}, \
+            [o.line for o in ops]
+
+    def test_scanned_step_keeps_fusion(self, net_setup):
+        """steps_per_call>1 wraps the step in lax.scan; the loop body
+        must still contain exactly one combined all-reduce (the scan
+        must not unroll into per-step de-fused collectives)."""
+        hvd, model, init, bdata = net_setup
+        step = hvd.DistributedTrainStep(_loss_fn(model), optax.adamw(1e-3),
+                                        steps_per_call=4)
+        params, opt = step.init(init)
+        batch = step.shard_batch(bdata)
+        ops = H.collective_ops(step.compiled_text(params, opt, batch))
+        assert H.count_by_kind(ops) == {"all-reduce": 1}, \
+            [o.line for o in ops]
+
+    def test_fsdp_step_shards_the_reduction(self, net_setup):
+        """fsdp_axis: parameters are gathered on use (all-gather ops
+        present) and gradient reduction is sharded — there must be NO
+        full-payload all-reduce spanning all 8 devices.  (On TPU the
+        sharded reduction lowers to reduce-scatter; the CPU backend
+        decomposes it, so the guard pins the invariants that hold on
+        both: gathers exist, and the only global-group all-reduces are
+        scalar-sized.)"""
+        hvd, model, init, bdata = net_setup
+        step = hvd.DistributedTrainStep(_loss_fn(model), optax.adamw(1e-3),
+                                        fsdp_axis="ici",
+                                        fsdp_min_weight_size=1024)
+        params, opt = step.init(init)
+        batch = step.shard_batch(bdata)
+        ops = H.collective_ops(step.compiled_text(params, opt, batch))
+        kinds = H.count_by_kind(ops)
+        assert kinds.get("all-gather", 0) >= 1 or \
+            kinds.get("reduce-scatter", 0) >= 1, kinds
+        full = _grad_bytes(init)
+        # group_size None covers replica_groups={} — HLO's spelling of
+        # "all devices, one group" — so a global all-reduce can't evade
+        # the guard by that form
+        global_ars = [o for o in ops
+                      if o.kind == "all-reduce" and
+                      o.group_size in (8, None)]
+        assert all(o.bytes < full for o in global_ars), \
+            [(o.bytes, o.line) for o in global_ars]
+
+
+class TestGroupedAllreduceFusion:
+    def test_grouped_mixed_dtypes_one_collective(self, hvd_runtime):
+        """grouped_allreduce with mixed f32/bf16 leaves lowers to ONE
+        all-reduce (bf16 rides the fp32-widened concat buffer) — the
+        one-collective-per-cycle contract of the fusion buffer."""
+        from horovod_tpu.ops import collectives as C
+        from horovod_tpu.runtime import state as S
+
+        mesh = S.global_state().mesh
+        leaves = [jnp.zeros((128,), jnp.float32),
+                  jnp.zeros((64,), jnp.bfloat16),
+                  jnp.zeros((32, 4), jnp.float32)]
+
+        def f(*ls):
+            return tuple(C.grouped_allreduce(list(ls), op=C.Sum,
+                                             axis=("dcn", "ici")))
+
+        sm = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(),) * 3, out_specs=(P(),) * 3,
+            check_vma=False))
+        ops = H.collective_ops(sm.lower(*leaves).compile().as_text())
+        assert H.count_by_kind(ops) == {"all-reduce": 1}, \
+            [o.line for o in ops]
+
+
+class TestHloParser:
+    def test_parses_tuple_allreduce(self):
+        line = ("  %all-reduce.7 = (f32[256]{0}, bf16[256,64]{1,0}, f32[]) "
+                "all-reduce(%a, %b, %c), channel_id=1, "
+                "replica_groups=[1,8]<=[8], to_apply=%add")
+        (op,) = H.collective_ops(line)
+        assert op.kind == "all-reduce"
+        assert op.shapes == [("f32", (256,)), ("bf16", (256, 64)),
+                             ("f32", ())]
+        assert op.bytes == 256 * 4 + 256 * 64 * 2 + 4
+        assert op.group_size == 8
+
+    def test_parses_explicit_groups_and_async(self):
+        # TPU async form: result is an (input, output) tuple — payload
+        # must count the gathered output only, not input+output
+        text = "\n".join([
+            "  %ag = (f32[8,128]{1,0}, f32[64,128]{1,0}) "
+            "all-gather-start(%x), "
+            "replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={0}",
+            "  %done = f32[64,128]{1,0} all-gather-done(%ag)",
+        ])
+        ops = H.collective_ops(text)
+        assert len(ops) == 1          # start/done pair counts once
+        assert ops[0].kind == "all-gather"
+        assert ops[0].group_size == 4
+        assert ops[0].bytes == 64 * 128 * 4
+
+    def test_ignores_non_collective_lines(self):
+        text = "  %dot.5 = f32[256,256]{1,0} dot(%a, %b)"
+        assert H.collective_ops(text) == []
