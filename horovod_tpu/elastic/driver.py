@@ -175,7 +175,12 @@ class ElasticDriver:
         self._worker_fn_takes_abort = nparams >= 4
         self._service.start()
         self._discovery_thread.start()
-        self.wait_for_available_slots(self._min_np)
+        # wait for the REQUESTED world, not the minimum (reference
+        # ``driver.start`` → ``wait_for_available_slots(np)``): with racy
+        # discovery (e.g. executor-pool registration) waiting only for
+        # min_np starts a world of whichever slots registered first and a
+        # fast job can finish before the rest ever join
+        self.wait_for_available_slots(max(np, self._min_np))
         with self._lock:
             self._update_host_assignments()
         self._spawn_all()

@@ -1,0 +1,426 @@
+"""Elastic training on an executor pool (Spark or the local pool).
+
+Reference: ``horovod/spark/runner.py:303 run_elastic`` — a Spark job
+whose tasks become *potential* Horovod slots, driven by the elastic
+driver through task-service RPC instead of ssh
+(``runner/gloo_run.py:274 launch_gloo_elastic`` provides the driver
+machinery; ``spark/driver/driver_service.py`` the task registry).
+
+Same composition here, over the pieces this repo already ships:
+
+* the task-service RPC plane of :mod:`horovod_tpu.spark.runner`
+  (``RegisterTask`` / ``RunFunction`` / ``ShutdownTask`` messages over
+  the HMAC ``BasicService``), with executor tasks extended to serve a
+  *sequence* of run commands (one per elastic spawn) instead of one;
+* :class:`horovod_tpu.elastic.driver.ElasticDriver` — discovery loop,
+  rank-stable reassignment, blacklisting, per-generation
+  ``jax.distributed`` coordinators — with ``create_worker_fn`` sending
+  ``RunFunction`` to an idle executor task rather than exec'ing ssh;
+* liveness by RPC ping: a task whose service stops answering is a dead
+  executor — its "host" leaves discovery (world shrinks, survivors get
+  ``HostsUpdatedInterrupt``) and any worker it was running is recorded
+  as failed.
+
+Each executor task is its own elastic *host* (identity
+``<hosthash>[<task index>]``): the executor process is the unit that
+owns devices, fails, and gets blacklisted — matching Spark deployments
+where executors are per-container.  Consequence: a task that ran a
+*failed* worker is blacklisted with its host and never reused; a task
+whose worker retired cleanly (scale-down) can serve a later spawn.
+
+Works with or without pyspark: ``run_elastic`` picks the active
+``SparkContext`` when present and otherwise degrades to
+:class:`~horovod_tpu.spark.local_executor.LocalSparkContext`, exactly
+like ``horovod_tpu.spark.run``.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import threading
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from horovod_tpu.elastic.discovery import HostDiscovery
+from horovod_tpu.utils import logging as hvd_logging
+
+#: env key carrying the per-spawn id inside RunFunction.env
+_RUN_ID_ENV = "HOROVOD_SPARK_ELASTIC_RUN_ID"
+_PING_TIMEOUT_S = 2.0
+#: one missed ping must not blacklist a healthy executor (a user fn
+#: holding the GIL can starve the service thread past one timeout)
+_PING_ATTEMPTS = 3
+_PING_RETRY_DELAY_S = 0.3
+
+
+class PingTask:
+    """Driver → task: liveness probe (answered by the service thread even
+    while the task's fn is computing)."""
+
+
+class ElasticTaskResult:
+    """Executor → driver: one spawn's return value (or ``_TaskError``)."""
+
+    def __init__(self, index: int, run_id: str, value: Any):
+        self.index, self.run_id, self.value = index, run_id, value
+
+
+def _elastic_task_fn(driver_addr, key: str, payload: bytes) -> Callable:
+    """Partition function for elastic pools: register, then serve run
+    commands until shutdown (the static task fn serves exactly one).
+    Idle tasks wait indefinitely — a spare slot in a max_np pool is
+    growth capacity, not a timeout candidate; the driver reaps the pool
+    with ``ShutdownTask`` (and local pool processes are daemonic)."""
+
+    def _task(index: int, _iterator):
+        import cloudpickle
+
+        from horovod_tpu.runner.network import (
+            AckResponse,
+            BasicClient,
+            BasicService,
+        )
+        from horovod_tpu.spark import elastic as _e
+        from horovod_tpu.spark import runner as _r
+
+        cmds: queue.Queue = queue.Queue()
+
+        def handle(req):
+            if isinstance(req, _r.RunFunction):
+                cmds.put(req)
+                return AckResponse()
+            if isinstance(req, _e.PingTask):
+                return AckResponse()
+            if isinstance(req, _r.ShutdownTask):
+                cmds.put(None)
+                return AckResponse()
+            raise ValueError(type(req).__name__)
+
+        service = BasicService(f"spark_elastic_task_{index}", key, handle)
+        service.start()
+        try:
+            client = BasicClient(driver_addr, key)
+            # the executor process is the elastic "host" (unit of failure
+            # and blacklisting) — see module docstring
+            hh = f"{_r.host_hash()}[{index}]"
+            client.request(_r.RegisterTask(
+                index, socket.gethostname(), hh, service.address,
+                task_id=uuid.uuid4().hex))
+            func, fargs, fkwargs = cloudpickle.loads(payload)
+            while True:
+                try:
+                    cmd = cmds.get(timeout=60.0)
+                except queue.Empty:
+                    continue     # idle growth capacity; keep serving pings
+                if cmd is None:
+                    break
+                os.environ.update(cmd.env)
+                try:
+                    value = func(*fargs, **fkwargs)
+                except BaseException as e:  # noqa: BLE001 - to the driver
+                    value = _r._TaskError(f"{type(e).__name__}: {e}")
+                client.request(_e.ElasticTaskResult(
+                    index, cmd.env[_e._RUN_ID_ENV], value))
+        finally:
+            service.shutdown()
+        return [index]
+
+    return _task
+
+
+class _Run:
+    """One worker spawn: which task serves it, where it is assigned, and
+    its completion state."""
+
+    def __init__(self, task_id: str, slot_key):
+        self.task_id = task_id
+        self.slot_key = slot_key           # (hostname, local_rank)
+        self.done = threading.Event()
+        self.exit_code: Optional[int] = None
+        self.value: Any = None
+
+    def complete(self, exit_code: int, value: Any = None) -> None:
+        if not self.done.is_set():
+            self.exit_code, self.value = exit_code, value
+            self.done.set()
+
+
+class _ExecutorPool:
+    """Driver-side view of the registered tasks: registry, liveness,
+    busy-tracking, and the discovery adapter the elastic driver polls.
+
+    All state keys are the per-process ``task_id`` uuid, never the Spark
+    partition index — Spark reuses indices when it re-runs a lost
+    executor's task, and index keys would let the replacement's
+    registration collide with the dead task's busy/consumed state."""
+
+    def __init__(self, key: str):
+        self._key = key
+        self.lock = threading.Lock()
+        self.registry: Dict[str, Any] = {}       # task_id -> RegisterTask
+        self.busy: Dict[str, str] = {}           # task_id -> run_id
+        self.consumed: set = set()               # tasks whose fn failed
+        self.runs: Dict[str, _Run] = {}
+        self.registered = threading.Event()
+
+    def _alive(self, reg) -> bool:
+        """Probe with retries: one missed ping (GIL-starved service
+        thread, loaded machine) must not read as executor death — death
+        blacklists the host and burns a reset."""
+        import time
+
+        from horovod_tpu.runner.network import BasicClient
+
+        for attempt in range(_PING_ATTEMPTS):
+            try:
+                BasicClient(reg.addr, self._key,
+                            timeout_s=_PING_TIMEOUT_S).request(PingTask())
+                return True
+            except Exception:
+                if attempt + 1 < _PING_ATTEMPTS:
+                    time.sleep(_PING_RETRY_DELAY_S)
+        return False
+
+    def check_liveness(self) -> Dict[str, int]:
+        """Ping every registered task concurrently; drop dead ones
+        (completing any run they were serving as failed) and return
+        alive ``{host_hash: slots}`` for discovery.  Concurrency bounds
+        the sweep at one probe's worst case instead of one per dead
+        task — the discovery loop calls this every second."""
+        with self.lock:
+            items = list(self.registry.items())
+        alive: Dict[str, bool] = {}
+
+        def _probe(tid, reg):
+            alive[tid] = self._alive(reg)
+
+        threads = [threading.Thread(target=_probe, args=(tid, reg),
+                                    daemon=True) for tid, reg in items]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        hosts: Dict[str, int] = {}
+        for tid, reg in items:
+            if alive.get(tid):
+                hosts[reg.host_hash] = hosts.get(reg.host_hash, 0) + 1
+                continue
+            hvd_logging.warning(
+                "spark elastic: executor task %d (%s) stopped responding "
+                "— removing from the pool", reg.index, reg.host_hash)
+            with self.lock:
+                self.registry.pop(tid, None)
+                run_id = self.busy.pop(tid, None)
+                run = self.runs.get(run_id) if run_id else None
+            if run is not None:
+                run.complete(1)
+        return hosts
+
+
+class _ExecutorPoolDiscovery(HostDiscovery):
+    """Discovery = the live executor registry (reference: Spark task
+    registration IS host discovery, ``spark/runner.py`` task addresses
+    grouped by host hash)."""
+
+    def __init__(self, pool: _ExecutorPool):
+        self._pool = pool
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return self._pool.check_liveness()
+
+
+def run_elastic_on_context(sc, fn: Callable, args=(), kwargs=None,
+                           num_proc: Optional[int] = None,
+                           min_np: Optional[int] = None,
+                           max_np: Optional[int] = None,
+                           extra_env: Optional[dict] = None,
+                           reset_limit: int = 0,
+                           start_timeout: Optional[float] = None,
+                           elastic_timeout: float = 600.0,
+                           verbose: bool = False) -> List[Any]:
+    """Elastic ``run`` over an executor-pool context (pyspark
+    ``SparkContext`` or ``LocalSparkContext``) — the architecture of
+    ``_run_on_spark`` with the one-shot command phase replaced by the
+    :class:`ElasticDriver` lifecycle."""
+    import cloudpickle
+
+    from horovod_tpu.elastic.driver import START_TIMEOUT_S, ElasticDriver
+    from horovod_tpu.runner.network import (
+        AckResponse,
+        BasicClient,
+        BasicService,
+        make_secret_key,
+    )
+    from horovod_tpu.spark import runner as _r
+
+    num_proc = num_proc or sc.defaultParallelism
+    min_np = min_np or num_proc
+    max_np = max_np or num_proc
+    if not (min_np <= num_proc <= max_np):
+        raise ValueError(f"need min_np <= num_proc <= max_np, got "
+                         f"{min_np}/{num_proc}/{max_np}")
+    pool_size = max_np          # one executor task per potential slot
+    register_timeout = float(os.environ.get(_r._START_TIMEOUT_ENV, "600"))
+    worker_start_timeout = start_timeout if start_timeout is not None else \
+        float(os.environ.get("HOROVOD_ELASTIC_START_TIMEOUT",
+                             START_TIMEOUT_S))
+    key = make_secret_key()
+    payload = cloudpickle.dumps((fn, tuple(args), dict(kwargs or {})))
+    pool = _ExecutorPool(key)
+
+    def handle(req):
+        if isinstance(req, _r.RegisterTask):
+            with pool.lock:
+                pool.registry[req.task_id] = req
+                if len(pool.registry) >= min_np:
+                    pool.registered.set()
+            return AckResponse()
+        if isinstance(req, ElasticTaskResult):
+            with pool.lock:
+                run = pool.runs.get(req.run_id)
+                if run is not None:
+                    pool.busy.pop(run.task_id, None)
+                    if isinstance(req.value, _r._TaskError):
+                        # this task's process ran a failed fn; its host
+                        # gets blacklisted — never hand it another worker
+                        pool.consumed.add(run.task_id)
+            if run is not None:
+                if isinstance(req.value, _r._TaskError):
+                    hvd_logging.warning("spark elastic: worker on task %d "
+                                        "failed: %s", req.index,
+                                        req.value.message)
+                    run.complete(1, req.value)
+                else:
+                    run.complete(0, req.value)
+            return AckResponse()
+        raise ValueError(type(req).__name__)
+
+    service = BasicService("spark_elastic_driver", key, handle)
+    service.start()
+    job_error: List[BaseException] = []
+
+    def _job():
+        try:
+            sc.parallelize(range(pool_size), pool_size) \
+                .mapPartitionsWithIndex(_elastic_task_fn(
+                    service.address, key, payload)) \
+                .collect()
+        except BaseException as e:  # noqa: BLE001
+            job_error.append(e)
+
+    spark_thread = threading.Thread(target=_job, daemon=True,
+                                    name="hvd_tpu_spark_elastic_job")
+    spark_thread.start()
+
+    driver = ElasticDriver(_ExecutorPoolDiscovery(pool), min_np, max_np,
+                           timeout=elastic_timeout,
+                           reset_limit=reset_limit, secret_key=key,
+                           start_timeout=worker_start_timeout)
+    driver_host, driver_port = driver.address
+
+    def create_worker_fn(slot, coordinator: str, generation: int,
+                         abort_event=None) -> int:
+        with pool.lock:
+            candidates = sorted(
+                (pool.registry[tid].index, tid)
+                for tid, reg in pool.registry.items()
+                if reg.host_hash == slot.hostname
+                and tid not in pool.busy and tid not in pool.consumed)
+            if not candidates:
+                hvd_logging.warning(
+                    "spark elastic: no idle executor task on %s for rank "
+                    "%d", slot.hostname, slot.rank)
+                return 1
+            _, task_id = candidates[0]
+            reg = pool.registry[task_id]
+            run_id = uuid.uuid4().hex
+            run = _Run(task_id, (slot.hostname, slot.local_rank))
+            pool.runs[run_id] = run
+            pool.busy[task_id] = run_id
+        env = dict(extra_env or {})
+        env.update(slot.to_env())
+        env.update({
+            "HOROVOD_COORDINATOR_ADDR": coordinator,
+            "HOROVOD_CONTROLLER": "jax",
+            "HOROVOD_ELASTIC": "1",
+            "HOROVOD_SECRET_KEY": key,
+            "HOROVOD_ELASTIC_DRIVER_ADDR": f"{driver_host}:{driver_port}",
+            "HOROVOD_ELASTIC_NOTIFY_ADDR": "1",
+            "HOROVOD_ELASTIC_GENERATION": str(generation),
+            _RUN_ID_ENV: run_id,
+        })
+        if verbose:
+            import sys
+
+            print(f"[spark elastic] rank {slot.rank} gen {generation} -> "
+                  f"task {reg.index} on {slot.hostname}", file=sys.stderr)
+        try:
+            BasicClient(reg.addr, key).request(_r.RunFunction(env))
+        except Exception as e:
+            hvd_logging.warning("spark elastic: could not command task %d: "
+                                "%s", reg.index, e)
+            with pool.lock:
+                pool.busy.pop(task_id, None)
+            run.complete(1)
+            return 1
+        while not run.done.wait(1.0):
+            if abort_event is not None and abort_event.is_set():
+                # in-process task workers can't be killed selectively;
+                # consume the task so it is never reused and let the
+                # pool's liveness/shutdown machinery reap the process
+                with pool.lock:
+                    pool.consumed.add(task_id)
+                    pool.busy.pop(task_id, None)
+                run.complete(1)
+        return run.exit_code if run.exit_code is not None else 1
+
+    def _shutdown_tasks():
+        with pool.lock:
+            regs = list(pool.registry.values())
+        for reg in regs:
+            try:
+                BasicClient(reg.addr, key,
+                            timeout_s=_PING_TIMEOUT_S).request(
+                    _r.ShutdownTask())
+            except Exception:
+                pass
+
+    try:
+        if not pool.registered.wait(register_timeout):
+            raise RuntimeError(
+                f"only {len(pool.registry)}/{min_np} executor tasks "
+                f"registered within {register_timeout:.0f}s "
+                f"({_r._START_TIMEOUT_ENV} raises the wait)")
+        if job_error:
+            raise RuntimeError(
+                f"executor pool failed during startup: {job_error[0]}")
+        driver.start(num_proc, create_worker_fn)
+        rc = driver.wait_for_completion()
+        if rc != 0:
+            raise RuntimeError(
+                f"spark elastic job failed (exit code {rc})")
+        # final-generation results in final-rank order: a surviving
+        # worker's rank may differ from the one it spawned with, so map
+        # each successful run's (host, local_rank) through the driver's
+        # final assignments
+        results: Dict[int, Any] = {}
+        with pool.lock:
+            finished = [r for r in pool.runs.values() if r.exit_code == 0]
+        for run in finished:
+            slot = driver.get_slot_info(*run.slot_key)
+            if slot is not None:
+                results[slot.rank] = run.value
+        world = driver.world_size
+        missing = sorted(set(range(world)) - set(results))
+        if missing:
+            raise RuntimeError(
+                f"spark elastic job completed but ranks {missing} "
+                f"returned no result")
+        return [results[r] for r in range(world)]
+    finally:
+        driver.stop()      # no-op exit-code-wise once finished
+        _shutdown_tasks()
+        service.shutdown()
+        spark_thread.join(30.0)

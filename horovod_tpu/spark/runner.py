@@ -40,14 +40,19 @@ _START_TIMEOUT_ENV = "HOROVOD_SPARK_START_TIMEOUT"
 #    and driver-side isinstance checks match executor instances) --------
 
 class RegisterTask:
-    """Executor → driver: announce this task's identity and RPC address."""
+    """Executor → driver: announce this task's identity and RPC address.
+
+    ``task_id`` (elastic pools only) is a per-process uuid: Spark reuses
+    partition *indices* when it re-runs a lost executor's task, so the
+    index cannot key driver-side state across executor replacement."""
 
     def __init__(self, index: int, host: str, host_hash: str,
-                 addr: Tuple[str, int]):
+                 addr: Tuple[str, int], task_id: Optional[str] = None):
         self.index = index
         self.host = host
         self.host_hash = host_hash
         self.addr = tuple(addr)
+        self.task_id = task_id
 
 
 class TaskResult:
@@ -128,21 +133,34 @@ def run_elastic(fn: Callable, args=(), kwargs=None,
                 num_proc: Optional[int] = None,
                 min_np: Optional[int] = None, max_np: Optional[int] = None,
                 **kw) -> List[Any]:
-    """Elastic variant (reference ``run_elastic:303``).  Requires pyspark:
-    elasticity comes from Spark re-provisioning executors."""
-    if not _spark_available():
-        raise ImportError(
-            "horovod_tpu.spark.run_elastic requires pyspark; for elastic "
-            "training without Spark use the hvdrun elastic launcher "
-            "(python -m horovod_tpu.runner.launch --min-np ...)")
-    from pyspark import SparkContext
+    """Elastic variant (reference ``run_elastic:303``): the executor
+    pool's tasks become *potential* slots driven by the
+    :class:`~horovod_tpu.elastic.driver.ElasticDriver` over task-service
+    RPC — executor loss shrinks the world, new executors grow it.  Like
+    :func:`run`, degrades to the local executor pool without pyspark
+    (see :mod:`horovod_tpu.spark.elastic`)."""
+    from horovod_tpu.spark.elastic import run_elastic_on_context
 
-    sc = SparkContext._active_spark_context
-    if sc is None:
-        raise RuntimeError("no active SparkContext; create a SparkSession "
-                           "before horovod_tpu.spark.run_elastic")
-    return _run_on_spark(sc, fn, args, kwargs, num_proc, None, False,
-                         min_np=min_np, max_np=max_np)
+    if _spark_available():
+        from pyspark import SparkContext
+
+        sc = SparkContext._active_spark_context
+        if sc is None:
+            raise RuntimeError(
+                "no active SparkContext; create a SparkSession before "
+                "horovod_tpu.spark.run_elastic")
+        return run_elastic_on_context(sc, fn, args, kwargs, num_proc,
+                                      min_np, max_np, **kw)
+    hvd_logging.debug("pyspark not available; spark.run_elastic using the "
+                      "local executor pool")
+    from horovod_tpu.spark.local_executor import LocalSparkContext
+
+    # default the initial world to the floor the caller asked for —
+    # `or 1` would fail run_elastic_on_context's min_np<=num_proc check
+    # for any min_np > 1
+    return run_elastic_on_context(LocalSparkContext(), fn, args, kwargs,
+                                  num_proc or min_np or 1, min_np, max_np,
+                                  **kw)
 
 
 def plan_assignments(registry: Dict[int, RegisterTask], num_proc: int):

@@ -41,6 +41,7 @@ p.add_argument("--discovery-schedule", default="[]")
 p.add_argument("--exit-schedule", default="{}")
 p.add_argument("--exit-mode", default="exception")
 p.add_argument("--discovery-wait", type=int, default=30)
+p.add_argument("--rank-logfile", default="")
 args = p.parse_args()
 
 import jax
@@ -93,6 +94,17 @@ def train(state):
             state.params = state.params + np.asarray(grad)
             state.batch += 1
             state.commit()
+        if args.rank_logfile:
+            # every rank's identity at every epoch (O_APPEND line writes
+            # are atomic at this size): the rank-stability evidence the
+            # rank-0-only logfile cannot carry
+            with open(args.rank_logfile, "a") as f:
+                f.write(json.dumps({
+                    "epoch": state.epoch,
+                    "start_rank": start_rank,
+                    "rank": hvd.process_rank(),
+                    "size": hvd.process_count(),
+                }) + os.linesep)
         if hvd.process_rank() == 0:
             log_state(state)
             cur = epoch_to_hosts.get(state.epoch, default_hosts)
@@ -170,6 +182,7 @@ def run_elastic(tmp_path, discovery_schedule, np=1, min_np=1, max_np=2,
            *extra_args,
            "--", sys.executable, str(train),
            "--logfile", str(logfile),
+           "--rank-logfile", str(tmp_path / "ranks.jsonl"),
            "--epochs", str(epochs),
            "--discovery-schedule", json.dumps(discovery_schedule),
            "--exit-schedule", json.dumps(exit_schedule or {}),
@@ -192,6 +205,40 @@ def worker_logs(tmp_path):
 
 
 class TestElasticEndToEnd:
+    def test_growth_to_three_and_back(self, tmp_path):
+        """2→3 growth with BOTH survivors keeping their ranks while a
+        third worker joins as rank 2, then 3→2 removal with ranks again
+        stable (reference ``elastic_common.py`` multi-survivor
+        schedules).  The third "host" is this machine's hostname —
+        distinct from localhost/127.0.0.1 but still exec'd locally."""
+        import socket
+
+        third = socket.gethostname()
+        schedule = [
+            (0, ["localhost:1", "127.0.0.1:1"]),
+            (1, ["localhost:1", "127.0.0.1:1", f"{third}:1"]),
+            (None, ["localhost:1", "127.0.0.1:1"]),
+        ]
+        proc, results = run_elastic(tmp_path, schedule, np=2, min_np=2,
+                                    max_np=3)
+        assert proc.returncode == 0, (
+            proc.stderr[-3000:] + worker_logs(tmp_path))
+        assert [r["size"] for r in results] == [2, 3, 2], results
+        assert [r["rendezvous"] for r in results] == [1, 2, 3]
+        # every epoch's identity set, from every rank's own report
+        by_epoch = {}
+        for line in (tmp_path / "ranks.jsonl").read_text().splitlines():
+            rec = json.loads(line)
+            by_epoch.setdefault(rec["epoch"], set()).add(
+                (rec["start_rank"], rec["rank"]))
+        # both original workers keep ranks 0/1 through growth AND
+        # shrink; the joiner appears as rank 2 only at epoch 1
+        assert by_epoch[0] == {(0, 0), (1, 1)}
+        assert by_epoch[1] == {(0, 0), (1, 1), (2, 2)}
+        assert by_epoch[2] == {(0, 0), (1, 1)}
+        # state continuity across both transitions
+        assert results[2]["w"] == pytest.approx(6.0)
+
     def test_hosts_added_and_removed(self, tmp_path):
         """World grows 1→2 when discovery adds a host, shrinks 2→1 when
         the original (rank-0) host is removed; epoch/state survive every

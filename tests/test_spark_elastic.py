@@ -1,0 +1,118 @@
+"""Elastic training over the executor pool — the Spark elastic flow
+(reference ``horovod/spark/runner.py:303 run_elastic``) executing for
+real through the LocalSparkContext contract double: task registration
+is discovery, worker commands ride task-service RPC, and executor loss
+mid-fit shrinks the world instead of failing the job."""
+
+import os
+
+import pytest
+
+from horovod_tpu.spark.elastic import run_elastic_on_context
+from horovod_tpu.spark.local_executor import LocalSparkContext
+
+
+def _clean_worker_env():
+    # executor worlds must not inherit the in-process virtual mesh
+    os.environ.pop("HOROVOD_TPU_MESH_SHAPE", None)
+    os.environ.pop("XLA_FLAGS", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _elastic_rank_fn():
+    _clean_worker_env()
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+
+    @hvd.elastic.run
+    def train(state):
+        state.rendezvous += 1
+        while state.epoch < 2:
+            state.epoch += 1
+            state.commit()
+
+    state = hvd.elastic.ObjectState(epoch=0, rendezvous=0)
+    train(state)
+    out = {"rank": hvd.process_rank(), "size": hvd.process_count(),
+           "epoch": state.epoch, "rendezvous": state.rendezvous}
+    hvd.shutdown()
+    return out
+
+
+def _elastic_churn_fn():
+    _clean_worker_env()
+    import jax.numpy as jnp
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    start_rank = int(os.environ.get("HOROVOD_RANK", 0))
+
+    @hvd.elastic.run
+    def train(state):
+        state.rendezvous += 1
+        while state.epoch < 4:
+            if state.epoch == 2 and start_rank == 1 and \
+                    state.rendezvous == 1:
+                # executor loss mid-fit: SIGKILL leaves no TaskResult —
+                # only the liveness ping can discover it
+                os.kill(os.getpid(), 9)
+            g = hvd.allreduce(jnp.ones((2,)), op=hvd.Average, name="g")
+            state.params = state.params + np.asarray(g)
+            state.epoch += 1
+            state.commit()
+
+    state = hvd.elastic.ObjectState(params=np.zeros(2), epoch=0,
+                                    rendezvous=0)
+    train(state)
+    out = {"start_rank": start_rank, "rank": hvd.process_rank(),
+           "size": hvd.process_count(), "epoch": state.epoch,
+           "params": float(state.params[0]),
+           "rendezvous": state.rendezvous}
+    hvd.shutdown()
+    return out
+
+
+class TestSparkElastic:
+    def test_static_world_completes(self, monkeypatch):
+        """No churn: 2 executor tasks register, become ranks 0/1, run
+        the elastic loop once, and per-rank results come back in rank
+        order — run()'s contract on the elastic path."""
+        monkeypatch.setenv("HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT", "5")
+        out = run_elastic_on_context(
+            LocalSparkContext(), _elastic_rank_fn, num_proc=2,
+            min_np=2, max_np=2, start_timeout=90.0, elastic_timeout=120.0)
+        assert [o["rank"] for o in out] == [0, 1]
+        assert all(o["size"] == 2 for o in out)
+        assert all(o["epoch"] == 2 for o in out)
+        assert all(o["rendezvous"] == 1 for o in out)
+
+    def test_executor_loss_shrinks_world_mid_fit(self, monkeypatch):
+        """The VERDICT scenario: 2 local executors, one SIGKILLed at
+        epoch 2; the liveness ping discovers the loss, the world shrinks
+        2→1, and training completes with the survivor's committed state
+        (epochs 0-1 at world 2, epochs 2-3 alone → params 4.0, one
+        re-rendezvous)."""
+        monkeypatch.setenv("HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT", "5")
+        out = run_elastic_on_context(
+            LocalSparkContext(), _elastic_churn_fn, num_proc=2,
+            min_np=1, max_np=2, start_timeout=90.0, elastic_timeout=120.0)
+        assert len(out) == 1                 # final world is one rank
+        (res,) = out
+        assert res["start_rank"] == 0
+        assert res["rank"] == 0
+        assert res["size"] == 1
+        assert res["epoch"] == 4
+        assert res["params"] == pytest.approx(4.0)
+        assert res["rendezvous"] == 2        # one reset after the loss
+
+    def test_bad_np_bounds_rejected(self):
+        with pytest.raises(ValueError, match="min_np <= num_proc"):
+            run_elastic_on_context(LocalSparkContext(), _elastic_rank_fn,
+                                   num_proc=1, min_np=2, max_np=4)
