@@ -179,8 +179,13 @@ class ElasticDriver:
         # ``driver.start`` → ``wait_for_available_slots(np)``): with racy
         # discovery (e.g. executor-pool registration) waiting only for
         # min_np starts a world of whichever slots registered first and a
-        # fast job can finish before the rest ever join
-        self.wait_for_available_slots(max(np, self._min_np))
+        # fast job can finish before the rest ever join.  But np is a
+        # request, not a contract: past the start timeout an elastic
+        # cluster that can muster min_np starts small and grows when
+        # hosts arrive — failing it outright would defeat elasticity.
+        self.wait_for_available_slots(max(np, self._min_np),
+                                      fallback_min=self._min_np,
+                                      fallback_after=self._start_timeout)
         with self._lock:
             self._update_host_assignments()
         self._spawn_all()
@@ -206,12 +211,28 @@ class ElasticDriver:
             svc.shutdown()
         return self._exit_code if self._exit_code is not None else 0
 
-    def wait_for_available_slots(self, min_np: int) -> None:
+    def wait_for_available_slots(self, min_np: int,
+                                 fallback_min: Optional[int] = None,
+                                 fallback_after: Optional[float] = None
+                                 ) -> None:
         """Block until discovery supplies ≥ min_np slots (reference
-        ``wait_for_available_slots:145``)."""
-        deadline = time.monotonic() + self._timeout
+        ``wait_for_available_slots:145``).  With a fallback, accept
+        ``fallback_min`` slots once ``fallback_after`` seconds have
+        passed — start-small-grow-later elasticity when the requested
+        world doesn't fully materialize."""
+        start = time.monotonic()
+        deadline = start + self._timeout
         while not self._shutdown.is_set():
-            if self._host_manager.available_slots >= min_np:
+            avail = self._host_manager.available_slots
+            if avail >= min_np:
+                return
+            if fallback_min is not None and fallback_after is not None \
+                    and avail >= fallback_min and \
+                    time.monotonic() - start > fallback_after:
+                hvd_logging.warning(
+                    "elastic: only %d of the requested %d slots appeared "
+                    "within %.0fs — starting with %d and growing as "
+                    "hosts arrive", avail, min_np, fallback_after, avail)
                 return
             if time.monotonic() > deadline:
                 raise TimeoutError(

@@ -27,13 +27,13 @@ from typing import Any, Callable, List, Sequence
 
 
 def _partition_worker(conn, fn_payload: bytes, index: int,
-                      items: list) -> None:
+                      items_payload: bytes) -> None:
     """Spawned-process body: run the cloudpickled partition function."""
     import cloudpickle
 
     try:
         f = cloudpickle.loads(fn_payload)
-        out = list(f(index, iter(items)))
+        out = list(f(index, iter(cloudpickle.loads(items_payload))))
         conn.send(("ok", out))
     except BaseException as e:  # noqa: BLE001 - report, don't swallow
         try:
@@ -85,8 +85,11 @@ class _MappedRDD:
         workers = []
         for i, part in enumerate(self._partitions):
             recv, send = ctx.Pipe(duplex=False)
+            # partition DATA rides cloudpickle like the function does:
+            # Spark's python serializer likewise handles callables in
+            # parallelize()'d data (executor-side data generators)
             p = ctx.Process(target=_partition_worker,
-                            args=(send, payload, i, part),
+                            args=(send, payload, i, cloudpickle.dumps(part)),
                             name=f"local-spark-worker-{i}", daemon=True)
             p.start()
             send.close()

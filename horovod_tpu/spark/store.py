@@ -227,7 +227,20 @@ class FilesystemStore(Store):
         :class:`RowGroupReader` sharding unit), and the schema saved as
         a ``_hvd_schema.json`` sidecar so ``Estimator.fit(path)``
         streams without re-probing.  Returns :class:`PreparedData`.
+
+        A pyspark DataFrame takes the executor-side path instead of
+        ``toPandas()`` (which lands the whole dataset in driver memory):
+        its partitions write their own parquet parts where they already
+        live (see :meth:`prepare_data_distributed`).  The routing needs
+        an ``.rdd`` (pyspark.pandas / Spark Connect frames fall through
+        to their ``to_pandas()``) and an executor-reachable store (a
+        process-local ``memory://`` store can only take driver writes).
         """
+        if type(df).__module__.split(".", 1)[0] == "pyspark" and \
+                hasattr(df, "rdd") and not self._process_local():
+            return self._prepare_from_rdd(
+                df.rdd, feature_cols, label_col, validation_fraction,
+                rows_per_group, idx)
         df = _to_pandas_like(df)
         # validate schema + dtypes column-by-column: each column is
         # materialized (cast-checked) once and immediately discarded, so
@@ -254,6 +267,107 @@ class FilesystemStore(Store):
             return json.dumps({
                 "features": [sp.to_json() for sp in feature_specs],
                 "label": label_spec.to_json(),
+                "val_path": val_path,
+                "role": role,
+            }, indent=2).encode()
+
+        self.write(os.path.join(train_path, self.SCHEMA_FILE),
+                   schema_json("train"))
+        if val_path:
+            self.write(os.path.join(val_path, self.SCHEMA_FILE),
+                       schema_json("val"))
+        return PreparedData(train_path, val_path, feature_specs,
+                            label_spec)
+
+    def prepare_data_distributed(self, sc, partitions, feature_cols,
+                                 label_col,
+                                 validation_fraction: float = 0.0,
+                                 rows_per_group: Optional[int] = None,
+                                 idx="prepared") -> "PreparedData":
+        """Executor-side ingestion: each partition materializes and
+        writes its rows ON an executor (reference
+        ``spark/common/util.py:541-590`` ``_get_or_create_dataset`` —
+        ``df.rdd.map(to_petastorm).toDF()`` distributed parquet write);
+        the driver never holds more than one partition's *metadata*, so
+        dataset size is bounded by executor memory, not driver memory.
+
+        ``sc`` is any executor context exposing the ``run()`` RDD slice
+        (pyspark ``SparkContext`` or
+        :class:`~horovod_tpu.spark.local_executor.LocalSparkContext`).
+        ``partitions`` is a list of per-partition sources: each element
+        is a DataFrame-shaped chunk or a zero-arg callable returning one
+        (callables let executors *generate* their data — e.g. read their
+        own files — without it ever existing on the driver).
+
+        The produced layout is byte-identical in kind to
+        :meth:`prepare_data`'s — ``part-NNNNN.parquet`` files +
+        ``_meta.json`` + ``_hvd_schema.json`` per side — so every reader
+        (``RowGroupReader``, ``Estimator.fit``) is unchanged.
+
+        Note: the store itself must be reachable from executors (a
+        shared filesystem or a real remote scheme); a ``memory://``
+        store is process-local and cannot receive executor writes.
+        """
+        parts = list(partitions)   # consume a generator argument ONCE
+        rdd = sc.parallelize(parts, max(len(parts), 1))
+        return self._prepare_from_rdd(rdd, feature_cols, label_col,
+                                      validation_fraction, rows_per_group,
+                                      idx)
+
+    def _process_local(self) -> bool:
+        """True when this store's filesystem lives inside the calling
+        process (executors cannot write into it)."""
+        return False
+
+    def _prepare_from_rdd(self, rdd, feature_cols, label_col,
+                          validation_fraction, rows_per_group,
+                          idx) -> "PreparedData":
+        if self._process_local():
+            raise ValueError(
+                "executor-side prepare needs a store reachable from "
+                "executor processes; this store's filesystem is "
+                f"process-local ({self.prefix_path!r}) — use a shared "
+                "path or a real remote scheme, or pass a pandas "
+                "DataFrame for the driver-side path")
+        train_path = self.get_train_data_path(idx)
+        val_path = self.get_val_data_path(idx) if validation_fraction \
+            else None
+        # a previous prepare may have left MORE parts than this one
+        # writes; stale part files would silently join the dataset
+        self.delete(train_path)
+        if val_path:
+            self.delete(val_path)
+        fn = _prepare_part_fn(
+            self.prefix_path, list(feature_cols), label_col,
+            float(validation_fraction), rows_per_group, train_path,
+            val_path)
+        metas = [m for m in rdd.mapPartitionsWithIndex(fn).collect() if m]
+        if not metas:
+            raise ValueError("prepare_data_distributed: no partition "
+                             "produced any rows")
+        first = metas[0]
+        for m in metas[1:]:
+            for k in ("features", "label", "shapes"):
+                if m[k] != first[k]:
+                    raise ValueError(
+                        f"partition {m['part']} disagrees with partition "
+                        f"{first['part']} on {k}: {m[k]!r} vs "
+                        f"{first[k]!r} — executor-side schemas must be "
+                        f"identical")
+        total_val = sum(m["val_rows"] for m in metas)
+        if val_path and not total_val:
+            val_path = None
+        feature_specs = [ColSpec.from_json(d) for d in first["features"]]
+        label_spec = ColSpec.from_json(first["label"])
+        # driver-side sidecar merge: one _meta.json + schema per side
+        for side in filter(None, (train_path, val_path)):
+            self.write(os.path.join(side, "_meta.json"),
+                       json.dumps({"shapes": first["shapes"]}).encode())
+
+        def schema_json(role):
+            return json.dumps({
+                "features": first["features"],
+                "label": first["label"],
                 "val_path": val_path,
                 "role": role,
             }, indent=2).encode()
@@ -359,6 +473,17 @@ class FilesystemStore(Store):
         stream instead of materializing (petastorm's row-group reader
         contract, reference ``spark/common/util.py:697``).
         """
+        shapes = self._write_parquet_part(df, path, "part-00000.parquet",
+                                          rows_per_group)
+        with self._open(path.rstrip("/") + "/_meta.json", "w") as f:
+            json.dump({"shapes": shapes}, f)
+
+    def _write_parquet_part(self, df, path: str, part_name: str,
+                            rows_per_group: Optional[int] = None) -> dict:
+        """One parquet part file of the store data-dir layout (no
+        ``_meta.json`` — the caller owns the directory-level sidecars,
+        so executor tasks can each write their own part).  Returns the
+        tensor-shape map for the sidecar."""
         import pandas as pd
         import pyarrow as pa
         import pyarrow.parquet as pq
@@ -378,12 +503,10 @@ class FilesystemStore(Store):
                 out[c] = col
         table = pa.Table.from_pandas(pd.DataFrame(out),
                                      preserve_index=False)
-        with self._open(path.rstrip("/") + "/part-00000.parquet",
-                        "wb") as f:
+        with self._open(path.rstrip("/") + "/" + part_name, "wb") as f:
             pq.write_table(table, f,
                            row_group_size=rows_per_group or len(df) or 1)
-        with self._open(path.rstrip("/") + "/_meta.json", "w") as f:
-            json.dump({"shapes": shapes}, f)
+        return shapes
 
     def read_dataframe(self, path: str):
         import pandas as pd
@@ -579,6 +702,11 @@ class FsspecStore(FilesystemStore):
         self._fs.get(remote.rstrip("/") + "/", local.rstrip("/") + "/",
                      recursive=True)
 
+    def _process_local(self) -> bool:
+        proto = getattr(self._fs, "protocol", "")
+        protos = {proto} if isinstance(proto, str) else set(proto)
+        return "memory" in protos
+
     def upload_file(self, local: str, remote: str) -> None:
         """Streamed single-file upload — ``put_file`` transfers in
         chunks, so multi-GB checkpoint files never materialize as one
@@ -659,6 +787,72 @@ class PreparedData:
     val_path: Optional[str]
     feature_specs: List["ColSpec"]
     label_spec: "ColSpec"
+
+
+def _prepare_part_fn(store_prefix: str, feature_cols, label_col: str,
+                     val_frac: float, rows_per_group, train_path: str,
+                     val_path):
+    """The executor-side body of distributed prepare: materialize this
+    partition's rows, split train/val by the tail fraction, write one
+    ``part-NNNNN.parquet`` per side, and return the partition's schema
+    for the driver-side agreement check + sidecar merge."""
+
+    def _fn(index: int, iterator):
+        import pandas as pd
+
+        from horovod_tpu.spark.store import (
+            Store,
+            _to_pandas_like,
+            extract_typed,
+        )
+
+        chunks = []
+        rows = []
+        for item in iterator:
+            if callable(item):
+                item = item()
+            elif hasattr(item, "asDict"):      # pyspark Row
+                rows.append(item.asDict())
+                continue
+            chunks.append(_to_pandas_like(item))
+        if rows:
+            chunks.append(pd.DataFrame(rows))
+        if not chunks:
+            return []
+        chunk = chunks[0] if len(chunks) == 1 else \
+            pd.concat(chunks, ignore_index=True)
+        store = Store.create(store_prefix)
+        feature_specs = []
+        for c in feature_cols:
+            _, (spec,) = extract_typed(chunk, [c])
+            feature_specs.append(spec)
+        _, (label_spec,) = extract_typed(chunk, [label_col])
+        n = len(chunk)
+        n_val = int(n * val_frac)
+        split = n - n_val
+        cols = list(dict.fromkeys(list(feature_cols) + [label_col]))
+        part = f"part-{index:05d}.parquet"
+        # same default as the driver-side prepare (split // 8): both
+        # paths must shard identical data identically
+        rpg = rows_per_group or max(split // 8, 1)
+        shapes = store._write_parquet_part(chunk.iloc[:split][cols],
+                                           train_path, part, rpg)
+        if n_val and val_path:
+            store._write_parquet_part(chunk.iloc[split:][cols], val_path,
+                                      part, rpg)
+        import os as _os
+
+        return [{
+            "part": index,
+            "pid": _os.getpid(),
+            "rows": n,
+            "val_rows": n_val if val_path else 0,
+            "features": [sp.to_json() for sp in feature_specs],
+            "label": label_spec.to_json(),
+            "shapes": shapes,
+        }]
+
+    return _fn
 
 
 def _to_pandas_like(df):

@@ -1,6 +1,8 @@
 """Estimator fit/transform (reference ``test_spark_keras.py`` /
 ``test_spark_torch.py`` shape: tiny DataFrames, local mode)."""
 
+import os
+
 import flax.linen as nn
 import numpy as np
 import pandas as pd
@@ -394,11 +396,14 @@ class TestSparkRun:
 
         assert spark_run(fn, num_proc=2) == [0, 1]
 
-    def test_run_elastic_requires_spark(self):
-        with pytest.raises(ImportError, match="pyspark"):
-            from horovod_tpu.spark import run_elastic
+    def test_run_elastic_validates_bounds_locally(self):
+        # run_elastic no longer requires pyspark (it degrades to the
+        # local executor pool like run); bad bounds still fail fast
+        # before any executors spawn
+        from horovod_tpu.spark import run_elastic
 
-            run_elastic(lambda: None, num_proc=2)
+        with pytest.raises(ValueError, match="min_np <= num_proc"):
+            run_elastic(lambda: None, num_proc=4, min_np=1, max_np=2)
 
 
 class TestPrepareData:
@@ -451,6 +456,91 @@ class TestPrepareData:
         assert len(d1) == len(d2) == 32
         import numpy as np
         np.testing.assert_allclose(d1["f1"], d2["f1"])
+
+    def test_prepare_distributed_executor_side(self, tmp_path):
+        """Executor-side ingestion (reference util.py:541-590): each
+        partition's data is GENERATED and written on an executor
+        process — the driver never materializes the dataset — and the
+        produced layout is indistinguishable from the driver-side
+        prepare (same readers, same sidecars)."""
+        from horovod_tpu.spark.local_executor import LocalSparkContext
+        from horovod_tpu.spark.store import (FilesystemStore,
+                                             RowGroupReader, Store)
+
+        marker_dir = tmp_path / "pids"
+        marker_dir.mkdir()
+
+        def make_partition(seed, n):
+            def _gen():
+                import os as _os
+
+                import numpy as _np
+                import pandas as _pd
+                with open(str(marker_dir / f"pid-{seed}"), "w") as f:
+                    f.write(str(_os.getpid()))
+                rng = _np.random.RandomState(seed)
+                x = rng.rand(n, 4).astype(_np.float32)
+                return _pd.DataFrame({
+                    "f1": x[:, 0], "f2": x[:, 1], "f3": x[:, 2],
+                    "f4": x[:, 3],
+                    "label": (x.sum(axis=1) > 2).astype(_np.int32),
+                })
+            return _gen
+
+        store = Store.create(str(tmp_path / "s"))
+        prepared = store.prepare_data_distributed(
+            LocalSparkContext(), [make_partition(s, 32) for s in range(3)],
+            ["f1", "f2", "f3", "f4"], "label",
+            validation_fraction=0.25, rows_per_group=8)
+
+        # the data existed only on executors: every generator ran in a
+        # spawned process, none in this (driver) process
+        pids = {int((marker_dir / f"pid-{s}").read_text())
+                for s in range(3)}
+        assert os.getpid() not in pids
+        assert len(pids) == 3            # one process per partition
+
+        # layout identical in kind to the driver-side prepare
+        assert store.is_parquet_dataset(prepared.train_path)
+        assert store.is_parquet_dataset(prepared.val_path)
+        parts = sorted(p for p in os.listdir(prepared.train_path)
+                       if p.endswith(".parquet"))
+        assert parts == [f"part-{i:05d}.parquet" for i in range(3)]
+        # 24 train rows per partition / 8 per group = 3 groups x 3 parts
+        reader = RowGroupReader(prepared.train_path)
+        assert reader.num_row_groups == 9
+        assert sum(reader.group_rows) == 72
+        val_reader = RowGroupReader(prepared.val_path)
+        assert sum(val_reader.group_rows) == 24
+        back = FilesystemStore.load_schema(prepared.train_path)
+        assert back is not None
+        assert [s.name for s in back.feature_specs] == \
+            ["f1", "f2", "f3", "f4"]
+        assert back.val_path == prepared.val_path
+
+        # the prepared handle trains exactly like a driver-side one
+        model = Estimator(Net(), feature_cols=["f1", "f2", "f3", "f4"],
+                          label_col="label", batch_size=8,
+                          epochs=1).fit(prepared)
+        out = model.transform(make_df(8))
+        assert "prediction" in out
+
+    def test_prepare_distributed_schema_mismatch_fails(self, tmp_path):
+        from horovod_tpu.spark.local_executor import LocalSparkContext
+        from horovod_tpu.spark.store import Store
+
+        import pandas as pd
+
+        parts = [
+            pd.DataFrame({"f1": np.zeros(8, np.float32),
+                          "label": np.zeros(8, np.int32)}),
+            pd.DataFrame({"f1": np.zeros((8, 2), np.float32).tolist(),
+                          "label": np.zeros(8, np.int32)}),
+        ]
+        store = Store.create(str(tmp_path / "s"))
+        with pytest.raises(ValueError, match="disagrees"):
+            store.prepare_data_distributed(
+                LocalSparkContext(), parts, ["f1"], "label")
 
     def test_fit_from_prepared_handle_and_path(self, tmp_path):
         from horovod_tpu.spark.store import Store
