@@ -103,6 +103,9 @@ class NativeTimeline:
         self._intern_cache: dict = {}
         self._cycle_id = self._intern("CYCLE_START")
         self._closed = False
+        from horovod_tpu.utils.timeline import TraceAnnotationBridge
+
+        self._annotations = TraceAnnotationBridge()
 
     def _intern(self, s: str) -> int:
         i = self._intern_cache.get(s)
@@ -120,12 +123,14 @@ class NativeTimeline:
             return
         self._lib.hvdtl_event(self._handle, self._intern(activity),
                               self._intern(tensor_name), b"B")
+        self._annotations.start(tensor_name, activity)
 
     def end_activity(self, tensor_name: str) -> None:
         if self._closed:
             return
         self._lib.hvdtl_event(self._handle, -1,
                               self._intern(tensor_name), b"E")
+        self._annotations.end(tensor_name)
 
     def instant(self, name: str, args=None) -> None:
         if self._closed:
@@ -143,6 +148,7 @@ class NativeTimeline:
     def close(self) -> None:
         if not self._closed:
             self._closed = True
+            self._annotations.clear()
             self._lib.hvdtl_close(self._handle)
 
 

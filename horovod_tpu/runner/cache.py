@@ -1,0 +1,77 @@
+"""On-disk TTL cache for launch-time discovery results.
+
+Reference: ``horovod/runner/util/cache.py`` — the launcher memoizes
+expensive pre-flight discovery (NIC routability probes) in a JSON file
+under the user's cache dir, keyed by the call parameters, with entries
+expiring after a staleness threshold; ``--disable-cache`` bypasses it.
+Repeated launches against the same host set then skip the multi-second
+ssh + ring-probe round trip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Optional
+
+from horovod_tpu.utils import logging as hvd_logging
+
+DEFAULT_TTL_S = 600.0
+_TTL_ENV = "HOROVOD_TPU_DISCOVERY_CACHE_TTL"
+
+
+def _default_path() -> str:
+    root = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(root, "horovod_tpu", "discovery_cache.json")
+
+
+class DiscoveryCache:
+    """``{key: (timestamp, value)}`` in one JSON file.
+
+    Keys are JSON-serialized (sorted) parameter dicts; values must be
+    JSON-serializable.  The file is re-read on every ``get`` — launches
+    are seconds apart, not microseconds, and rereads keep concurrent
+    launchers coherent enough (last-writer-wins, same as the
+    reference's fcntl-less fallback behavior)."""
+
+    def __init__(self, path: Optional[str] = None,
+                 ttl_s: Optional[float] = None):
+        self._path = path or _default_path()
+        self._ttl = ttl_s if ttl_s is not None else \
+            float(os.environ.get(_TTL_ENV, DEFAULT_TTL_S))
+
+    @staticmethod
+    def _key(params: Any) -> str:
+        return json.dumps(params, sort_keys=True)
+
+    def _load(self) -> dict:
+        try:
+            with open(self._path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    def get(self, params: Any):
+        """The cached value for ``params``, or None when missing or
+        older than the TTL."""
+        entry = self._load().get(self._key(params))
+        if not entry:
+            return None
+        ts, value = entry
+        if time.time() - ts > self._ttl:
+            return None
+        return value
+
+    def put(self, params: Any, value: Any) -> None:
+        data = self._load()
+        data[self._key(params)] = (time.time(), value)
+        try:
+            os.makedirs(os.path.dirname(self._path), exist_ok=True)
+            tmp = self._path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, self._path)    # atomic vs concurrent readers
+        except OSError as e:
+            hvd_logging.debug("discovery cache write failed: %s", e)

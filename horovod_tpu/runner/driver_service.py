@@ -273,3 +273,31 @@ def discover_common_interfaces(hostnames: List[str], spawn_task,
         spawn_task(host, idx, daddrs)
     common = driver.wait_common_interfaces(timeout_s)
     return common, driver
+
+
+def probe_common_and_rank0(hostnames: List[str], spawn_task,
+                           secret_key: Optional[str] = None,
+                           timeout_s: float = 60.0, cache=None):
+    """``(common_interfaces, {iface: rank0_ip})`` — the two facts a
+    launcher consumes from the ring probe — with an optional on-disk TTL
+    cache (reference ``runner/util/cache.py``: repeated launches against
+    the same host set skip the ssh + probe round trip; an expired or
+    missing entry re-probes).  Only interface/IP facts are cached —
+    ports are per-run ephemera."""
+    params = {"probe": hostnames}
+    if cache is not None:
+        hit = cache.get(params)
+        if hit is not None:
+            hvd_logging.debug("NIC discovery: warm cache hit for %s",
+                              hostnames)
+            return hit["common"], hit["rank0"]
+    common, driver = discover_common_interfaces(
+        hostnames, spawn_task, secret_key, timeout_s)
+    try:
+        rank0 = {iface: addr[0]
+                 for iface, addr in driver.task_address(0).items()}
+    finally:
+        driver.shutdown()
+    if cache is not None:
+        cache.put(params, {"common": common, "rank0": rank0})
+    return common, rank0

@@ -92,7 +92,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--cache-capacity", type=int, dest="cache_capacity")
     p.add_argument("--disable-cache", action="store_const", const=True,
                    dest="disable_cache",
-                   help="disable the response-cache analogue "
+                   help="re-run launch-time discovery (NIC ring probe) "
+                        "instead of using cached results (reference "
+                        "--disable-cache), and disable the "
+                        "response-cache analogue "
                         "(sets HOROVOD_CACHE_CAPACITY=0)")
     p.add_argument("--autotune", action="store_const", const=True,
                    dest="autotune")
@@ -172,7 +175,7 @@ def _discover_coordinator_addr(hosts: List[HostInfo], args) -> str:
     name resolves identically from every worker."""
     import subprocess
 
-    from horovod_tpu.runner.driver_service import discover_common_interfaces
+    from horovod_tpu.runner.driver_service import probe_common_and_rank0
     from horovod_tpu.runner.network import make_secret_key
 
     hostnames = [h.hostname for h in hosts]
@@ -198,7 +201,16 @@ def _discover_coordinator_addr(hosts: List[HostInfo], args) -> str:
                                       stderr=subprocess.DEVNULL))
 
     try:
-        common, driver = discover_common_interfaces(hostnames, spawn, key)
+        # repeated launches against one host set skip the ssh+probe
+        # round trip via the on-disk TTL cache (reference
+        # runner/util/cache.py; --disable-cache forces a fresh probe)
+        cache = None
+        if not getattr(args, "disable_cache", None):
+            from horovod_tpu.runner.cache import DiscoveryCache
+
+            cache = DiscoveryCache()
+        common, rank0_ips = probe_common_and_rank0(
+            hostnames, spawn, key, cache=cache)
         if requested_nics is not None:
             # --network-interface: the user's list wins, but the probe
             # still supplies rank-0's IP on that interface (the launcher
@@ -210,12 +222,8 @@ def _discover_coordinator_addr(hosts: List[HostInfo], args) -> str:
                     f"--network-interface {args.nics} matches none of "
                     f"the mutually-routable interfaces {common}")
             common = narrowed
-        try:
-            rank0 = driver.task_address(0)
-            iface = next(i for i in common if i in rank0)
-            ip = rank0[iface][0]
-        finally:
-            driver.shutdown()
+        iface = next(i for i in common if i in rank0_ips)
+        ip = rank0_ips[iface]
         if args.verbose:
             print(f"[launcher] common interfaces: {common}; coordinator "
                   f"on {ip}", file=sys.stderr)

@@ -85,9 +85,10 @@ class _MappedRDD:
         workers = []
         for i, part in enumerate(self._partitions):
             recv, send = ctx.Pipe(duplex=False)
-            # partition DATA rides cloudpickle like the function does:
-            # Spark's python serializer likewise handles callables in
-            # parallelize()'d data (executor-side data generators)
+            # partition DATA rides cloudpickle like the function does,
+            # so closures work as parallelize()'d elements here.  (Real
+            # pyspark serializes data with plain pickle — closures need
+            # a module-level function / functools.partial there.)
             p = ctx.Process(target=_partition_worker,
                             args=(send, payload, i, cloudpickle.dumps(part)),
                             name=f"local-spark-worker-{i}", daemon=True)

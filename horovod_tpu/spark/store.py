@@ -233,11 +233,14 @@ class FilesystemStore(Store):
         its partitions write their own parquet parts where they already
         live (see :meth:`prepare_data_distributed`).  The routing needs
         an ``.rdd`` (pyspark.pandas / Spark Connect frames fall through
-        to their ``to_pandas()``) and an executor-reachable store (a
-        process-local ``memory://`` store can only take driver writes).
+        to their ``to_pandas()``) and a store KNOWN to be reachable from
+        executors — a real remote scheme.  A plain local path may or may
+        not be a shared mount (the driver cannot tell), so it keeps the
+        driver-side write; call :meth:`prepare_data_distributed`
+        explicitly when the path is cluster-visible.
         """
         if type(df).__module__.split(".", 1)[0] == "pyspark" and \
-                hasattr(df, "rdd") and not self._process_local():
+                hasattr(df, "rdd") and self._executor_reachable():
             return self._prepare_from_rdd(
                 df.rdd, feature_cols, label_col, validation_fraction,
                 rows_per_group, idx)
@@ -256,11 +259,16 @@ class FilesystemStore(Store):
         rpg = rows_per_group or max(split // 8, 1)
         cols = list(dict.fromkeys(list(feature_cols) + [label_col]))
         train_path = self.get_train_data_path(idx)
+        # a prior distributed prepare may have left part-00001.. here;
+        # stale parts would silently join this dataset (write_dataframe
+        # only overwrites part-00000)
+        self.delete(train_path)
         self.write_dataframe(df.iloc[:split][cols], train_path,
                              rows_per_group=rpg)
         val_path = None
         if n_val:
             val_path = self.get_val_data_path(idx)
+            self.delete(val_path)
             self.write_dataframe(df.iloc[split:][cols], val_path,
                                  rows_per_group=rpg)
         def schema_json(role):
@@ -297,7 +305,11 @@ class FilesystemStore(Store):
         ``partitions`` is a list of per-partition sources: each element
         is a DataFrame-shaped chunk or a zero-arg callable returning one
         (callables let executors *generate* their data — e.g. read their
-        own files — without it ever existing on the driver).
+        own files — without it ever existing on the driver).  pyspark
+        serializes parallelize()'d data with plain pickle, so callables
+        there must be plain-picklable (a module-level function or
+        ``functools.partial`` of one, not a closure); the local pool
+        ships data via cloudpickle and takes closures too.
 
         The produced layout is byte-identical in kind to
         :meth:`prepare_data`'s — ``part-NNNNN.parquet`` files +
@@ -317,6 +329,15 @@ class FilesystemStore(Store):
     def _process_local(self) -> bool:
         """True when this store's filesystem lives inside the calling
         process (executors cannot write into it)."""
+        return False
+
+    def _executor_reachable(self) -> bool:
+        """True when executor processes are KNOWN to see this store's
+        paths (a real remote scheme).  A plain local path is unknowable
+        — it may be a private disk or a shared mount — so automatic
+        pyspark routing stays conservative and only
+        :meth:`prepare_data_distributed` (an explicit claim by the
+        caller) uses it."""
         return False
 
     def _prepare_from_rdd(self, rdd, feature_cols, label_col,
@@ -706,6 +727,9 @@ class FsspecStore(FilesystemStore):
         proto = getattr(self._fs, "protocol", "")
         protos = {proto} if isinstance(proto, str) else set(proto)
         return "memory" in protos
+
+    def _executor_reachable(self) -> bool:
+        return not self._process_local()
 
     def upload_file(self, local: str, remote: str) -> None:
         """Streamed single-file upload — ``put_file`` transfers in

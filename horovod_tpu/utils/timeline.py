@@ -40,6 +40,48 @@ COMPILE = "COMPILE"
 UNFUSE = "UNFUSE"                  # MEMCPY_OUT_FUSION_BUFFER analogue
 
 
+class TraceAnnotationBridge:
+    """Mirrors timeline activity spans into ``jax.profiler``
+    TraceAnnotations — the device-trace correlation hook (SURVEY §5.1's
+    TPU mapping: "hook the same phase/activity event model into
+    Perfetto/jax.profiler").  A device trace captured under
+    ``jax.profiler.trace()`` then carries host ``hvd:ACTIVITY:tensor``
+    rows that line up 1:1 with the Chrome-trace QUEUE/NEGOTIATE/XLA_*
+    spans (docs/timeline.md "Overlaying with the device trace").
+    TraceMe no-ops when no profiler session is active, so the bridge is
+    free in normal runs.  Spans are entered/exited on the dispatching
+    thread (TraceMe is thread-local); both timeline writers (Python and
+    native) share this one bridge implementation."""
+
+    def __init__(self):
+        self._open: dict = {}
+
+    @staticmethod
+    def _annotation(name: str):
+        try:
+            import jax.profiler as _prof
+
+            return _prof.TraceAnnotation(name)
+        except Exception:       # profiler unavailable in this build
+            return None
+
+    def start(self, tensor_name: str, activity: str) -> None:
+        ann = self._annotation(f"hvd:{activity}:{tensor_name}")
+        if ann is not None:
+            ann.__enter__()
+            self._open[tensor_name] = ann
+
+    def end(self, tensor_name: str) -> None:
+        ann = self._open.pop(tensor_name, None)
+        if ann is not None:
+            ann.__exit__(None, None, None)
+
+    def clear(self) -> None:
+        # drop (don't cross-thread-exit) dangling spans at close:
+        # TraceMe is thread-local and spans end with the process anyway
+        self._open.clear()
+
+
 class Timeline:
     """Asynchronous Chrome-trace writer (reference ``TimelineWriter``).
 
@@ -58,6 +100,7 @@ class Timeline:
         # wall_origin_us + ts, the rebasing key for cross-process merge
         self.wall_origin_us = time.time_ns() / 1e3
         self._active: dict = {}
+        self._annotations = TraceAnnotationBridge()
         self._closed = False
         self._pid = os.getpid()
         self._file = open(filename, "w")
@@ -76,10 +119,12 @@ class Timeline:
         self._queue.put({"ph": "B", "name": activity, "cat": activity,
                          "tid": tensor_name, "pid": self._pid,
                          "ts": self._ts_us()})
+        self._annotations.start(tensor_name, activity)
 
     def end_activity(self, tensor_name: str) -> None:
         self._queue.put({"ph": "E", "tid": tensor_name, "pid": self._pid,
                          "ts": self._ts_us()})
+        self._annotations.end(tensor_name)
 
     def instant(self, name: str, args: Optional[dict] = None) -> None:
         self._queue.put({"ph": "i", "name": name, "s": "p",
@@ -108,6 +153,7 @@ class Timeline:
         if self._closed:
             return
         self._closed = True
+        self._annotations.clear()
         self._queue.put(None)
         self._writer.join(timeout=5)
         self._file.write("\n]\n")

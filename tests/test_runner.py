@@ -438,6 +438,73 @@ class TestNicDiscovery:
         finally:
             driver.shutdown()
 
+    def test_probe_cache_warm_hit_skips_probe(self, tmp_path):
+        """TTL-cached discovery (reference runner/util/cache.py): the
+        second launch against the same host set consults the on-disk
+        cache and spawns NO probe tasks; an expired entry re-probes."""
+        import threading
+
+        from horovod_tpu.runner.cache import DiscoveryCache
+        from horovod_tpu.runner.driver_service import (
+            probe_common_and_rank0,
+            run_probe_task,
+        )
+
+        spawns = []
+
+        def spawn(host, index, driver_addr):
+            spawns.append(index)
+            threading.Thread(target=run_probe_task,
+                             args=(driver_addr, index, "k"),
+                             daemon=True).start()
+
+        cache = DiscoveryCache(path=str(tmp_path / "cache.json"),
+                               ttl_s=3600)
+        hosts = ["localhost", "localhost"]
+        common, rank0 = probe_common_and_rank0(hosts, spawn, "k",
+                                               timeout_s=30, cache=cache)
+        assert common and rank0
+        assert len(spawns) == 2
+        # warm: same hosts, zero probe spawns, identical answer
+        common2, rank02 = probe_common_and_rank0(hosts, spawn, "k",
+                                                 timeout_s=30, cache=cache)
+        assert (common2, rank02) == (common, rank0)
+        assert len(spawns) == 2
+        # a different host set is a different key — probes again
+        probe_common_and_rank0(["localhost"], spawn, "k",
+                               timeout_s=30, cache=cache)
+        assert len(spawns) == 3
+        # expired: TTL 0 forces a fresh probe
+        expired = DiscoveryCache(path=str(tmp_path / "cache.json"),
+                                 ttl_s=0)
+        probe_common_and_rank0(hosts, spawn, "k", timeout_s=30,
+                               cache=expired)
+        assert len(spawns) == 5
+
+    def test_discovery_cache_roundtrip_and_expiry(self, tmp_path):
+        import time as _time
+
+        from horovod_tpu.runner.cache import DiscoveryCache
+
+        path = str(tmp_path / "c.json")
+        c = DiscoveryCache(path=path, ttl_s=3600)
+        assert c.get({"probe": ["a"]}) is None
+        c.put({"probe": ["a"]}, {"common": ["lo"], "rank0": {"lo": "1.1"}})
+        assert c.get({"probe": ["a"]})["common"] == ["lo"]
+        # key order must not matter
+        c.put({"b": 1, "a": 2}, "v")
+        assert DiscoveryCache(path=path, ttl_s=3600).get(
+            {"a": 2, "b": 1}) == "v"
+        # expiry honors the entry timestamp
+        short = DiscoveryCache(path=path, ttl_s=0.05)
+        short.put({"probe": ["x"]}, "soon-stale")
+        _time.sleep(0.1)
+        assert short.get({"probe": ["x"]}) is None
+        # corrupt file degrades to a miss, never a crash
+        with open(path, "w") as f:
+            f.write("{not json")
+        assert DiscoveryCache(path=path).get({"probe": ["a"]}) is None
+
     def test_probe_timeout_names_missing_tasks(self):
         from horovod_tpu.runner.driver_service import ProbeDriver
 
