@@ -112,7 +112,8 @@ def run_resnet(args, hvd):
 
     compute_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
     model = ResNet50(num_classes=1000, dtype=compute_dtype,
-                     space_to_depth=args.space_to_depth)
+                     space_to_depth=args.space_to_depth,
+                     fused_bwd=args.fused_bwd)
 
     def loss_fn(params, batch):
         logits = model.apply(params, batch["x"], train=False)
@@ -182,7 +183,8 @@ def run_transformer(args, hvd):
     cfg = TransformerConfig(
         vocab_size=32_000, num_layers=layers, num_heads=heads,
         d_model=d_model, d_ff=4 * d_model, max_seq_len=seq,
-        dtype=dtype, attention_impl=attn, remat=remat)
+        dtype=dtype, attention_impl=attn, remat=remat,
+        flash_block=args.tf_flash_block)
     model = TransformerLM(cfg)
 
     def loss_fn(params, batch):
@@ -305,6 +307,57 @@ def run_vit(args, hvd):
     }
 
 
+def run_autotune(args, hvd):
+    """``--autotune``: tune the jit-path knobs that set the BENCH
+    numbers (steps_per_call, flash block) against the measured rate —
+    the offline counterpart of the runtime ParameterManager (see
+    horovod_tpu/utils/bench_autotune.py).  Cold start: the seed is the
+    axis midpoint, NOT the hand-tuned default."""
+    import copy
+
+    from horovod_tpu.utils.bench_autotune import ThroughputAutotuner
+
+    if args.model not in ("resnet", "transformer"):
+        raise SystemExit(
+            "--autotune tunes one model's knobs per run; pass "
+            "--model resnet or --model transformer explicitly")
+    model = args.model
+    # short measurement windows: relative ranking needs ~2x2 timed
+    # calls per point, not the full bench's 5x5
+    base = copy.copy(args)
+    base.num_iters, base.num_batches_per_iter, base.num_warmup_batches = \
+        2, 2, 1
+
+    if model == "transformer":
+        axes = {"steps_per_call": [1, 5, 10, 20, 40],
+                "flash_block": [128, 256, 512, 1024]}
+
+        def measure(point):
+            a = copy.copy(base)
+            a.steps_per_call = point["steps_per_call"]
+            a.tf_flash_block = point["flash_block"]
+            return run_transformer(a, hvd)["transformer_tokens_per_sec"]
+    elif model == "resnet":
+        axes = {"steps_per_call": [1, 5, 10, 20, 40]}
+
+        def measure(point):
+            a = copy.copy(base)
+            a.steps_per_call = point["steps_per_call"]
+            return run_resnet(a, hvd)["value"]
+    else:
+        raise SystemExit(f"--autotune supports resnet/transformer, "
+                         f"not {model}")
+
+    log_path = args.autotune_log or f"autotune_{model}.csv"
+    tuner = ThroughputAutotuner(measure, axes, log_path=log_path)
+    best, rate = tuner.run()
+    return {"metric": f"autotune_{model}", "value": round(rate, 1),
+            "unit": ("img/sec/chip" if model == "resnet"
+                     else "tokens/sec/chip"),
+            "vs_baseline": None, "best_point": best,
+            "autotune_log": log_path}
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="both",
@@ -337,6 +390,10 @@ def main():
     p.add_argument("--no-space-to-depth", dest="space_to_depth",
                    action="store_false",
                    help="use the reference 7x7 stride-2 stem")
+    p.add_argument("--fused-bwd", action="store_true",
+                   help="fused one-pass Pallas backward for the ResNet "
+                        "stride-1 3x3 block segments (A/B candidate for "
+                        "the BN-reduction bottleneck)")
     p.add_argument("--tf-layers", type=int, default=16)
     p.add_argument("--tf-d-model", type=int, default=2048)
     p.add_argument("--tf-heads", type=int, default=16)
@@ -348,6 +405,16 @@ def main():
                         "activations in backward)")
     p.add_argument("--tf-attention", default="flash",
                    choices=["dense", "flash"])
+    p.add_argument("--tf-flash-block", type=int, default=512,
+                   help="flash-attention q/k block size (512 = round-4 "
+                        "measured winner)")
+    p.add_argument("--autotune", action="store_true",
+                   help="tune the jit-path throughput knobs "
+                        "(steps_per_call; flash block for the "
+                        "transformer) by measurement instead of running "
+                        "the plain bench; writes --autotune-log")
+    p.add_argument("--autotune-log", default=None,
+                   help="CSV sample log (default autotune_<model>.csv)")
     p.add_argument("--vit-batch-size", type=int, default=128,
                    help="ViT per-chip batch size (--model vit only)")
     p.add_argument("--vit-heads", type=int, default=12,
@@ -360,6 +427,9 @@ def main():
     import horovod_tpu as hvd
 
     hvd.init()
+    if args.autotune:
+        print(json.dumps(run_autotune(args, hvd)), flush=True)
+        return
     out = {}
     if args.model in ("both", "resnet"):
         out.update(run_resnet(args, hvd))

@@ -32,7 +32,9 @@ def build_step(batch_size, image_size, steps_per_call, lhs, s2d):
 
     hvd.init()
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16,
-                     space_to_depth=s2d)
+                     space_to_depth=s2d,
+                     fused_bwd=bool(int(os.environ.get(
+                         "HOROVOD_PROFILE_FUSED_BWD", "0"))))
 
     def loss_fn(params, batch):
         logits = model.apply(params, batch["x"], train=False)

@@ -25,12 +25,53 @@ import jax.numpy as jnp
 ModuleDef = Any
 
 
+class FusedConvBnRelu3x3(nn.Module):
+    """The block's 3x3 segment with the one-pass Pallas backward.
+
+    Forward is plain XLA (conv + affine + relu fuse optimally there);
+    the backward is :func:`~horovod_tpu.ops.pallas_kernels.
+    fused_conv_bn_relu_bwd` — relu mask, BN dgamma/dbeta reductions, BN
+    input scaling, and both conv gradients in ONE pass over the
+    tensors, instead of XLA's extra VPU-bound convert+reduce streams
+    (the measured ResNet bottleneck, PERF_NOTES.md).  Inference-mode BN
+    only (frozen running stats — the synthetic bench's training
+    configuration); param/stat names match nn.Conv/nn.BatchNorm but
+    nest under this module, so the pytree differs from the unfused
+    block — a bench-mode option, not a checkpoint-compatible toggle."""
+
+    features: int
+    dtype: Any = jnp.float32
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        from horovod_tpu.ops.pallas_kernels import fused_conv_bn_relu
+
+        cin = x.shape[-1]
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (3, 3, cin, self.features), jnp.float32)
+        gamma = self.param("scale", nn.initializers.ones_init(),
+                           (self.features,), jnp.float32)
+        beta = self.param("bias", nn.initializers.zeros_init(),
+                          (self.features,), jnp.float32)
+        mean = self.variable("batch_stats", "mean",
+                             lambda: jnp.zeros((self.features,),
+                                               jnp.float32))
+        var = self.variable("batch_stats", "var",
+                            lambda: jnp.ones((self.features,),
+                                             jnp.float32))
+        return fused_conv_bn_relu(x.astype(self.dtype), kernel, gamma,
+                                  beta, mean.value, var.value,
+                                  eps=self.eps)
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: Tuple[int, int]
     conv: ModuleDef
     norm: ModuleDef
     act: Callable
+    fused_bwd: bool = False   # inference-BN segments only (see ResNet)
 
     @nn.compact
     def __call__(self, x):
@@ -38,9 +79,12 @@ class BottleneckBlock(nn.Module):
         y = self.conv(self.filters, (1, 1))(x)
         y = self.norm()(y)
         y = self.act(y)
-        y = self.conv(self.filters, (3, 3), self.strides)(y)
-        y = self.norm()(y)
-        y = self.act(y)
+        if self.fused_bwd and self.strides == (1, 1):
+            y = FusedConvBnRelu3x3(self.filters, dtype=y.dtype)(y)
+        else:
+            y = self.conv(self.filters, (3, 3), self.strides)(y)
+            y = self.norm()(y)
+            y = self.act(y)
         y = self.conv(self.filters * 4, (1, 1))(y)
         y = self.norm(scale_init=nn.initializers.zeros_init())(y)
         if residual.shape != y.shape:
@@ -74,6 +118,11 @@ class ResNet(nn.Module):
     # a dense (112,112,12)->64 conv instead (the standard MLPerf TPU
     # ResNet trick; measured ~+2% end-to-end on v5e, PERF_NOTES.md).
     space_to_depth: bool = False
+    # fused one-pass Pallas backward for stride-1 3x3 block segments
+    # (FusedConvBnRelu3x3).  Only meaningful with inference-mode BN
+    # (train=False — the bench configuration); applied automatically
+    # only then.  Changes the param-tree shape of those segments.
+    fused_bwd: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -93,11 +142,13 @@ class ResNet(nn.Module):
         x = norm(name="bn_init")(x)
         x = act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        fused = self.fused_bwd and not train
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
                 x = BottleneckBlock(self.num_filters * 2 ** i, strides,
-                                    conv, norm, act)(x)
+                                    conv, norm, act,
+                                    fused_bwd=fused)(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
         return x

@@ -52,6 +52,8 @@ class TransformerConfig:
     max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16
     attention_impl: str = "dense"       # dense | flash | ring | ulysses
+    flash_block: int = 512              # flash q/k block (512 = round-4
+                                        # measured winner; autotunable)
     causal: bool = True                 # False: bidirectional (ViT/BERT)
     sp_axis: str = AXIS_SP
     tp_axis: str = AXIS_TP
@@ -134,7 +136,9 @@ class Attention(nn.Module):
         elif cfg.attention_impl == "flash":
             from horovod_tpu.ops.pallas_kernels import flash_attention
 
-            o = flash_attention(q, k, v, causal=cfg.causal)
+            o = flash_attention(q, k, v, causal=cfg.causal,
+                                block_q=cfg.flash_block,
+                                block_k=cfg.flash_block)
         elif cfg.attention_impl == "ring":
             o = ring_attention(q, k, v, cfg.sp_axis, causal=cfg.causal)
         elif cfg.attention_impl == "ulysses":

@@ -375,3 +375,238 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     _attn.defvjp(_fwd, _bwd)
     return _attn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# fused bottleneck-segment backward (conv3x3 + inference-BN + relu)
+# ---------------------------------------------------------------------------
+#
+# ResNet's measured gap (PERF_NOTES.md): the XLA backward spends ~35% of
+# the step in VPU-bound BN dgamma/dbeta convert+reduce fusions that
+# re-stream the gradient/activation tensors from HBM after the conv
+# backward already read them.  This kernel computes the WHOLE backward
+# of the block segment  b = relu(bn(conv3x3(a)))  (inference-mode BN —
+# frozen running stats, the synthetic-bench training configuration) in
+# one pass:
+#
+#   dz      = db * (b > 0)                  (relu)
+#   dbeta  += sum(dz);  dgamma += sum(dz * yhat)      (BN param grads)
+#   dy      = dz * gamma/sigma                        (BN input grad)
+#   dW[tap] += a_shifted^T @ dy             (9 tap matmuls, MXU)
+#   da      = sum_tap dy_shifted @ W[tap]^T (9 tap matmuls, MXU)
+#
+# so db/b/a cross HBM exactly once and the channel reductions ride the
+# VMEM tiles the matmuls already hold.  The reference has no analogue —
+# cuDNN owns its conv backward — this is the "fuse across the block
+# boundary" lever the round-4 review left on the table.
+
+def _cbr_bwd_kernel(db_ref, b_ref, ap_ref, w_ref, beta_ref, gamma_ref,
+                    seff_ref, da_ref, dw_ref, dgamma_ref, dbeta_ref,
+                    dypad_ref, *, hh: int, ww: int):
+    """Grid is (batch_tiles,) with the 9-tap loop unrolled in the body.
+
+    Accumulator layout constraint: Pallas TPU output windows are only
+    defined across CONSECUTIVE same-index grid steps, so every
+    accumulated output (dW, dgamma, dbeta) must keep a constant block
+    index over the whole grid — a tap-in-the-grid variant (dW blocked
+    per tap, revisited once per tile) silently accumulates into stale
+    buffers on hardware.  The price of the unrolled body is Mosaic
+    stack pressure (~48 B/tile element live), paid for with a smaller
+    batch tile (see the caller's budget)."""
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+        dgamma_ref[...] = jnp.zeros_like(dgamma_ref)
+        dbeta_ref[...] = jnp.zeros_like(dbeta_ref)
+
+    db = db_ref[...].astype(jnp.float32)          # (nb, H, W, C)
+    b = b_ref[...].astype(jnp.float32)
+    beta = beta_ref[0]                            # (C,)
+    gamma = gamma_ref[0]
+    seff = seff_ref[0]
+
+    dz = jnp.where(b > 0, db, 0.0)
+    dbeta_ref[...] += jnp.broadcast_to(
+        dz.sum((0, 1, 2))[None, :], dbeta_ref.shape)
+    # yhat = (z - beta)/gamma; on active lanes z == b, on inactive ones
+    # dz == 0 annihilates the (wrong) yhat — no mask needed.  gamma==0
+    # destroys the information needed to recover yhat from the relu
+    # output at all (z is constant beta), so the safe divide pins that
+    # channel's dgamma to 0 instead of NaN (docstring caveat in
+    # fused_conv_bn_relu).
+    gamma_safe = jnp.where(jnp.abs(gamma) < 1e-12, 1.0, gamma)
+    dgamma_ref[...] += jnp.broadcast_to(
+        (dz * ((b - beta) / gamma_safe)).sum((0, 1, 2))[None, :],
+        dgamma_ref.shape)
+
+    dy = (dz * seff).astype(db_ref.dtype)         # conv-output grad
+    nb, h, w, c = dy.shape
+    rows = nb * h * w
+    dy2 = dy.reshape(rows, c)
+
+    # dW[tap] += a_pad[:, kh:kh+H, kw:kw+W]^T @ dy   (contract rows)
+    for kh in range(3):
+        for kw in range(3):
+            a_tap = ap_ref[:, kh:kh + hh, kw:kw + ww, :] \
+                .reshape(rows, ap_ref.shape[-1])
+            dw_ref[3 * kh + kw] += jax.lax.dot_general(
+                a_tap, dy2, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    # da = sum_tap dy_pad[:, 2-kh : 2-kh+H, 2-kw : 2-kw+W] @ W[tap]^T
+    dypad_ref[...] = jnp.zeros_like(dypad_ref)
+    dypad_ref[:, 1:hh + 1, 1:ww + 1, :] = dy
+    acc = None
+    for kh in range(3):
+        for kw in range(3):
+            d_tap = dypad_ref[:, 2 - kh:2 - kh + hh,
+                              2 - kw:2 - kw + ww, :].reshape(rows, c)
+            part = jax.lax.dot_general(
+                d_tap, w_ref[3 * kh + kw], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            acc = part if acc is None else acc + part
+    da_ref[...] = acc.reshape(da_ref.shape).astype(da_ref.dtype)
+
+
+def _cbr_bwd_reference(db, b, a, w, gamma, beta, scale_eff):
+    """jnp oracle of the fused backward (also the off-TPU fallback):
+    relu/BN grads by hand, conv grads through jax.vjp of the forward
+    conv — exactly what XLA autodiff produces, unfused."""
+    f32 = jnp.float32
+    dz = jnp.where(b > 0, db.astype(f32), 0.0)
+    dbeta = dz.sum((0, 1, 2))
+    gamma_safe = jnp.where(jnp.abs(gamma) < 1e-12, 1.0, gamma)
+    dgamma = (dz * ((b.astype(f32) - beta) / gamma_safe)).sum((0, 1, 2))
+    dy = (dz * scale_eff).astype(a.dtype)
+    dn = jax.lax.conv_dimension_numbers(a.shape, w.shape,
+                                        ("NHWC", "HWIO", "NHWC"))
+
+    def conv(a_, w_):
+        return jax.lax.conv_general_dilated(
+            a_, w_, (1, 1), "SAME", dimension_numbers=dn)
+
+    _, vjp = jax.vjp(conv, a, w.astype(a.dtype))
+    da, dw = vjp(dy)
+    return da, dw.astype(f32), dgamma, dbeta
+
+
+def fused_conv_bn_relu_bwd(db, b, a, w, gamma, beta, scale_eff,
+                           interpret: bool = False):
+    """Backward of ``relu(bn_inference(conv3x3_same(a, w)))``.
+
+    Returns ``(da, dw, dgamma, dbeta)``.  One fused pass on TPU (see
+    the kernel above); jnp fallback elsewhere or for shapes outside the
+    tiling contract (stride-1 SAME 3x3, channels a lane multiple).
+    """
+    n, hh, ww, cin = a.shape
+    c = w.shape[-1]
+    # the dW accumulator (9*Cin*C fp32) lives in VMEM for the whole
+    # grid; past 256x256 channels it plus the tiles exceeds the ~16 MB
+    # scoped-vmem budget (measured: 512x512 OOMs at 19.3 MB), so wide
+    # segments keep the XLA path — the dominant stages (PERF_NOTES
+    # profile) are the 128/256-channel ones anyway
+    dw_bytes = 9 * cin * c * 4
+    usable = (interpret or _on_tpu()) and w.shape[:2] == (3, 3) and \
+        c % 128 == 0 and cin % 128 == 0 and db.shape == b.shape and \
+        db.shape[:3] == (n, hh, ww) and dw_bytes <= 2_400_000
+    if not usable:
+        return _cbr_bwd_reference(db, b, a, w, gamma, beta, scale_eff)
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    # batch tile: keep dW + the per-tile working set within the 16 MB
+    # scoped-vmem budget.  The unrolled 9-tap body keeps ~48 B of live
+    # temporaries per tile element on the Mosaic stack (measured:
+    # 21.3 MB at nb=8, 14x14x256); nb must divide N
+    tile_budget = max(10e6 - dw_bytes, 1e6)
+    target = max(1, int(tile_budget // (hh * ww * max(c, cin) * 48)))
+    nb = 1
+    while nb * 2 <= min(target, n) and n % (nb * 2) == 0:
+        nb *= 2
+    grid = (n // nb,)
+
+    a_pad = jnp.pad(a, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    w9 = w.astype(jnp.float32).reshape(9, cin, c)
+    rep = (8, c)
+    gamma8 = jnp.broadcast_to(gamma.astype(jnp.float32)[None, :], rep)
+    beta8 = jnp.broadcast_to(beta.astype(jnp.float32)[None, :], rep)
+    seff8 = jnp.broadcast_to(scale_eff.astype(jnp.float32)[None, :], rep)
+
+    da, dw, dgamma8, dbeta8 = pl.pallas_call(
+        functools.partial(_cbr_bwd_kernel, hh=hh, ww=ww),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((nb, hh, ww, c), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((nb, hh, ww, c), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((nb, hh + 2, ww + 2, cin),
+                         lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((9, cin, c), lambda i: (0, 0, 0)),
+            pl.BlockSpec(rep, lambda i: (0, 0)),
+            pl.BlockSpec(rep, lambda i: (0, 0)),
+            pl.BlockSpec(rep, lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((nb, hh, ww, cin), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((9, cin, c), lambda i: (0, 0, 0)),
+            pl.BlockSpec(rep, lambda i: (0, 0)),
+            pl.BlockSpec(rep, lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(a.shape, a.dtype),
+            jax.ShapeDtypeStruct((9, cin, c), jnp.float32),
+            jax.ShapeDtypeStruct(rep, jnp.float32),
+            jax.ShapeDtypeStruct(rep, jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((nb, hh + 2, ww + 2, c), db.dtype),
+        ],
+        interpret=interpret,
+    )(db, b, a_pad, w9, beta8, gamma8, seff8)
+    return da, dw.reshape(w.shape), dgamma8[0], dbeta8[0]
+
+
+def fused_conv_bn_relu(a, w, gamma, beta, mean, var,
+                       eps: float = 1e-5, interpret: bool = False):
+    """``relu(bn_inference(conv3x3_same(a, w)))`` with the one-pass
+    fused backward above wired in via custom_vjp.  The forward stays
+    plain XLA (its conv+affine+relu already fuse optimally); only the
+    backward — where XLA re-streams tensors for the channel reductions
+    — is replaced.  ``mean``/``var`` are frozen running stats and get
+    zero gradients (they are buffers, not parameters).
+
+    Caveat: dgamma is reconstructed from the relu output as
+    ``sum(dz * (z - beta)/gamma)`` — only the relu output is saved, so
+    a channel whose ``gamma`` reaches exactly 0 has no recoverable
+    normalized activation and its dgamma is pinned to 0 (instead of
+    NaN).  Autodiff of the unfused segment (which saves the conv
+    output) stays exact there; don't enable the fused path if BN
+    scales are expected to cross zero."""
+
+    @jax.custom_vjp
+    def _run(a, w, gamma, beta, mean, var):
+        return _fwd(a, w, gamma, beta, mean, var)[0]
+
+    def _fwd(a, w, gamma, beta, mean, var):
+        dn = jax.lax.conv_dimension_numbers(a.shape, w.shape,
+                                            ("NHWC", "HWIO", "NHWC"))
+        y = jax.lax.conv_general_dilated(a, w.astype(a.dtype), (1, 1),
+                                         "SAME", dimension_numbers=dn)
+        scale_eff = (gamma / jnp.sqrt(var + eps)).astype(jnp.float32)
+        z = y.astype(jnp.float32) * scale_eff + \
+            (beta - mean * scale_eff)
+        out = jnp.maximum(z, 0.0).astype(a.dtype)
+        return out, (a, w, out, gamma, beta, scale_eff, mean, var)
+
+    def _bwd(res, db):
+        a, w, out, gamma, beta, scale_eff, mean, var = res
+        da, dw, dgamma, dbeta = fused_conv_bn_relu_bwd(
+            db, out, a, w, gamma.astype(jnp.float32),
+            beta.astype(jnp.float32), scale_eff, interpret=interpret)
+        return (da, dw.astype(w.dtype), dgamma.astype(gamma.dtype),
+                dbeta.astype(beta.dtype), jnp.zeros_like(mean),
+                jnp.zeros_like(var))
+
+    _run.defvjp(_fwd, _bwd)
+    return _run(a, w, gamma, beta, mean, var)

@@ -1,0 +1,105 @@
+"""Offline throughput autotuning of jit-path knobs.
+
+The runtime :class:`~horovod_tpu.utils.autotune.ParameterManager` tunes
+the *eager* plane's knobs online by bytes/sec — the reference
+``parameter_manager.{h,cc}`` lifecycle.  The jit data plane's
+throughput knobs (``steps_per_call``, the flash-attention block size,
+compile options) cannot move mid-jit: every candidate needs a fresh
+compile, so they are tuned *offline* by this driver against the real
+measured objective (images/sec, tokens/sec) — the knobs that actually
+move BENCH numbers, per the reference's point that autotuning exists
+for the perf-critical parameters (``parameter_manager.h:58-78``).
+
+Strategy: coordinate descent over small categorical axes with
+memoization.  The per-axis responses are unimodal in practice (the
+round-4 hand scans in PERF_NOTES.md: flash block 128→59%, 256→66%,
+512→69% peak, 1024→68.7%; steps_per_call saturating), so cycling the
+axes to a fixed point finds the grid optimum in far fewer compiles
+than the full cross product.  Every sample lands in a CSV log — the
+same artifact shape as the online manager's autotune log.
+
+Entry point: ``python bench.py --model transformer --autotune``.
+"""
+
+from __future__ import annotations
+
+import csv
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from horovod_tpu.utils import logging as hvd_logging
+
+
+class ThroughputAutotuner:
+    """Maximize ``measure(point)`` over a categorical grid.
+
+    ``axes`` maps knob name → candidate values (order defines the scan
+    order).  ``measure`` builds + runs the workload at a point and
+    returns units/sec; each unique point is measured once (memoized).
+    ``seed`` picks the starting point (default: middle of each axis —
+    a deliberately un-tuned cold start).
+    """
+
+    def __init__(self, measure: Callable[[Dict], float],
+                 axes: Dict[str, List],
+                 seed: Optional[Dict] = None,
+                 log_path: Optional[str] = None,
+                 max_rounds: int = 3):
+        self._measure = measure
+        self._axes = {k: list(v) for k, v in axes.items()}
+        self._seed = dict(seed) if seed else \
+            {k: v[len(v) // 2] for k, v in self._axes.items()}
+        self._log_path = log_path
+        self._max_rounds = max_rounds
+        self._cache: Dict[Tuple, float] = {}
+        self._rows: List[dict] = []
+
+    def _key(self, point: Dict) -> Tuple:
+        return tuple(point[k] for k in self._axes)
+
+    def _score(self, point: Dict) -> float:
+        key = self._key(point)
+        if key in self._cache:
+            return self._cache[key]
+        t0 = time.monotonic()
+        rate = float(self._measure(dict(point)))
+        self._cache[key] = rate
+        self._rows.append(dict(point, units_per_sec=rate,
+                               measure_seconds=round(
+                                   time.monotonic() - t0, 1)))
+        hvd_logging.info("autotune: %s -> %.1f/sec", point, rate)
+        return rate
+
+    def run(self) -> Tuple[Dict, float]:
+        """Coordinate-descend to a fixed point; returns
+        ``(best_point, best_rate)`` and writes the log."""
+        current = dict(self._seed)
+        for _round in range(self._max_rounds):
+            moved = False
+            for knob, values in self._axes.items():
+                scored = [(self._score(dict(current, **{knob: v})), v)
+                          for v in values]
+                best_rate, best_v = max(scored)
+                if best_v != current[knob]:
+                    current[knob] = best_v
+                    moved = True
+            if not moved:
+                break
+        best = max(self._cache.items(), key=lambda kv: kv[1])
+        point = dict(zip(self._axes, best[0]))
+        self._write_log(point, best[1])
+        return point, best[1]
+
+    def _write_log(self, best_point: Dict, best_rate: float) -> None:
+        if not self._log_path or not self._rows:
+            return
+        rows = [dict(r, best="") for r in self._rows]
+        for r in rows:
+            if all(r[k] == best_point[k] for k in self._axes):
+                r["best"] = "*"
+        with open(self._log_path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=list(rows[0]))
+            w.writeheader()
+            w.writerows(rows)
+        hvd_logging.info("autotune: winner %s at %.1f/sec; log at %s",
+                         best_point, best_rate, self._log_path)

@@ -54,25 +54,35 @@ class TraceAnnotationBridge:
     native) share this one bridge implementation."""
 
     def __init__(self):
-        self._open: dict = {}
-
-    @staticmethod
-    def _annotation(name: str):
+        self._open: dict = {}      # (thread id, tensor) -> annotation
+        # resolve the class ONCE — this sits on the per-tensor eager
+        # hot path, where a try/import per event would not be "free"
         try:
             import jax.profiler as _prof
 
-            return _prof.TraceAnnotation(name)
-        except Exception:       # profiler unavailable in this build
-            return None
+            self._cls = _prof.TraceAnnotation
+        except Exception:          # profiler unavailable in this build
+            self._cls = None
+
+    def _annotation(self, name: str):
+        return None if self._cls is None else self._cls(name)
 
     def start(self, tensor_name: str, activity: str) -> None:
+        # keyed by (thread, tensor): TraceMe spans are thread-local, so
+        # an end_activity arriving on another thread must NOT exit this
+        # span (it is dropped instead — an open leftover span in one
+        # lane beats a corrupted track), and a duplicate in-flight
+        # start for the same tensor keeps the first span
+        key = (threading.get_ident(), tensor_name)
+        if key in self._open:
+            return
         ann = self._annotation(f"hvd:{activity}:{tensor_name}")
         if ann is not None:
             ann.__enter__()
-            self._open[tensor_name] = ann
+            self._open[key] = ann
 
     def end(self, tensor_name: str) -> None:
-        ann = self._open.pop(tensor_name, None)
+        ann = self._open.pop((threading.get_ident(), tensor_name), None)
         if ann is not None:
             ann.__exit__(None, None, None)
 

@@ -90,3 +90,60 @@ class TestParameterManagerLifecycle:
                 break
             pm.record_bytes(1 << 20)
         assert cfg.fusion_threshold_bytes == 123456
+
+
+class TestThroughputAutotuner:
+    """Offline jit-knob tuner (bench.py --autotune): coordinate descent
+    with memoization over the knobs that move measured throughput."""
+
+    def _surface(self, calls):
+        # unimodal on both axes, peak at (20, 512) — the shape of the
+        # round-4 hand scans in PERF_NOTES.md
+        spc_gain = {1: 0.6, 5: 0.85, 10: 0.95, 20: 1.0, 40: 0.98}
+        blk_gain = {128: 0.85, 256: 0.95, 512: 1.0, 1024: 0.99}
+
+        def measure(point):
+            calls.append(dict(point))
+            return 25_000 * spc_gain[point["steps_per_call"]] * \
+                blk_gain[point["flash_block"]]
+
+        return measure
+
+    def test_finds_grid_optimum_with_memoized_samples(self, tmp_path):
+        from horovod_tpu.utils.bench_autotune import ThroughputAutotuner
+
+        calls = []
+        log = tmp_path / "at.csv"
+        tuner = ThroughputAutotuner(
+            self._surface(calls),
+            {"steps_per_call": [1, 5, 10, 20, 40],
+             "flash_block": [128, 256, 512, 1024]},
+            log_path=str(log))
+        best, rate = tuner.run()
+        assert best == {"steps_per_call": 20, "flash_block": 512}
+        assert rate == 25_000
+        # memoization: far fewer measurements than the 20-point cross
+        # product, and no point measured twice
+        keys = [tuple(sorted(c.items())) for c in calls]
+        assert len(keys) == len(set(keys))
+        assert len(keys) <= 9
+        # log artifact: every sample + the starred winner
+        rows = log.read_text().splitlines()
+        assert "units_per_sec" in rows[0] and "best" in rows[0]
+        assert sum(1 for r in rows[1:] if r.endswith("*")) == 1
+
+    def test_seed_and_single_axis(self, tmp_path):
+        from horovod_tpu.utils.bench_autotune import ThroughputAutotuner
+
+        calls = []
+
+        def measure(point):
+            calls.append(dict(point))
+            return {1: 1.0, 5: 3.0, 10: 2.0}[point["steps_per_call"]]
+
+        tuner = ThroughputAutotuner(
+            measure, {"steps_per_call": [1, 5, 10]},
+            seed={"steps_per_call": 1})
+        best, rate = tuner.run()
+        assert best == {"steps_per_call": 5} and rate == 3.0
+        assert len(calls) == 3

@@ -163,3 +163,126 @@ class TestBlockFitting:
         expected = reference_attention(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
                                    rtol=2e-5, atol=2e-5)
+
+
+class TestFusedConvBnReluBwd:
+    """One-pass backward of relu(bn_inference(conv3x3)) — the ResNet
+    block-segment kernel.  Oracle: jax.grad of the unfused segment."""
+
+    def _setup(self, n=4, h=6, w=6, cin=128, c=128, dtype=jnp.float32):
+        import numpy as np
+
+        rng = np.random.RandomState(0)
+        a = jnp.asarray(rng.randn(n, h, w, cin), dtype)
+        k = jnp.asarray(rng.randn(3, 3, cin, c) * 0.05, jnp.float32)
+        gamma = jnp.asarray(rng.rand(c) + 0.5, jnp.float32)
+        beta = jnp.asarray(rng.randn(c) * 0.1, jnp.float32)
+        mean = jnp.asarray(rng.randn(c) * 0.1, jnp.float32)
+        var = jnp.asarray(rng.rand(c) + 0.5, jnp.float32)
+        cot = jnp.asarray(rng.randn(n, h, w, c), dtype)
+        return a, k, gamma, beta, mean, var, cot
+
+    @staticmethod
+    def _unfused(a, k, gamma, beta, mean, var):
+        dn = jax.lax.conv_dimension_numbers(
+            a.shape, k.shape, ("NHWC", "HWIO", "NHWC"))
+        y = jax.lax.conv_general_dilated(
+            a, k.astype(a.dtype), (1, 1), "SAME", dimension_numbers=dn)
+        s = gamma / jnp.sqrt(var + 1e-5)
+        z = y.astype(jnp.float32) * s + (beta - mean * s)
+        return jnp.maximum(z, 0.0).astype(a.dtype)
+
+    def test_matches_autodiff_of_unfused_segment(self):
+        from horovod_tpu.ops.pallas_kernels import fused_conv_bn_relu
+
+        a, k, gamma, beta, mean, var, cot = self._setup()
+
+        def loss_u(a, k, gamma, beta):
+            return (self._unfused(a, k, gamma, beta, mean, var)
+                    .astype(jnp.float32) * cot).sum()
+
+        def loss_f(a, k, gamma, beta):
+            return (fused_conv_bn_relu(a, k, gamma, beta, mean, var,
+                                       interpret=True)
+                    .astype(jnp.float32) * cot).sum()
+
+        import numpy as np
+
+        np.testing.assert_allclose(
+            self._unfused(a, k, gamma, beta, mean, var),
+            fused_conv_bn_relu(a, k, gamma, beta, mean, var,
+                               interpret=True), rtol=2e-5, atol=2e-5)
+        gu = jax.grad(loss_u, argnums=(0, 1, 2, 3))(a, k, gamma, beta)
+        gf = jax.grad(loss_f, argnums=(0, 1, 2, 3))(a, k, gamma, beta)
+        for name, u, f in zip(("da", "dw", "dgamma", "dbeta"), gu, gf):
+            np.testing.assert_allclose(u, f, rtol=2e-4, atol=2e-4,
+                                       err_msg=name)
+
+    def test_odd_batch_and_bigger_spatial(self):
+        """nb must divide N (grid tiling): N=3 forces nb=1, H=W=10
+        exercises multi-row padding slices."""
+        import numpy as np
+
+        from horovod_tpu.ops.pallas_kernels import (
+            _cbr_bwd_reference,
+            fused_conv_bn_relu_bwd,
+        )
+
+        a, k, gamma, beta, mean, var, cot = self._setup(n=3, h=10, w=10)
+        s = gamma / jnp.sqrt(var + 1e-5)
+        b = self._unfused(a, k, gamma, beta, mean, var)
+        got = fused_conv_bn_relu_bwd(cot, b, a, k, gamma, beta, s,
+                                     interpret=True)
+        want = _cbr_bwd_reference(cot, b, a, k, gamma, beta, s)
+        for name, g, w_ in zip(("da", "dw", "dgamma", "dbeta"), got, want):
+            np.testing.assert_allclose(g, w_, rtol=2e-4, atol=2e-4,
+                                       err_msg=name)
+
+    def test_non_lane_channels_fall_back(self):
+        """C not a 128-multiple stays on the jnp fallback (identical
+        numerics by construction) — never a Mosaic lowering risk."""
+        import numpy as np
+
+        from horovod_tpu.ops.pallas_kernels import (
+            _cbr_bwd_reference,
+            fused_conv_bn_relu_bwd,
+        )
+
+        a, k, gamma, beta, mean, var, cot = self._setup(cin=64, c=64)
+        s = gamma / jnp.sqrt(var + 1e-5)
+        b = self._unfused(a, k, gamma, beta, mean, var)
+        got = fused_conv_bn_relu_bwd(cot, b, a, k, gamma, beta, s)
+        want = _cbr_bwd_reference(cot, b, a, k, gamma, beta, s)
+        for g, w_ in zip(got, want):
+            np.testing.assert_allclose(g, w_, rtol=1e-6)
+
+    def test_resnet_fused_flag_trains(self, hvd_runtime):
+        """ResNet50(fused_bwd=True) wires the custom-vjp segments into
+        a real train step (CPU falls back to the identical-numerics jnp
+        path; the kernel itself is covered in interpret mode above)."""
+        import numpy as np
+        import optax
+
+        from horovod_tpu.models.resnet import ResNet50
+
+        hvd = hvd_runtime
+        model = ResNet50(num_classes=10, fused_bwd=True)
+
+        def loss_fn(params, batch):
+            import optax as _optax
+
+            logits = model.apply(params, batch["x"], train=False)
+            return _optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["y"]).mean()
+
+        step = hvd.DistributedTrainStep(loss_fn, optax.sgd(0.01))
+        x0 = jnp.zeros((1, 32, 32, 3), jnp.float32)
+        params, opt = step.init(jax.jit(
+            lambda kk: model.init(kk, x0, train=False))(
+                jax.random.PRNGKey(0)))
+        rng = np.random.RandomState(0)
+        batch = step.shard_batch({
+            "x": jnp.asarray(rng.rand(16, 32, 32, 3), jnp.float32),
+            "y": jnp.asarray(rng.randint(0, 10, (16,)), jnp.int32)})
+        params, opt, loss = step(params, opt, batch)
+        assert np.isfinite(float(loss))
