@@ -124,6 +124,68 @@ class TestTrainStepFusion:
             [(o.bytes, o.line) for o in global_ars]
 
 
+class TestModelParallelCollectives:
+    def test_tp_block_costs_exactly_one_psum(self, hvd_runtime):
+        """Column→row parallel MLP block under jit over a tp mesh:
+        exactly ONE all-reduce (the row-parallel psum) and ZERO
+        all-gathers — the Megatron cost contract.  Guards the
+        regression where the modules' partitioning metadata stops
+        reaching GSPMD and the 'tensor-parallel' block silently runs
+        replicated with no collectives at all (the exact state this
+        test was written against)."""
+        from horovod_tpu.parallel.mesh import make_parallel_mesh
+        from horovod_tpu.parallel.tensor_parallel import (
+            ColumnParallelDense,
+            RowParallelDense,
+        )
+
+        mesh = make_parallel_mesh(tp=8, devices=jax.devices("cpu")[:8])
+
+        class TpMlp(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                h = ColumnParallelDense(256, axis="tp")(x)
+                h = nn.gelu(h)
+                return RowParallelDense(128, axis="tp")(h)
+
+        model = TpMlp()
+        x = jax.random.normal(jax.random.PRNGKey(0), (16, 128),
+                              jnp.float32)
+        variables = model.init(jax.random.PRNGKey(1), x)
+        with mesh:
+            txt = jax.jit(model.apply).lower(variables, x).compile() \
+                .as_text()
+        ops = H.collective_ops(txt)
+        assert H.count_by_kind(ops) == {"all-reduce": 1}, \
+            [o.line for o in ops]
+        (ar,) = ops
+        assert ar.bytes == 16 * 128 * 4     # the block output, once
+
+    def test_ring_attention_permutes_never_gathers(self, hvd_runtime):
+        """Ring attention's compiled form moves K/V by collective
+        permutes only — an all-gather would mean the O(T) sequence
+        memory scaling silently regressed to O(T·sp)."""
+        from horovod_tpu.parallel.mesh import make_parallel_mesh
+        from horovod_tpu.parallel.ring_attention import ring_attention
+
+        mesh = make_parallel_mesh(sp=8, devices=jax.devices("cpu")[:8])
+        q, k, v = (jax.random.normal(jax.random.PRNGKey(i),
+                                     (2, 64, 4, 16), jnp.float32)
+                   for i in range(3))
+
+        def f(q, k, v):
+            return ring_attention(q, k, v, "sp", causal=False)
+
+        sm = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"), check_vma=False))
+        ops = H.collective_ops(sm.lower(q, k, v).compile().as_text())
+        kinds = H.count_by_kind(ops)
+        assert kinds.get("collective-permute", 0) >= 1, kinds
+        assert kinds.get("all-gather", 0) == 0, kinds
+        assert kinds.get("all-reduce", 0) == 0, kinds
+
+
 class TestGroupedAllreduceFusion:
     def test_grouped_mixed_dtypes_one_collective(self, hvd_runtime):
         """grouped_allreduce with mixed f32/bf16 leaves lowers to ONE
