@@ -307,6 +307,108 @@ def run_vit(args, hvd):
     }
 
 
+def run_moe(args, hvd):
+    """Opt-in (--model moe) fourth benchmark family: Switch-MoE LM.
+
+    Single-chip measurement runs the experts in local mode (all
+    resident); the ep_axis dispatch plane is exercised by the dryrun
+    and the virtual-mesh tests.  MFU is computed against ACTIVE
+    FLOPs/token (top-1 routing: one expert per token), the standard
+    MoE accounting."""
+    from horovod_tpu.models import MoEConfig, MoETransformerLM, moe_aux_loss
+
+    n_chips = hvd.size()
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        layers, d_model, heads, seq, batch, dtype, experts = \
+            2, 128, 4, 128, 4, jnp.float32, 4
+    else:
+        layers, d_model, heads, seq, batch, dtype, experts = (
+            args.moe_layers, args.moe_d_model, args.moe_heads,
+            args.tf_seq_len, args.moe_batch_size, jnp.bfloat16,
+            args.moe_experts)
+    spc = args.steps_per_call if platform == "tpu" else 1
+    log(f"bench[moe]: {n_chips} chip(s) on {platform}, "
+        f"{layers}L/{d_model}d/{heads}h, {experts} experts "
+        f"(moe_every 2), seq {seq}, batch {batch}/chip, "
+        f"steps_per_call {spc}")
+
+    cfg = MoEConfig(
+        vocab_size=32_000, num_layers=layers, num_heads=heads,
+        d_model=d_model, d_ff=4 * d_model, max_seq_len=seq, dtype=dtype,
+        attention_impl="flash" if platform == "tpu" else "dense",
+        flash_block=args.tf_flash_block, num_experts=experts,
+        capacity_factor=1.25, moe_every=2)
+    model = MoETransformerLM(cfg)
+
+    def loss_fn(params, batch):
+        logits, state = model.apply({"params": params}, batch["inputs"],
+                                    mutable=["intermediates"])
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["labels"]).mean()
+        return ce + 0.01 * moe_aux_loss(state["intermediates"])
+
+    step = hvd.DistributedTrainStep(
+        loss_fn, optax.adamw(3e-4), steps_per_call=spc,
+        compiler_options=tpu_compiler_options(args))
+    tokens0 = jnp.zeros((1, seq), jnp.int32)
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0), tokens0)
+    leaves = jax.tree_util.tree_flatten_with_path(variables["params"])[0]
+    nparams = sum(x.size for _, x in leaves)
+    expert_params = sum(
+        x.size for path, x in leaves
+        if any(getattr(p, "key", "") in ("w1", "w2") for p in path))
+    # top-1 active params: one of E experts per token
+    active = nparams - expert_params + expert_params // experts
+    params, opt_state = step.init(variables["params"])
+
+    global_bs = batch * n_chips
+    rng = np.random.RandomState(0)
+    raw = rng.randint(0, cfg.vocab_size, (global_bs, seq + 1))
+    batch_data = step.shard_batch({
+        "inputs": jnp.asarray(raw[:, :-1], jnp.int32),
+        "labels": jnp.asarray(raw[:, 1:], jnp.int32),
+    })
+
+    # auditability of the active-FLOP MFU: dropped tokens do zero
+    # expert work but still count full active FLOPs, so the headline
+    # is optimistic by the drop rate — measure and report it
+    @jax.jit
+    def _probe_drops(params, tokens):
+        _, state0 = model.apply({"params": params}, tokens,
+                                mutable=["intermediates"])
+        # sow tuples flatten away: leaves are the scalar values
+        leaves = [v for path, v in
+                  jax.tree_util.tree_flatten_with_path(
+                      state0["intermediates"])[0]
+                  if any(getattr(p, "key", "") == "moe_drop_fraction"
+                         for p in path)]
+        return jnp.mean(jnp.stack(leaves)) if leaves else jnp.zeros(())
+
+    drop_fraction = float(_probe_drops(
+        variables["params"], jnp.asarray(raw[:batch, :-1], jnp.int32)))
+    log(f"bench[moe]: {nparams / 1e6:.1f}M params "
+        f"({active / 1e6:.1f}M active/token), drop fraction "
+        f"{drop_fraction:.3f} at cf {cfg.capacity_factor}")
+    tokens_per_chip_sec = median_rate(
+        lambda s: step(s[0], s[1], batch_data), (params, opt_state, None),
+        args.num_warmup_batches, args.num_iters,
+        args.num_batches_per_iter,
+        global_bs * seq * spc, "moe") / n_chips
+
+    flops_per_token = 6 * active + 6 * layers * seq * d_model
+    peak = hw_peak_flops()
+    tf_s = tokens_per_chip_sec * flops_per_token
+    return {
+        "moe_tokens_per_sec": round(tokens_per_chip_sec, 1),
+        "moe_mfu": round(tf_s / peak, 4) if peak else None,
+        "moe_active_tflops_per_sec": round(tf_s / 1e12, 1),
+        "moe_params_m": round(nparams / 1e6, 1),
+        "moe_active_params_m": round(active / 1e6, 1),
+        "moe_drop_fraction": round(drop_fraction, 4),
+    }
+
+
 def run_autotune(args, hvd):
     """``--autotune``: tune the jit-path knobs that set the BENCH
     numbers (steps_per_call, flash block) against the measured rate —
@@ -361,7 +463,8 @@ def run_autotune(args, hvd):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="both",
-                   choices=["both", "resnet", "transformer", "vit"])
+                   choices=["both", "resnet", "transformer", "vit",
+                            "moe"])
     p.add_argument("--batch-size", type=int, default=128,
                    help="ResNet per-chip batch size")
     p.add_argument("--image-size", type=int, default=224)
@@ -417,6 +520,14 @@ def main():
                    help="CSV sample log (default autotune_<model>.csv)")
     p.add_argument("--vit-batch-size", type=int, default=128,
                    help="ViT per-chip batch size (--model vit only)")
+    p.add_argument("--moe-layers", type=int, default=12)
+    p.add_argument("--moe-d-model", type=int, default=1024)
+    p.add_argument("--moe-heads", type=int, default=8,
+                   help="MoE LM heads (8 at d_model 1024 = head_dim "
+                        "128, the MXU lane width)")
+    p.add_argument("--moe-experts", type=int, default=8)
+    p.add_argument("--moe-batch-size", type=int, default=8,
+                   help="MoE per-chip batch size (--model moe only)")
     p.add_argument("--vit-heads", type=int, default=12,
                    help="ViT heads: 12 = standard ViT-B head_dim 64; "
                         "6 = TPU-shaped head_dim 128 (MXU lane width)")
@@ -437,6 +548,8 @@ def main():
         out.update(run_transformer(args, hvd))
     if args.model == "vit":
         out.update(run_vit(args, hvd))
+    if args.model == "moe":
+        out.update(run_moe(args, hvd))
     print(json.dumps(out), flush=True)
 
 

@@ -6,6 +6,11 @@ package provides the TPU-native (flax, NHWC, bf16-friendly) equivalents
 used by ``examples/`` and ``bench.py``.
 """
 
+from horovod_tpu.models.moe import (
+    MoEConfig,
+    MoETransformerLM,
+    moe_aux_loss,
+)
 from horovod_tpu.models.resnet import ResNet50, ResNet101, ResNet152
 from horovod_tpu.models.transformer import (
     TransformerConfig,
@@ -21,4 +26,5 @@ from horovod_tpu.models.vit import (
 
 __all__ = ["ResNet50", "ResNet101", "ResNet152",
            "TransformerLM", "TransformerConfig", "lm_loss",
+           "MoETransformerLM", "MoEConfig", "moe_aux_loss",
            "VisionTransformer", "ViTConfig", "ViT_S16", "ViT_B16"]

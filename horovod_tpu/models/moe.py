@@ -1,0 +1,208 @@
+"""Switch-style Mixture-of-Experts transformer LM.
+
+Completes the model family around the expert-parallel machinery
+(:mod:`horovod_tpu.parallel.expert` — the TPU formulation of the
+reference's variable-split alltoall, ``operations.cc:979``, as an MoE
+dispatch plane): :class:`SwitchFFN` replaces every second block's MLP
+with top-1-routed experts, and :class:`MoETransformerLM` stacks them on
+the same attention/RMSNorm/RoPE machinery as
+:class:`~horovod_tpu.models.transformer.TransformerLM`.
+
+TPU-first choices, same stance as the rest of the zoo:
+
+* static capacity buckets (no dynamic shapes under jit; over-capacity
+  tokens drop, the Switch-Transformer policy);
+* expert FFNs run as ONE batched einsum over ``(E, C, d)`` buffers —
+  the MXU sees a single large contraction, not per-expert dispatches;
+* two execution modes sharing the router and parameters: *local*
+  (every device holds all experts — single chip, or experts replicated
+  under pure DP) and *expert-parallel* (``ep_axis`` set, call under
+  ``shard_map``: experts sharded, tokens moved by ``all_to_all`` via
+  :func:`~horovod_tpu.parallel.expert.expert_parallel_ffn`);
+* the Switch load-balancing auxiliary loss is sowed under
+  ``intermediates/moe_aux_loss`` so training loops can add
+  ``aux_weight * mean(aux)`` without threading extra outputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.models.transformer import (
+    Attention,
+    RMSNorm,
+    TransformerConfig,
+)
+from horovod_tpu.parallel.expert import expert_parallel_ffn, top1_routing
+
+
+@dataclasses.dataclass
+class MoEConfig:
+    vocab_size: int = 32_000
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "dense"
+    flash_block: int = 512
+    causal: bool = True
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    moe_every: int = 2              # every Nth block is MoE (Switch: 2)
+    ep_axis: Optional[str] = None   # None: local experts; "ep": sharded
+    remat: bool = False
+
+    def transformer(self) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=self.vocab_size, num_layers=self.num_layers,
+            num_heads=self.num_heads, d_model=self.d_model,
+            d_ff=self.d_ff, max_seq_len=self.max_seq_len,
+            dtype=self.dtype, attention_impl=self.attention_impl,
+            flash_block=self.flash_block, causal=self.causal,
+            remat=self.remat)
+
+
+class SwitchFFN(nn.Module):
+    """Top-1-routed expert FFN (gelu MLP experts).
+
+    ``(B, T, D) -> (B, T, D)``; sows ``moe_aux_loss`` (Switch aux:
+    ``E * sum_e fraction_e * prob_e``, minimized at uniform routing)
+    and ``moe_drop_fraction`` under ``intermediates``.
+    """
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, t, d = x.shape
+        e = cfg.num_experts
+        gate_kernel = self.param(
+            "gate", nn.initializers.normal(0.02), (d, e), jnp.float32)
+        w1 = self.param("w1", nn.initializers.lecun_normal(),
+                        (e, d, cfg.d_ff), jnp.float32)
+        w2 = self.param("w2", nn.initializers.lecun_normal(),
+                        (e, cfg.d_ff, d), jnp.float32)
+        tokens = x.reshape(b * t, d)
+
+        # Switch aux loss from the router view (identical in both
+        # modes; fp32 for a stable softmax)
+        scores = tokens.astype(jnp.float32) @ gate_kernel
+        probs = jax.nn.softmax(scores, axis=-1)
+        chosen = jax.nn.one_hot(jnp.argmax(probs, axis=-1), e,
+                                dtype=jnp.float32)
+        aux = e * jnp.sum(chosen.mean(0) * probs.mean(0))
+        self.sow("intermediates", "moe_aux_loss", aux)
+
+        w1c = w1.astype(cfg.dtype)
+        w2c = w2.astype(cfg.dtype)
+
+        def experts(buffers):
+            """(E?, S, d) -> (E?, S, d): one batched MXU contraction
+            per layer across however many experts are local."""
+            n_local = buffers.shape[0]
+            h = jnp.einsum("esd,edf->esf", buffers, w1c[:n_local])
+            h = nn.gelu(h)
+            return jnp.einsum("esf,efd->esd", h, w2c[:n_local])
+
+        if cfg.ep_axis is not None:
+            # expert-parallel: must be traced inside shard_map with the
+            # axis bound.  Each shard applies ITS slice of the experts.
+            from jax import lax
+
+            def expert_fn(buffers):
+                world = lax.axis_size(cfg.ep_axis)
+                e_local = e // world
+                idx = lax.axis_index(cfg.ep_axis)
+                w1l = lax.dynamic_slice_in_dim(w1c, idx * e_local,
+                                               e_local, 0)
+                w2l = lax.dynamic_slice_in_dim(w2c, idx * e_local,
+                                               e_local, 0)
+                h = jnp.einsum("esd,edf->esf", buffers, w1l)
+                h = nn.gelu(h)
+                return jnp.einsum("esf,efd->esd", h, w2l)
+
+            y, dropped = expert_parallel_ffn(
+                tokens.astype(cfg.dtype), gate_kernel.astype(cfg.dtype),
+                expert_fn, e, capacity_factor=cfg.capacity_factor,
+                axis=cfg.ep_axis)
+        else:
+            # local mode: same dispatch/combine as the parallel path
+            # minus the all_to_alls — numerics are mode-invariant
+            capacity = int(max(1, -(-cfg.capacity_factor *
+                                    tokens.shape[0] // e)))
+            expert_idx, slot, keep, gate = top1_routing(scores, capacity)
+            xt = tokens.astype(cfg.dtype)
+            dispatch = jnp.zeros((e, capacity, d), cfg.dtype)
+            safe_slot = jnp.where(keep, slot, 0)
+            dispatch = dispatch.at[expert_idx, safe_slot].add(
+                jnp.where(keep[:, None], xt, 0))
+            out = experts(dispatch)
+            y = out[expert_idx, safe_slot]
+            y = jnp.where(keep[:, None],
+                          y * gate[:, None].astype(y.dtype), 0)
+            dropped = 1.0 - jnp.mean(keep.astype(jnp.float32))
+        self.sow("intermediates", "moe_drop_fraction", dropped)
+        return y.reshape(b, t, d).astype(cfg.dtype)
+
+
+class MoEBlock(nn.Module):
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        tcfg = self.cfg.transformer()
+        x = x + Attention(tcfg, name="attn")(
+            RMSNorm(name="ln1")(x), positions)
+        return x + SwitchFFN(self.cfg, name="moe")(
+            RMSNorm(name="ln2")(x))
+
+
+class MoETransformerLM(nn.Module):
+    """``apply(variables, tokens) -> logits``; every
+    ``cfg.moe_every``-th block routes through experts, the rest are the
+    dense :class:`~horovod_tpu.models.transformer.Block` MLPs.  Collect
+    the aux losses with ``mutable=["intermediates"]`` and add
+    ``aux_weight * mean(moe_aux_loss values)`` to the task loss."""
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions: Optional[jax.Array] = None):
+        from horovod_tpu.models.transformer import Block
+
+        cfg = self.cfg
+        tcfg = cfg.transformer()
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])
+        emb = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                       embedding_init=nn.initializers.normal(0.02),
+                       name="embed")
+        x = emb(tokens)
+        for i in range(cfg.num_layers):
+            moe = cfg.moe_every and (i + 1) % cfg.moe_every == 0
+            cls = MoEBlock if moe else Block
+            if cfg.remat:
+                cls = nn.remat(cls, static_argnums=())
+            x = cls(cfg if moe else tcfg, name=f"layer_{i}")(x, positions)
+        x = RMSNorm(name="ln_f")(x)
+        return emb.attend(x.astype(jnp.float32))
+
+
+def moe_aux_loss(intermediates) -> jax.Array:
+    """Mean of the sowed Switch aux losses (0 when none present)."""
+    leaves = [v for path, v in
+              jax.tree_util.tree_flatten_with_path(intermediates)[0]
+              if any(getattr(p, "key", "") == "moe_aux_loss"
+                     for p in path)]
+    if not leaves:
+        return jnp.zeros(())
+    return jnp.mean(jnp.stack([jnp.asarray(l, jnp.float32).mean()
+                               for l in leaves]))

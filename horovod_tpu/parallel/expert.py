@@ -73,7 +73,11 @@ def expert_parallel_ffn(x: jax.Array, gate_kernel: jax.Array,
     t, d = x.shape
     capacity = int(max(1, -(-capacity_factor * t // num_experts_total)))
 
-    scores = x @ gate_kernel                       # (t, E)
+    # router in fp32 regardless of compute dtype: near-tie tokens
+    # argmax differently in bf16 (measured ~0.2%), which would make
+    # the dispatched routing diverge from fp32-side accounting (aux
+    # losses) and from local-mode execution
+    scores = x.astype(jnp.float32) @ gate_kernel.astype(jnp.float32)
     expert_idx, slot, keep, gate = top1_routing(scores, capacity)
 
     # scatter tokens into (E, C, d) dispatch buckets
