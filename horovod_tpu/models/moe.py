@@ -104,13 +104,13 @@ class SwitchFFN(nn.Module):
         w1c = w1.astype(cfg.dtype)
         w2c = w2.astype(cfg.dtype)
 
-        def experts(buffers):
+        def expert_mlp(buffers, w1_, w2_):
             """(E?, S, d) -> (E?, S, d): one batched MXU contraction
-            per layer across however many experts are local."""
-            n_local = buffers.shape[0]
-            h = jnp.einsum("esd,edf->esf", buffers, w1c[:n_local])
-            h = nn.gelu(h)
-            return jnp.einsum("esf,efd->esd", h, w2c[:n_local])
+            per layer across however many experts are present — the
+            ONE expert body both modes share (local and EP must never
+            diverge in what an expert computes)."""
+            h = jnp.einsum("esd,edf->esf", buffers, w1_)
+            return jnp.einsum("esf,efd->esd", nn.gelu(h), w2_)
 
         if cfg.ep_axis is not None:
             # expert-parallel: must be traced inside shard_map with the
@@ -125,14 +125,15 @@ class SwitchFFN(nn.Module):
                                                e_local, 0)
                 w2l = lax.dynamic_slice_in_dim(w2c, idx * e_local,
                                                e_local, 0)
-                h = jnp.einsum("esd,edf->esf", buffers, w1l)
-                h = nn.gelu(h)
-                return jnp.einsum("esf,efd->esd", h, w2l)
+                return expert_mlp(buffers, w1l, w2l)
 
+            # scores= hands the fp32 routing used for the aux loss to
+            # the dispatch plane: the accounted routing IS the
+            # dispatched routing, in any compute dtype
             y, dropped = expert_parallel_ffn(
-                tokens.astype(cfg.dtype), gate_kernel.astype(cfg.dtype),
+                tokens.astype(cfg.dtype), gate_kernel,
                 expert_fn, e, capacity_factor=cfg.capacity_factor,
-                axis=cfg.ep_axis)
+                axis=cfg.ep_axis, scores=scores)
         else:
             # local mode: same dispatch/combine as the parallel path
             # minus the all_to_alls — numerics are mode-invariant
@@ -144,7 +145,7 @@ class SwitchFFN(nn.Module):
             safe_slot = jnp.where(keep, slot, 0)
             dispatch = dispatch.at[expert_idx, safe_slot].add(
                 jnp.where(keep[:, None], xt, 0))
-            out = experts(dispatch)
+            out = expert_mlp(dispatch, w1c, w2c)
             y = out[expert_idx, safe_slot]
             y = jnp.where(keep[:, None],
                           y * gate[:, None].astype(y.dtype), 0)
@@ -170,7 +171,14 @@ class MoETransformerLM(nn.Module):
     ``cfg.moe_every``-th block routes through experts, the rest are the
     dense :class:`~horovod_tpu.models.transformer.Block` MLPs.  Collect
     the aux losses with ``mutable=["intermediates"]`` and add
-    ``aux_weight * mean(moe_aux_loss values)`` to the task loss."""
+    ``aux_weight * mean(moe_aux_loss values)`` to the task loss.
+
+    With ``ep_axis`` set, call under ``shard_map`` with *unboxed*
+    params (``flax.core.meta.unbox``) — same contract as
+    TransformerLM's ring/ulysses modes (manual meshes reject the
+    Partitioned metadata's sharding constraints); init with an
+    ``ep_axis=None`` twin (identical param tree, no bound axis
+    needed).  See ``examples/moe_lm_example.py``."""
 
     cfg: MoEConfig
 

@@ -14,7 +14,7 @@ standard Switch-Transformer policy.
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -47,7 +47,8 @@ def top1_routing(scores: jax.Array, capacity: int):
 def expert_parallel_ffn(x: jax.Array, gate_kernel: jax.Array,
                         expert_fn: Callable, num_experts_total: int,
                         capacity_factor: float = 1.25,
-                        axis: str = AXIS_EP):
+                        axis: str = AXIS_EP,
+                        scores: Optional[jax.Array] = None):
     """Mixture-of-experts FFN with experts sharded over ``axis``.
 
     Call inside ``shard_map``.  Args:
@@ -76,8 +77,12 @@ def expert_parallel_ffn(x: jax.Array, gate_kernel: jax.Array,
     # router in fp32 regardless of compute dtype: near-tie tokens
     # argmax differently in bf16 (measured ~0.2%), which would make
     # the dispatched routing diverge from fp32-side accounting (aux
-    # losses) and from local-mode execution
-    scores = x.astype(jnp.float32) @ gate_kernel.astype(jnp.float32)
+    # losses) and from local-mode execution.  Callers that already
+    # computed fp32 scores (e.g. for the Switch aux loss) pass them in
+    # — the DISPATCHED routing and the accounted routing must be the
+    # same routing, and the gate matmul runs once.
+    if scores is None:
+        scores = x.astype(jnp.float32) @ gate_kernel.astype(jnp.float32)
     expert_idx, slot, keep, gate = top1_routing(scores, capacity)
 
     # scatter tokens into (E, C, d) dispatch buckets

@@ -41,25 +41,30 @@ AxisSpec = Union[str, Sequence[str]]
 # pjit/GSPMD modules — sharding by annotation
 # ---------------------------------------------------------------------------
 
-def _ambient_mesh_axes() -> Optional[set]:
-    """Axis names of the context (``with mesh:``) mesh, or None.
+def _constrainable_axes() -> Optional[set]:
+    """Mesh axis names a sharding constraint may legally name, or None.
 
-    Reads ``jax._src.mesh.thread_resources`` — the classic mesh
-    context has no public accessor (``get_abstract_mesh`` only sees
-    the new ``use_mesh`` style); pinned against the image's jax, same
-    stance as ``runtime/distributed.py``."""
+    Inside ``shard_map`` the abstract mesh marks every axis Manual —
+    constraints are illegal there (values are already per-shard; the
+    TransformerLM docstring's unboxed-params mode), so Manual axes are
+    excluded.  The classic ``with mesh:`` context has no public
+    accessor, so ``jax._src.mesh.thread_resources`` is read as the
+    fallback — pinned against the image's jax, same stance as
+    ``runtime/distributed.py``."""
+    try:        # use_mesh / shard_map-style contexts carry axis types
+        am = jax.sharding.get_abstract_mesh()
+        if am is not None and not am.empty:
+            return {name for name, typ in zip(am.axis_names,
+                                              am.axis_types)
+                    if "Manual" not in str(typ)}
+    except Exception:
+        pass
     try:
         from jax._src import mesh as _jmesh
 
         m = _jmesh.thread_resources.env.physical_mesh
         if m is not None and not m.empty:
             return set(m.axis_names)
-    except Exception:
-        pass
-    try:        # use_mesh-style contexts
-        am = jax.sharding.get_abstract_mesh()
-        if am is not None and not am.empty:
-            return set(am.axis_names)
     except Exception:
         pass
     return None
@@ -73,11 +78,12 @@ def _constrain(x, *spec):
     constraint a jit over a tp mesh is free to replicate the kernels
     and the "tensor-parallel" module silently computes fully
     replicated (measured: the compiled module had zero collectives).
-    The constraint is skipped ONLY when no ambient mesh exists or the
-    mesh lacks the requested axis (the single-device/unsharded paths);
-    real sharding errors on a live mesh — e.g. features not divisible
-    by the axis size — must propagate, not silently replicate."""
-    mesh_axes = _ambient_mesh_axes()
+    The constraint is skipped ONLY when no ambient mesh exists, the
+    mesh lacks the requested axis, or the axis is Manual (shard_map
+    body — constraining there is illegal); real sharding errors on a
+    live mesh — e.g. features not divisible by the axis size — must
+    propagate, not silently replicate."""
+    mesh_axes = _constrainable_axes()
     wanted = {s for s in spec if isinstance(s, str)}
     if mesh_axes is None or not wanted <= mesh_axes:
         return x

@@ -77,6 +77,29 @@ class TestSwitchFFN:
         np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_local),
                                    rtol=2e-4, atol=2e-4)
 
+    def test_ep_routing_matches_local_in_bf16(self, hvd_runtime):
+        """bf16 compute: the dispatched routing must still be the fp32
+        routing the aux loss accounts (scores= pass-through into the
+        dispatch plane) — outputs match local mode to bf16 tolerance."""
+        mesh = make_parallel_mesh(ep=8, devices=jax.devices("cpu")[:8])
+        kw = dict(num_experts=8, capacity_factor=16.0,
+                  dtype=jnp.bfloat16)
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 8, 32),
+                              jnp.float32)
+        local = SwitchFFN(tiny_cfg(**kw))
+        variables = local.init(jax.random.PRNGKey(1), x)
+        y_local = local.apply(variables, x)
+
+        ep = SwitchFFN(tiny_cfg(ep_axis="ep", **kw))
+        smapped = jax.jit(jax.shard_map(
+            lambda p, x: ep.apply({"params": p}, x), mesh=mesh,
+            in_specs=(P(), P("ep",)), out_specs=P("ep",),
+            check_vma=False))
+        y_ep = smapped(variables["params"], x)
+        np.testing.assert_allclose(
+            np.asarray(y_ep, np.float32), np.asarray(y_local, np.float32),
+            rtol=5e-2, atol=5e-2)
+
     def test_capacity_drops_overflow_tokens(self):
         cfg = tiny_cfg(capacity_factor=0.25)   # force drops
         ffn = SwitchFFN(cfg)
