@@ -165,6 +165,18 @@ class _ExecutorPool:
         self.runs: Dict[str, _Run] = {}
         self.registered = threading.Event()
 
+    def idle_tasks(self, host_hash: str) -> list:
+        """Task ids on ``host_hash`` free to serve a worker, ordered by
+        partition index (deterministic pick).  Busy tasks and tasks
+        whose fn failed (``consumed`` — their process is poisoned) are
+        excluded; keys are per-process uuids, so a replacement task at
+        a reused Spark partition index never inherits its dead
+        predecessor's state.  Callers must hold ``self.lock``."""
+        return [tid for _, tid in sorted(
+            (reg.index, tid) for tid, reg in self.registry.items()
+            if reg.host_hash == host_hash
+            and tid not in self.busy and tid not in self.consumed)]
+
     def _alive(self, reg) -> bool:
         """Probe with retries: one missed ping (GIL-starved service
         thread, loaded machine) must not read as executor death — death
@@ -323,17 +335,13 @@ def run_elastic_on_context(sc, fn: Callable, args=(), kwargs=None,
     def create_worker_fn(slot, coordinator: str, generation: int,
                          abort_event=None) -> int:
         with pool.lock:
-            candidates = sorted(
-                (pool.registry[tid].index, tid)
-                for tid, reg in pool.registry.items()
-                if reg.host_hash == slot.hostname
-                and tid not in pool.busy and tid not in pool.consumed)
+            candidates = pool.idle_tasks(slot.hostname)
             if not candidates:
                 hvd_logging.warning(
                     "spark elastic: no idle executor task on %s for rank "
                     "%d", slot.hostname, slot.rank)
                 return 1
-            _, task_id = candidates[0]
+            task_id = candidates[0]
             reg = pool.registry[task_id]
             run_id = uuid.uuid4().hex
             run = _Run(task_id, (slot.hostname, slot.local_rank))

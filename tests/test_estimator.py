@@ -635,6 +635,50 @@ class TestFsspecStore:
         sync.mirror()
         assert len(store.writes) == n
 
+    def test_syncing_checkpointer_survives_store_blips(self, tmp_path):
+        """A transient store error during the per-save mirror must not
+        abort the training loop; the mirror state only advances on a
+        fully successful pass, so the next mirror retries everything
+        still pending."""
+        from horovod_tpu.estimator import _SyncingCheckpointer
+
+        class FlakyStore:
+            def __init__(self):
+                self.files: dict = {}
+                self.fail_next = 1
+
+            def write(self, path, data):
+                if self.fail_next:
+                    self.fail_next -= 1
+                    raise OSError("503 transient")
+                self.files[path] = data
+
+            def delete(self, path):
+                self.files.pop(path, None)
+
+        class NullInner:
+            def save(self, step, state):
+                return True
+
+        store = FlakyStore()
+        staging = tmp_path / "stage"
+        (staging / "step_0").mkdir(parents=True)
+        (staging / "step_0" / "state.pkl").write_bytes(b"s0")
+        sync = _SyncingCheckpointer(NullInner(), store, str(staging),
+                                    "memory://b/ckpt")
+        # the blip is swallowed (warn-and-continue), nothing landed
+        sync.save(0, {})
+        assert store.files == {}
+        # next save retries the pending file and succeeds
+        sync.save(1, {})
+        assert set(store.files) == {"memory://b/ckpt/step_0/state.pkl"}
+        # the strict final mirror PROPAGATES store errors
+        store.fail_next = 1
+        (staging / "step_1").mkdir()
+        (staging / "step_1" / "state.pkl").write_bytes(b"s1")
+        with pytest.raises(OSError, match="503"):
+            sync.mirror()
+
     def test_run_artifact_layout(self):
         from horovod_tpu.spark.store import (ColSpec, load_metadata,
                                              save_metadata)

@@ -116,3 +116,68 @@ class TestSparkElastic:
         with pytest.raises(ValueError, match="min_np <= num_proc"):
             run_elastic_on_context(LocalSparkContext(), _elastic_rank_fn,
                                    num_proc=1, min_np=2, max_np=4)
+
+
+class TestExecutorPool:
+    """Driver-side pool units: liveness completes dead tasks' runs and
+    drops them from discovery; uuid keys survive index reuse."""
+
+    def test_liveness_completes_dead_tasks_run(self):
+        import socket
+
+        from horovod_tpu.spark.elastic import _ExecutorPool, _Run
+        from horovod_tpu.spark.runner import RegisterTask
+
+        # a port nobody listens on (bind-then-close)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            dead_addr = s.getsockname()
+
+        pool = _ExecutorPool("k")
+        reg = RegisterTask(0, "h", "h[0]", dead_addr, task_id="a")
+        pool.registry["a"] = reg
+        run = _Run("a", ("h[0]", 0))
+        pool.runs["r"] = run
+        pool.busy["a"] = "r"
+        hosts = pool.check_liveness()
+        assert hosts == {}                      # host left discovery
+        assert "a" not in pool.registry
+        assert run.done.is_set() and run.exit_code == 1
+
+    def test_liveness_keeps_answering_tasks(self):
+        from horovod_tpu.runner.network import AckResponse, BasicService
+        from horovod_tpu.spark.elastic import PingTask, _ExecutorPool
+        from horovod_tpu.spark.runner import RegisterTask
+
+        def handle(req):
+            assert isinstance(req, PingTask)
+            return AckResponse()
+
+        service = BasicService("t", "k", handle)
+        service.start()
+        try:
+            pool = _ExecutorPool("k")
+            pool.registry["a"] = RegisterTask(
+                0, "h", "h[0]", service.address, task_id="a")
+            assert pool.check_liveness() == {"h[0]": 1}
+            assert "a" in pool.registry
+        finally:
+            service.shutdown()
+
+    def test_replacement_task_not_poisoned_by_predecessor(self):
+        """Spark reuses partition indices when re-running a lost
+        executor's task; the replacement's uuid key must not inherit
+        the dead task's busy/consumed state."""
+        from horovod_tpu.spark.elastic import _ExecutorPool
+        from horovod_tpu.spark.runner import RegisterTask
+
+        pool = _ExecutorPool("k")
+        pool.registry["old"] = RegisterTask(0, "h", "h[0]", ("x", 1),
+                                            task_id="old")
+        pool.busy["old"] = "r1"
+        pool.consumed.add("old")
+        pool.registry["new"] = RegisterTask(0, "h", "h[0]", ("x", 2),
+                                            task_id="new")
+        # the REAL selection create_worker_fn uses, not a re-derivation
+        assert pool.idle_tasks("h[0]") == ["new"]
+        assert pool.idle_tasks("other") == []
