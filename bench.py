@@ -471,10 +471,12 @@ def main():
     p.add_argument("--num-iters", type=int, default=5)
     p.add_argument("--num-batches-per-iter", type=int, default=5)
     p.add_argument("--num-warmup-batches", type=int, default=3)
-    p.add_argument("--steps-per-call", type=int, default=20,
+    p.add_argument("--steps-per-call", type=int, default=40,
                    help="optimizer steps scanned into one dispatched "
                         "program (steps_per_execution); amortizes "
-                        "per-call launch overhead")
+                        "per-call launch overhead.  40 = the offline "
+                        "autotuner's cold-start pick, confirmed by "
+                        "full-length A/B on both models (round 5)")
     p.add_argument("--no-compiler-options", action="store_true",
                    help="disable the default TPU XLA compile options")
     p.add_argument("--platform", default=None,
