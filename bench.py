@@ -71,8 +71,12 @@ def median_rate(step_fn, state, warmup_batches, iters, batches_per_iter,
     Fences on a host fetch of the loss, not ``jax.block_until_ready``:
     through remote-device tunnels block_until_ready can return before
     the step finishes, silently inflating rates; a scalar device_get
-    cannot.  Median is robust to single-iteration tunnel/scheduler
-    hiccups (observed ±3% run-to-run drift, PERF_NOTES.md).
+    cannot.  The HEADLINE metric is the median of the per-iteration
+    rates — robust to single-iteration tunnel/scheduler hiccups
+    (observed ±3% run-to-run drift, and one BENCH_r05 transformer
+    iteration collapsing 25,364→3,061 tok/s) — and any iteration
+    deviating >20% from that median is flagged so tail anomalies are
+    visible in the log instead of silently polluting the trajectory.
     """
     t0 = time.perf_counter()
     for _ in range(warmup_batches):
@@ -90,7 +94,39 @@ def median_rate(step_fn, state, warmup_batches, iters, batches_per_iter,
         dt = time.perf_counter() - t0
         rates.append(units_per_batch * batches_per_iter / dt)
         log(f"bench[{label}]: iter {it}: {rates[-1]:.1f}/sec")
-    return float(np.median(rates))
+    median = float(np.median(rates))
+    for it, r in enumerate(rates):
+        dev = abs(r - median) / median if median > 0 else 0.0
+        if dev > 0.2:
+            log(f"bench[{label}]: WARNING iter {it} ({r:.1f}/sec) "
+                f"deviates {dev * 100:.0f}% from the median "
+                f"{median:.1f}/sec; the headline stays median-of-iters "
+                f"— treat this run's tail as anomalous, not the trend")
+    return median
+
+
+def run_overlap_probe(args, loss_fn, params, batch, prefix, label):
+    """Measure the backward/exchange/fused timings and the achieved
+    comm/compute overlap fraction for this model's gradient exchange
+    (utils/overlap_probe.py) — the scaling model consumes the measured
+    ``overlap_fraction`` instead of assuming one (docs/overlap.md)."""
+    if args.no_overlap_probe:
+        return {}
+    from horovod_tpu.utils.overlap_probe import measure_overlap
+
+    try:
+        rep = measure_overlap(
+            loss_fn, params, batch,
+            bucket_bytes=args.overlap_bucket_bytes, iters=3, warmup=1)
+    except Exception as e:  # noqa: BLE001 — probe must not sink the bench
+        log(f"bench[{label}]: overlap probe failed ({e}); "
+            f"omitting overlap fields")
+        return {}
+    log(f"bench[{label}]: overlap probe bwd {rep.backward_s * 1e3:.2f}ms "
+        f"exch {rep.exchange_s * 1e3:.2f}ms fused {rep.fused_s * 1e3:.2f}ms "
+        f"-> overlap {rep.overlap_fraction:.2f} "
+        f"({rep.payload_bytes / 1e6:.1f} MB payload, world {rep.world})")
+    return rep.as_bench_fields(prefix)
 
 
 def run_resnet(args, hvd):
@@ -136,6 +172,11 @@ def run_resnet(args, hvd):
         "y": jnp.asarray(rng.randint(0, 1000, (global_bs,)), jnp.int32),
     })
 
+    # probe BEFORE the throughput loop: the step donates params, so
+    # they are only alive up to the first timed call
+    overlap = run_overlap_probe(args, loss_fn, params, batch,
+                                "resnet_", "resnet")
+
     per_chip = median_rate(
         lambda s: step(s[0], s[1], batch), (params, opt_state, None),
         args.num_warmup_batches, args.num_iters,
@@ -154,6 +195,7 @@ def run_resnet(args, hvd):
         "vs_baseline": round(per_chip / BASELINE_IMG_SEC_PER_ACCEL, 3),
         "mfu": round(per_chip * flops_per_img / peak, 4) if peak else None,
         "model_tflops_per_sec": round(per_chip * flops_per_img / 1e12, 1),
+        **overlap,
     }
 
 
@@ -212,6 +254,10 @@ def run_transformer(args, hvd):
     })
 
     log(f"bench[transformer]: {nparams / 1e6:.1f}M params")
+    # headline overlap_fraction rides the flagship model (probe before
+    # the timed loop — the step donates params on its first call)
+    overlap = run_overlap_probe(args, loss_fn, params, batch_data,
+                                "", "transformer")
     tokens_per_chip_sec = median_rate(
         lambda s: step(s[0], s[1], batch_data), (params, opt_state, None),
         args.num_warmup_batches, args.num_iters,
@@ -231,6 +277,7 @@ def run_transformer(args, hvd):
         "transformer_mfu": round(tf_s / peak, 4) if peak else None,
         "transformer_tflops_per_sec": round(tf_s / 1e12, 1),
         "transformer_params_m": round(nparams / 1e6, 1),
+        **overlap,
     }
 
 
@@ -479,6 +526,15 @@ def main():
                         "full-length A/B on both models (round 5)")
     p.add_argument("--no-compiler-options", action="store_true",
                    help="disable the default TPU XLA compile options")
+    p.add_argument("--no-overlap-probe", action="store_true",
+                   help="skip the comm/compute overlap microbenchmark "
+                        "(backward-only vs exchange-only vs fused "
+                        "timings; emits overlap_fraction)")
+    p.add_argument("--overlap-bucket-bytes", type=int, default=None,
+                   help="bucket the probed gradient exchange at this "
+                        "byte cap (reverse-layer-order buckets, the "
+                        "exchange_bucket_bytes knob); default: one "
+                        "monolithic bucket")
     p.add_argument("--platform", default=None,
                    help="force a jax backend (e.g. cpu) — env "
                         "JAX_PLATFORMS alone is overridden by this "

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from horovod_tpu import compat as _compat  # noqa: F401  (installs jax shims)
 from horovod_tpu.ops import (
     Adasum,
     Average,

@@ -1,0 +1,123 @@
+"""Version shims for the jax pinned in this image.
+
+The repo is written against the modern ``jax.shard_map`` entry point,
+whose replication-checking kwarg is ``check_vma``; the image pins
+jax 0.4.37, where shard_map still lives at
+``jax.experimental.shard_map.shard_map`` and the kwarg is spelled
+``check_rep``.  Rather than fork every call site (and every test) on a
+version check, importing :mod:`horovod_tpu` installs one alias:
+``jax.shard_map`` that accepts either spelling and forwards to
+whichever implementation the installed jax provides.
+
+The same goes for ``jax.lax.axis_size``: 0.4.37 predates it, but
+``jax.core.axis_frame(name)`` already returns the bound axis size as a
+plain int, which is exactly the static value the collectives layer
+needs for shard-shape arithmetic.  And for
+``jax._src.distributed._jax`` (the coordination-service bindings):
+0.4.37 ships the same factories on ``xla_extension`` under the older
+keyword spelling, adapted below.
+
+The shims are additive only — on a jax that already ships the modern
+names nothing is touched, so upgrading the image drops them to no-ops
+instead of shadowing the real APIs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def _install_shard_map() -> None:
+    if getattr(jax, "shard_map", None) is not None:
+        return
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, check_rep=None, **kwargs):
+        if check_rep is None:
+            check_rep = True if check_vma is None else bool(check_vma)
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_rep,
+                          **kwargs)
+
+    jax.shard_map = shard_map
+
+
+def _install_axis_size() -> None:
+    if getattr(jax.lax, "axis_size", None) is not None:
+        return
+
+    def axis_size(axis_name):
+        """Static size of a bound mesh axis (modern ``lax.axis_size``)."""
+        if isinstance(axis_name, (tuple, list)):
+            n = 1
+            for a in axis_name:
+                n *= axis_size(a)
+            return n
+        return jax.core.axis_frame(axis_name)
+
+    jax.lax.axis_size = axis_size
+
+
+def _install_distributed_runtime() -> None:
+    """``jax._src.distributed._jax`` — the coordination-service bindings
+    :mod:`horovod_tpu.runtime.distributed` drives.  Modern jax re-exports
+    the jaxlib module there; 0.4.37 exposes the same factories on
+    ``xla_extension`` with the older knob spelling (``heartbeat_interval``
+    × ``max_missing_heartbeats`` instead of one ``heartbeat_timeout``, a
+    one-arg missed-heartbeat callback, no ``recoverable``).  The adapter
+    translates the modern call the repo makes into the pinned API."""
+    from jax._src import distributed as dist
+
+    if getattr(dist, "_jax", None) is not None:
+        return
+
+    from jax._src.lib import xla_extension as xe
+
+    _MISSABLE = 5   # timeout = interval x missable, matching new-API feel
+
+    def _hb(heartbeat_timeout):
+        if heartbeat_timeout is None:
+            return {}
+        return {"heartbeat_interval":
+                max(1, int(heartbeat_timeout) // _MISSABLE),
+                "max_missing_heartbeats": _MISSABLE}
+
+    class _Adapter:
+        @staticmethod
+        def get_distributed_runtime_service(address, num_nodes,
+                                            heartbeat_timeout=None, **kw):
+            return xe.get_distributed_runtime_service(
+                address, num_nodes, **_hb(heartbeat_timeout), **kw)
+
+        @staticmethod
+        def get_distributed_runtime_client(address, node_id,
+                                           heartbeat_timeout=None,
+                                           recoverable=None,
+                                           missed_heartbeat_callback=None,
+                                           **kw):
+            del recoverable     # 0.4.37 clients predate the knob
+            kwargs = dict(_hb(heartbeat_timeout), **kw)
+            if missed_heartbeat_callback is not None:
+                # old callback passes status only; the modern signature
+                # adds coordinator_reported_failure — unknowable here
+                kwargs["missed_heartbeat_callback"] = \
+                    lambda status: missed_heartbeat_callback(status, False)
+            return xe.get_distributed_runtime_client(address, node_id,
+                                                     **kwargs)
+
+    dist._jax = _Adapter()
+
+
+def install() -> None:
+    """Idempotently install every missing-API alias."""
+    _install_shard_map()
+    _install_axis_size()
+    _install_distributed_runtime()
+
+
+install()

@@ -31,10 +31,57 @@ jitted program as the reduction, so XLA fuses pack + collective + unpack.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 from horovod_tpu.runtime import state
 from horovod_tpu.utils import timeline as tl
+
+
+def plan_buckets(nbytes: Sequence[int],
+                 bucket_bytes: Optional[int],
+                 reverse: bool = True) -> List[List[int]]:
+    """Partition leaf indices into byte-capped fusion buckets — the
+    compiler-era form of the reference's fusion-buffer cycle, used by
+    the in-graph sharded exchange
+    (:func:`horovod_tpu.ops.collectives.grouped_reducescatter`).
+
+    ``nbytes[i]`` is leaf ``i``'s payload.  Greedy, order-preserving
+    packing: a bucket closes when adding the next leaf would exceed
+    ``bucket_bytes`` (a single oversized leaf still gets its own
+    bucket).  With ``reverse=True`` (default) leaves are walked from
+    the END of the pytree: autodiff produces gradients in reverse
+    layer order, so bucket 0 holds the *earliest-ready* gradients of
+    the backward pass and its collective appears first in program
+    order — the dependency structure that lets XLA's latency-hiding
+    scheduler start the first reduce-scatter while earlier layers'
+    backward is still computing (the role of the reference's
+    ready-order background flushes, ``controller.cc:686``).
+
+    ``bucket_bytes`` of ``None`` or ``<= 0`` disables splitting: one
+    bucket with every index (still reverse-ordered), i.e. the
+    monolithic exchange.
+
+    Like the eager :class:`Bucketer`, the plan depends only on static
+    shapes and the cap — never on timing — so every shard compiles the
+    identical collective schedule.
+    """
+    order = range(len(nbytes) - 1, -1, -1) if reverse \
+        else range(len(nbytes))
+    if not bucket_bytes or bucket_bytes <= 0:
+        ids = list(order)
+        return [ids] if ids else []
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for i in order:
+        if cur and cur_bytes + nbytes[i] > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes[i]
+    if cur:
+        buckets.append(cur)
+    return buckets
 
 
 class _Entry:
@@ -52,6 +99,14 @@ class _Entry:
 
 
 class Bucketer:
+    """Eager-plane fusion buckets.
+
+    Submission order IS gradient-ready order (the framework hooks fire
+    as autodiff produces each gradient, last layer first), so
+    threshold-triggered dispatches leave in reverse-layer order — the
+    eager twin of :func:`plan_buckets`' reverse walk for the compiled
+    path."""
+
     def __init__(self):
         self._lock = threading.Lock()
         self._buckets: Dict[tuple, List[_Entry]] = {}

@@ -22,9 +22,10 @@ the bitwise AND/OR bitvector reductions the controller uses internally
 
 from __future__ import annotations
 
+import dataclasses
 import enum
 from functools import partial
-from typing import Optional, Sequence, Union
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -202,8 +203,24 @@ def quantized_allreduce(x: jax.Array, axis: AxisSpec = GLOBAL_AXES,
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError("quantized_allreduce supports Sum/Average")
     x32 = x.astype(jnp.float32)
+    scale = _shared_wire_scale(x32, segments, axis)
+    q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
+    total = lax.psum(q.astype(jnp.int32), axis)
+    y = total.astype(jnp.float32) * scale
+    if op == ReduceOp.AVERAGE:
+        y = y / axis_size(axis)
+    return y.astype(x.dtype)
+
+
+def _shared_wire_scale(x32: jax.Array, segments: Sequence[int],
+                       axis: AxisSpec) -> jax.Array:
+    """Shared int8 quantization scale(s) for a (fused) flat buffer —
+    the codec core of :func:`quantized_allreduce`, reused by
+    :func:`quantized_reducescatter`.  One ``pmax`` agrees on the
+    per-segment absmax across shards; returns a scalar (no segments)
+    or a per-element scale vector (one scale per fused tensor)."""
     if segments and len(segments) > 1:
-        if x.ndim != 1 or sum(segments) != x.shape[0]:
+        if x32.ndim != 1 or sum(segments) != x32.shape[0]:
             raise ValueError("segments must partition a flat buffer")
         bounds = np.cumsum([0] + list(segments))
         local_amax = jnp.stack(
@@ -211,18 +228,214 @@ def quantized_allreduce(x: jax.Array, axis: AxisSpec = GLOBAL_AXES,
              for i in range(len(segments))])
         scales = lax.pmax(local_amax, axis) / 127.0
         scales = jnp.maximum(scales, 1e-30)
-        scale = jnp.repeat(scales, np.asarray(segments),
-                           total_repeat_length=x.shape[0])
-    else:
-        local_amax = jnp.max(jnp.abs(x32))
-        scale = lax.pmax(local_amax, axis) / 127.0
-        scale = jnp.maximum(scale, 1e-30)
+        return jnp.repeat(scales, np.asarray(segments),
+                          total_repeat_length=x32.shape[0])
+    local_amax = jnp.max(jnp.abs(x32))
+    scale = lax.pmax(local_amax, axis) / 127.0
+    return jnp.maximum(scale, 1e-30)
+
+
+def quantized_reducescatter(x: jax.Array, axis: AxisSpec = GLOBAL_AXES,
+                            op: ReduceOp = Average,
+                            bits: int = 8,
+                            segments: Sequence[int] = ()) -> jax.Array:
+    """Reduce-scatter with the int8 wire of :func:`quantized_allreduce`
+    (same shared-scale codec: one ``pmax`` agrees the scale, int8 on
+    the wire, exact int32 accumulation).  ``x`` must be flat with
+    length divisible by the axis world size; each shard receives its
+    dequantized 1/world slice.  With ``segments``, per-tensor scales
+    are used and this shard dequantizes with the scale entries of its
+    own slice."""
+    if bits != 8:
+        raise ValueError("only 8-bit quantization is supported")
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("quantized_reducescatter supports Sum/Average")
+    world = axis_size(axis)
+    if x.ndim != 1 or x.shape[0] % world:
+        raise ValueError(
+            f"quantized_reducescatter needs a flat buffer divisible by "
+            f"world size {world}, got shape {x.shape}")
+    x32 = x.astype(jnp.float32)
+    scale = _shared_wire_scale(x32, segments, axis)
     q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
-    total = lax.psum(q.astype(jnp.int32), axis)
+    ax = axis if isinstance(axis, str) else tuple(axis)
+    total = lax.psum_scatter(q.astype(jnp.int32), ax, tiled=True)
+    shard = x.shape[0] // world
+    if scale.ndim:          # per-segment scales: this shard's slice
+        scale = lax.dynamic_slice(scale, (axis_index(axis) * shard,),
+                                  (shard,))
     y = total.astype(jnp.float32) * scale
     if op == ReduceOp.AVERAGE:
-        y = y / axis_size(axis)
+        y = y / world
     return y.astype(x.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardGroup:
+    """One fused wire buffer of the sharded exchange: the leaves of a
+    single (bucket, dtype) cell, concatenated flat and padded to a
+    shard-divisible length."""
+
+    key: str                        # "b<bucket>/<dtype>" — shard dict key
+    dtype: str                      # jnp dtype name
+    indices: Tuple[int, ...]        # original leaf indices, bucket order
+    sizes: Tuple[int, ...]          # per-leaf element counts
+    shapes: Tuple[Tuple[int, ...], ...]
+    padded: int                     # flat length after zero-padding
+    shard: int                      # padded // world — this rank's slice
+
+
+@dataclasses.dataclass(frozen=True)
+class FusionSpec:
+    """Static reassembly plan for a bucketed sharded exchange.
+
+    Built from leaf shapes only (deterministic across shards — the
+    same invariant the eager :class:`~horovod_tpu.ops.bucketing.Bucketer`
+    keeps by flushing on program order, here enforced by construction).
+    Carries everything :func:`grouped_allgather` needs to reverse
+    :func:`grouped_reducescatter`."""
+
+    groups: Tuple[ShardGroup, ...]
+    world: int
+    num_leaves: int
+
+
+def make_fusion_spec(leaves: Sequence[jax.Array], world: int,
+                     bucket_bytes: Optional[int] = None) -> FusionSpec:
+    """Plan the bucketed sharded exchange for ``leaves``.
+
+    Buckets come from :func:`horovod_tpu.ops.bucketing.plan_buckets`
+    in reverse-layer order (see there for why); within a bucket the
+    leaves split per dtype — mixed-dtype buckets ride as one bucket
+    with one wire collective per member dtype, exactly like
+    :func:`grouped_allreduce`'s dtype groups.  Each group's flat
+    length is padded up to the next multiple of ``world`` so
+    ``psum_scatter`` tiles evenly."""
+    from horovod_tpu.ops.bucketing import plan_buckets
+
+    nbytes = [x.size * x.dtype.itemsize for x in leaves]
+    buckets = plan_buckets(nbytes, bucket_bytes, reverse=True)
+    groups: List[ShardGroup] = []
+    for b, idxs in enumerate(buckets):
+        by_dtype: Dict[str, List[int]] = {}
+        for i in idxs:
+            by_dtype.setdefault(jnp.dtype(leaves[i].dtype).name,
+                                []).append(i)
+        for dtype, members in by_dtype.items():
+            total = sum(leaves[i].size for i in members)
+            padded = -(-max(total, 1) // world) * world
+            groups.append(ShardGroup(
+                key=f"b{b}/{dtype}", dtype=dtype,
+                indices=tuple(members),
+                sizes=tuple(int(leaves[i].size) for i in members),
+                shapes=tuple(tuple(leaves[i].shape) for i in members),
+                padded=padded, shard=padded // world))
+    return FusionSpec(groups=tuple(groups), world=world,
+                      num_leaves=len(leaves))
+
+
+def _group_flat(group: ShardGroup, leaves: Sequence[jax.Array],
+                prescale: Optional[float] = None) -> jax.Array:
+    """Concatenate + zero-pad a group's leaves into its wire buffer."""
+    flat = jnp.concatenate(
+        [jnp.ravel(_scale(leaves[i], prescale)) for i in group.indices]) \
+        if group.indices else jnp.zeros((0,), jnp.dtype(group.dtype))
+    pad = group.padded - flat.shape[0]
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat
+
+
+def local_fusion_shards(leaves: Sequence[jax.Array], spec: FusionSpec,
+                        axis: AxisSpec = GLOBAL_AXES) -> Dict[str, jax.Array]:
+    """This rank's slice of every fused group buffer — no collective,
+    just concat + ``dynamic_slice`` at ``rank * shard``.  The sharded
+    optimizer uses this to see the *parameter* values co-located with
+    the gradient shard it owns."""
+    me = axis_index(axis)
+    out: Dict[str, jax.Array] = {}
+    for g in spec.groups:
+        flat = _group_flat(g, leaves)
+        out[g.key] = lax.dynamic_slice(flat, (me * g.shard,), (g.shard,))
+    return out
+
+
+def grouped_reducescatter(xs: Sequence[jax.Array],
+                          op: ReduceOp = Sum,
+                          axis: AxisSpec = GLOBAL_AXES,
+                          prescale_factor: Optional[float] = None,
+                          postscale_factor: Optional[float] = None,
+                          quantized_bits: Optional[int] = None,
+                          bucket_bytes: Optional[int] = None,
+                          spec: Optional[FusionSpec] = None):
+    """Fused reduce-scatter of many tensors — the first half of the
+    ZeRO-style rewrite of :func:`grouped_allreduce` (reduce-scatter →
+    shard-local math → allgather), with the same fusion machinery:
+    per-(bucket, dtype) flat buffers, zero-padding to shard-divisible
+    lengths, and the int8 wire of :func:`quantized_allreduce` via
+    ``quantized_bits=8``.
+
+    Returns ``(shards, spec)``: ``shards`` maps each
+    :class:`ShardGroup` key to this rank's reduced ``(shard,)`` slice;
+    ``spec`` is the static plan :func:`grouped_allgather` (or
+    :func:`local_fusion_shards`) consumes.  ``bucket_bytes`` splits
+    the exchange into reverse-layer-order buckets so XLA can overlap
+    each bucket's collective with the rest of backward (see
+    :func:`horovod_tpu.ops.bucketing.plan_buckets`); ``None`` keeps
+    the monolithic single-bucket exchange.
+
+    Degenerate 1-shard worlds reduce to plain identity semantics: the
+    "shard" is the whole (padded) buffer and ``psum_scatter`` over a
+    size-1 axis is the local value itself.
+    """
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("grouped_reducescatter supports op=Sum/Average")
+    world = int(axis_size(axis))
+    if spec is None:
+        spec = make_fusion_spec(xs, world, bucket_bytes)
+    elif spec.world != world:
+        raise ValueError(
+            f"spec was planned for world {spec.world}, axis has {world}")
+    ax = axis if isinstance(axis, str) else tuple(axis)
+    shards: Dict[str, jax.Array] = {}
+    for g in spec.groups:
+        flat = _group_flat(g, xs, prescale_factor)
+        floating = jnp.issubdtype(flat.dtype, jnp.floating)
+        if quantized_bits is not None and floating:
+            # pad rides the last segment: zeros never raise its absmax
+            segs = list(g.sizes)
+            segs[-1] += g.padded - sum(g.sizes)
+            red = quantized_reducescatter(flat, axis=axis, op=op,
+                                          bits=quantized_bits,
+                                          segments=tuple(segs))
+        else:
+            red = lax.psum_scatter(flat, ax, tiled=True)
+            if op == ReduceOp.AVERAGE and floating:
+                red = _scale(red, 1.0 / world)
+            elif op == ReduceOp.AVERAGE:
+                raise ValueError(
+                    "op=Average requires floating dtypes, got "
+                    f"{g.dtype}")
+        shards[g.key] = _scale(red, postscale_factor)
+    return shards, spec
+
+
+def grouped_allgather(shards: Dict[str, jax.Array], spec: FusionSpec,
+                      axis: AxisSpec = GLOBAL_AXES) -> list:
+    """Reassemble per-rank group shards into full tensors — the second
+    half of the sharded exchange.  All-gathers each group buffer
+    (innermost mesh axis first, so concatenation order matches
+    :func:`axis_index`'s row-major linearization), strips the padding,
+    and splits back into the original leaf order.  The exact inverse
+    of :func:`grouped_reducescatter`'s packing."""
+    out: list = [None] * spec.num_leaves
+    for g in spec.groups:
+        flat = allgather(shards[g.key], axis=axis, tiled=True)
+        offset = 0
+        for i, n, shape in zip(g.indices, g.sizes, g.shapes):
+            out[i] = flat[offset:offset + n].reshape(shape)
+            offset += n
+    return out
 
 
 def sparse_allreduce(values: jax.Array, indices: jax.Array,

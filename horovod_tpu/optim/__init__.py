@@ -11,8 +11,10 @@ from horovod_tpu.optim.optimizer import (
     DistributedAdasumOptimizer,
     DistributedGradientTape,
     DistributedOptimizer,
+    ShardedOptimizerState,
     adasum_updates,
     distributed_gradients,
+    sharded_distributed_update,
 )
 from horovod_tpu.optim.sync_batch_norm import SyncBatchNorm, sync_batch_stats
 from horovod_tpu.optim.train_step import DistributedTrainStep, join_step
@@ -21,8 +23,10 @@ __all__ = [
     "DistributedOptimizer",
     "DistributedAdasumOptimizer",
     "DistributedGradientTape",
+    "ShardedOptimizerState",
     "distributed_gradients",
     "adasum_updates",
+    "sharded_distributed_update",
     "DistributedTrainStep",
     "join_step",
     "SyncBatchNorm",

@@ -199,6 +199,117 @@ def distributed_gradients(op: ReduceOp = Average,
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+class ShardedOptimizerState(NamedTuple):
+    """State of :func:`sharded_distributed_update`: the wrapped
+    optimizer's state over this rank's flat gradient shards — 1/N of
+    the replicated-state footprint per rank."""
+
+    inner: object
+
+
+def _static_world(axis: AxisSpec) -> int:
+    """World size of ``axis`` as a static int — from the bound mesh
+    axes when tracing inside shard_map, else from the runtime mesh
+    (init-time use outside the mesh context)."""
+    try:
+        return int(C.axis_size(axis))
+    except Exception:
+        pass
+    from horovod_tpu.runtime import state as _rt
+
+    if _rt.is_initialized():
+        mesh = _rt.global_state().mesh
+        names = (axis,) if isinstance(axis, str) else tuple(axis)
+        n = 1
+        for a in names:
+            n *= mesh.shape[a]
+        return n
+    raise RuntimeError(
+        "sharded optimizer needs a bound mesh axis (inside shard_map) "
+        "or an initialized runtime to size its shards; call hvd.init() "
+        "first")
+
+
+def sharded_distributed_update(optimizer: optax.GradientTransformation,
+                               op: ReduceOp = Average,
+                               axis: AxisSpec = GLOBAL_AXES,
+                               prescale_factor: Optional[float] = None,
+                               postscale_factor: Optional[float] = None,
+                               quantized_bits: Optional[int] = None,
+                               bucket_bytes: Optional[int] = None,
+                               world: Optional[int] = None
+                               ) -> optax.GradientTransformation:
+    """ZeRO-style sharded rewrite of ``chain(distributed_gradients,
+    optimizer)``: reduce-scatter the gradients, run ``optimizer`` on
+    this rank's 1/N flat shard only, allgather the resulting updates.
+
+    Numerically equivalent to allreduce-then-update for *elementwise*
+    optimizers (SGD, momentum, Adam/AdamW, RMSProp, …): their update
+    of element ``i`` depends only on the gradient/parameter history of
+    element ``i``, so sharding the flat buffer commutes with the math
+    (pinned by ``tests/test_optimizer.py``).  Transforms that couple
+    elements globally (``clip_by_global_norm``, factored second
+    moments) would see shard-local statistics — compose those *before*
+    this wrapper or keep the replicated path.
+
+    What it buys (the reduce-scatter decomposition of allreduce):
+
+    * optimizer state is shard-sized — 1/N memory per rank;
+    * optimizer math runs on 1/N elements — 1/N update FLOPs;
+    * the wire carries the same ``2·(N-1)/N·B`` as a ring allreduce,
+      but split into two phases XLA can schedule independently —
+      reduce-scatter overlapping backward, allgather overlapping the
+      shard update — and, with ``bucket_bytes``, further chunked in
+      reverse-layer order for earlier overlap (arXiv:2305.06942's
+      fused compute-collective argument).
+
+    ``params`` passed to ``update`` are sliced to matching shards, so
+    parameter-coupled rules (weight decay) see co-located values.
+    State caveat (shared with the delta-Adasum form): each rank's
+    state covers only its shard, so a host read captures rank 0's
+    shard — checkpoint/restore of sharded state must go through the
+    exchange-aware helpers, not raw rank-0 convention.
+    """
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("sharded_distributed_update supports "
+                         "op=Sum/Average")
+
+    def _spec(leaves):
+        # ``world`` pins the shard sizing when init runs outside any
+        # mesh context against a non-runtime mesh (DistributedTrainStep
+        # passes its own mesh's size); otherwise derive it
+        return C.make_fusion_spec(
+            leaves, world if world is not None else _static_world(axis),
+            bucket_bytes)
+
+    def init_fn(params):
+        leaves = jax.tree_util.tree_leaves(params)
+        spec = _spec(leaves)
+        template = {g.key: jnp.zeros((g.shard,), jnp.dtype(g.dtype))
+                    for g in spec.groups}
+        return ShardedOptimizerState(inner=optimizer.init(template))
+
+    def update_fn(updates, state, params=None):
+        leaves, treedef = jax.tree_util.tree_flatten(updates)
+        shards, spec = C.grouped_reducescatter(
+            leaves, op=op, axis=axis,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            quantized_bits=quantized_bits,
+            bucket_bytes=bucket_bytes)
+        p_shards = None
+        if params is not None:
+            p_leaves = jax.tree_util.tree_leaves(params)
+            p_shards = C.local_fusion_shards(p_leaves, spec, axis=axis)
+        upd_shards, inner = optimizer.update(shards, state.inner,
+                                             p_shards)
+        out = C.grouped_allgather(upd_shards, spec, axis=axis)
+        return jax.tree_util.tree_unflatten(treedef, out), \
+            ShardedOptimizerState(inner=inner)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          named_parameters=None,
                          op: ReduceOp = Average,
@@ -209,7 +320,9 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          prescale_factor: Optional[float] = None,
                          postscale_factor: Optional[float] = None,
                          sparse_params: Optional[dict] = None,
-                         gradient_predivide_factor: float = 1.0
+                         gradient_predivide_factor: float = 1.0,
+                         shard_optimizer_states: bool = False,
+                         exchange_bucket_bytes: Optional[int] = None
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so each update uses cross-replica-reduced
     gradients (reference ``DistributedOptimizer`` factory,
@@ -222,8 +335,42 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     MultiSteps, so skipped micro-steps do no communication, matching the
     reference's delayed-allreduce semantics (``torch/optimizer.py``
     backward_passes_per_step counting).
+
+    ``shard_optimizer_states=True`` replaces allreduce-then-update with
+    the ZeRO-style reduce-scatter → shard-local update → allgather
+    exchange (:func:`sharded_distributed_update`): same parameters
+    within dtype tolerance, 1/N optimizer memory and update FLOPs per
+    rank, and a two-phase wire XLA overlaps with backward.
+    ``exchange_bucket_bytes`` chunks that exchange into
+    reverse-layer-order buckets for earlier overlap.  Requires
+    ``mode='shard_map'`` and an elementwise ``optimizer`` (see the
+    sharded transform's docstring).
     """
     del named_parameters
+    if exchange_bucket_bytes is not None and not shard_optimizer_states:
+        raise ValueError(
+            "exchange_bucket_bytes buckets the sharded exchange; pass "
+            "shard_optimizer_states=True to enable it")
+    if shard_optimizer_states:
+        if mode != "shard_map":
+            raise ValueError(
+                "shard_optimizer_states requires mode='shard_map' (the "
+                "exchange is explicit per-device code; pjit autodiff "
+                "already reduced the gradients densely)")
+        if sparse_params:
+            raise ValueError(
+                "shard_optimizer_states is incompatible with "
+                "sparse_params: sparse leaves bypass the fused flat "
+                "buffer the shard slicing is defined over")
+        if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            raise ValueError(
+                "shard_optimizer_states supports op=Sum/Average")
+        qbits = getattr(compression, "wire_reduce_bits", None)
+        if compression is not None and qbits is None:
+            raise ValueError(
+                "shard_optimizer_states supports only wire-reduction "
+                "compression (Compression.int8); compressor-style "
+                "codecs would decompress before the shard slicing")
     if gradient_predivide_factor != 1.0:
         # reference semantics (torch/optimizer.py:119-123): split the
         # averaging across the sum — grads scale by 1/f before and f/size
@@ -237,6 +384,17 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                 "prescale/postscale factors, not both")
         prescale_factor = 1.0 / gradient_predivide_factor
         postscale_factor = gradient_predivide_factor
+    if shard_optimizer_states:
+        chained = sharded_distributed_update(
+            optimizer, op=op, axis=axis,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            quantized_bits=qbits,
+            bucket_bytes=exchange_bucket_bytes)
+        if backward_passes_per_step > 1:
+            return optax.MultiSteps(
+                chained, every_k_schedule=backward_passes_per_step)
+        return chained
     chained = optax.chain(
         distributed_gradients(op=op, axis=axis, mode=mode,
                               compression=compression,

@@ -75,7 +75,9 @@ class DistributedTrainStep:
                  compiler_options: Optional[dict] = None,
                  sparse_params: Optional[dict] = None,
                  fsdp_axis: Optional[str] = None,
-                 fsdp_min_weight_size: Optional[int] = None):
+                 fsdp_min_weight_size: Optional[int] = None,
+                 shard_optimizer_states: bool = False,
+                 exchange_bucket_bytes: Optional[int] = None):
         """``steps_per_call > 1`` scans that many optimizer steps inside
         the one compiled program (the Keras ``steps_per_execution``
         knob): one dispatch amortizes per-call host/launch overhead —
@@ -92,11 +94,42 @@ class DistributedTrainStep:
         collectives ZeRO-3 schedules by hand (see
         :mod:`horovod_tpu.parallel.fsdp`).  Typically ``"ici"`` on the
         runtime mesh so gathers ride the fast interconnect while the
-        batch stays sharded over (dcn, ici)."""
+        batch stays sharded over (dcn, ici).
+
+        ``shard_optimizer_states=True`` (shard_map mode) swaps the
+        monolithic post-backward allreduce for the ZeRO-style bucketed
+        reduce-scatter → shard-local optimizer update → allgather
+        exchange (:func:`horovod_tpu.optim.sharded_distributed_update`):
+        numerically equivalent parameters, 1/N optimizer memory and
+        update FLOPs per rank, and a collective schedule XLA overlaps
+        with backward.  ``exchange_bucket_bytes`` splits the exchange
+        into reverse-layer-order buckets for earlier overlap (measured
+        by ``utils/overlap_probe.py``)."""
         self._mesh = mesh or state.global_state().mesh
         self._mode = mode
         self._optimizer = optimizer
         self._op = op
+        if shard_optimizer_states:
+            if mode != "shard_map":
+                raise ValueError(
+                    "shard_optimizer_states requires mode='shard_map' "
+                    "(the explicit exchange; under pjit use fsdp_axis, "
+                    "where GSPMD inserts the sharded collectives)")
+            if op is None or op not in (C.ReduceOp.SUM,
+                                        C.ReduceOp.AVERAGE):
+                raise ValueError(
+                    "shard_optimizer_states performs the gradient "
+                    "reduction itself and supports op=Sum/Average")
+            if sparse_params:
+                raise ValueError(
+                    "shard_optimizer_states is incompatible with "
+                    "sparse_params (sparse leaves bypass the fused "
+                    "flat buffer the shard slicing is defined over)")
+        elif exchange_bucket_bytes is not None:
+            raise ValueError(
+                "exchange_bucket_bytes buckets the sharded exchange; "
+                "pass shard_optimizer_states=True to enable it")
+        self._shard_opt = shard_optimizer_states
         if fsdp_axis is not None and mode != "pjit":
             raise ValueError(
                 "fsdp_axis requires mode='pjit' (GSPMD inserts the "
@@ -200,7 +233,27 @@ class DistributedTrainStep:
 
             axes = self._data_axes
 
-            if op is not None:
+            if shard_optimizer_states:
+                from horovod_tpu.optim.optimizer import (
+                    sharded_distributed_update,
+                )
+
+                qbits = getattr(compression, "wire_reduce_bits", None)
+                if compression is not None and qbits is None:
+                    raise ValueError(
+                        "shard_optimizer_states supports only "
+                        "wire-reduction compression (Compression.int8)")
+                # the sharded exchange owns the reduction AND the
+                # optimizer: RS -> shard-local update -> AG of updates
+                world = 1
+                for a in axes:
+                    world *= self._mesh.shape[a]
+                self._optimizer = sharded_distributed_update(
+                    optimizer, op=op, axis=axes,
+                    quantized_bits=qbits,
+                    bucket_bytes=exchange_bucket_bytes,
+                    world=world)
+            elif op is not None:
                 from horovod_tpu.optim.optimizer import distributed_gradients
 
                 reducer = distributed_gradients(
@@ -209,7 +262,7 @@ class DistributedTrainStep:
 
             def per_device(params, opt_state, batch):
                 loss, grads = jax.value_and_grad(self._loss_fn)(params, batch)
-                if self._op is not None:
+                if self._op is not None and not self._shard_opt:
                     grads, _ = reducer.update(grads, optax.EmptyState())
                 # op=None: gradients stay local — the optimizer chain owns
                 # the cross-shard reduction (the delta-Adasum form, where
@@ -224,7 +277,11 @@ class DistributedTrainStep:
             # genuinely replicated (the reducer or the delta-form
             # optimizer chain makes every shard's update identical), but
             # with op=None the *optimizer state* (e.g. Adasum-wrapped
-            # momenta) is per-rank by construction.  Host reads and
+            # momenta) is per-rank by construction — and with
+            # shard_optimizer_states=True deliberately so: each rank
+            # stores only its 1/N flat state shard (the ZeRO memory
+            # saving); the shard-shaped leaves ride the P() boundary as
+            # per-device values.  Host reads and
             # checkpoints of that state then capture device 0's copy —
             # deliberately matching the reference's rank-0-checkpoint
             # semantics (save on rank 0, broadcast on restore); a

@@ -32,9 +32,16 @@ from jax import lax
 from jax.sharding import PartitionSpec
 
 from horovod_tpu.parallel.mesh import AXIS_TP
+from horovod_tpu.utils import logging as hvd_logging
 
 Dtype = Any
 AxisSpec = Union[str, Sequence[str]]
+
+# one-time flag: a partitioned module running with no constrainable
+# ambient mesh silently computes fully replicated (see _constrain);
+# warn on the first occurrence only — the condition repeats every
+# trace and per-layer spam would bury the signal
+_warned_no_ambient_mesh = False
 
 
 # ---------------------------------------------------------------------------
@@ -85,7 +92,20 @@ def _constrain(x, *spec):
     propagate, not silently replicate."""
     mesh_axes = _constrainable_axes()
     wanted = {s for s in spec if isinstance(s, str)}
-    if mesh_axes is None or not wanted <= mesh_axes:
+    if mesh_axes is None:
+        global _warned_no_ambient_mesh
+        if not _warned_no_ambient_mesh:
+            _warned_no_ambient_mesh = True
+            hvd_logging.warning(
+                "tensor-parallel module executed with no ambient mesh: "
+                "kernel sharding constraints for axes %s were skipped, "
+                "so the module computes fully REPLICATED (no tensor "
+                "parallelism). Run it under `with mesh:` / "
+                "`jax.sharding.use_mesh(mesh)` over a mesh carrying "
+                "those axes, or inside shard_map with hand-placed "
+                "collectives.", sorted(wanted))
+        return x
+    if not wanted <= mesh_axes:
         return x
     return jax.lax.with_sharding_constraint(x, PartitionSpec(*spec))
 
@@ -93,7 +113,17 @@ def _constrain(x, *spec):
 class ColumnParallelDense(nn.Module):
     """Dense with output features sharded over ``axis`` (kernel partition
     spec ``(None, axis)``).  Forward needs no collective; pair with
-    :class:`RowParallelDense` to close the block with one psum."""
+    :class:`RowParallelDense` to close the block with one psum.
+
+    **Ambient-mesh requirement**: the sharding constraints that make
+    the module actually tensor-parallel only apply when it executes
+    under an ambient mesh carrying ``axis`` — ``with mesh:`` or
+    ``jax.sharding.use_mesh(mesh)`` around the jitted ``apply`` (see
+    :func:`horovod_tpu.parallel.mesh.make_parallel_mesh`).  With no
+    ambient mesh the module still computes correct values but fully
+    replicated, and a one-time warning is logged.  Inside ``shard_map``
+    the axes are Manual and constraints are skipped by design — use the
+    explicit :func:`column_parallel_dense` there."""
 
     features: int
     axis: str = AXIS_TP
@@ -122,7 +152,13 @@ class ColumnParallelDense(nn.Module):
 class RowParallelDense(nn.Module):
     """Dense with input features sharded over ``axis`` (kernel partition
     spec ``(axis, None)``); the partial products are summed by XLA's
-    inserted collective under pjit.  Bias is added after the reduction."""
+    inserted collective under pjit.  Bias is added after the reduction.
+
+    Same **ambient-mesh requirement** as :class:`ColumnParallelDense`:
+    without a ``with mesh:`` / ``use_mesh`` context carrying ``axis``
+    the constraints are skipped (one-time warning) and the module runs
+    replicated; inside ``shard_map`` use the explicit
+    :func:`row_parallel_dense` instead."""
 
     features: int
     axis: str = AXIS_TP

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+import socket
 import time
 from typing import Any, Optional
 
@@ -19,6 +20,25 @@ from horovod_tpu.utils import logging as hvd_logging
 
 DEFAULT_TTL_S = 600.0
 _TTL_ENV = "HOROVOD_TPU_DISCOVERY_CACHE_TTL"
+
+
+def tcp_reachable(ip: str, port: int = 22, timeout_s: float = 1.0) -> bool:
+    """Cheap liveness check for a cached rank-0 IP: one TCP connect.
+
+    A completed handshake proves the host is up and routable; so does a
+    REFUSED connect (the RST came *from that host* — nothing listening
+    on ``port`` is fine, we only validate addressing).  Only a timeout
+    or a routing error (host renumbered, NIC gone, network moved) marks
+    the cached IP stale.  Well inside the TTL a host can re-IP — DHCP
+    churn, pod rescheduling — and a launcher that trusts the entry then
+    burns the full startup timeout; one connect costs ~an RTT."""
+    try:
+        with socket.create_connection((ip, port), timeout=timeout_s):
+            return True
+    except ConnectionRefusedError:
+        return True
+    except OSError:
+        return False
 
 
 def _default_path() -> str:

@@ -143,7 +143,12 @@ class ProbeDriver:
                            if i not in self._results]
             raise TimeoutError(
                 f"NIC probe incomplete after {timeout_s}s; no result from "
-                f"task(s) {missing} — host(s) unreachable or blocked")
+                f"task(s) {missing} — host(s) unreachable or blocked. "
+                f"If a previous launch cached discovery results for "
+                f"these hosts (~/.cache/horovod_tpu/"
+                f"discovery_cache.json), a stale entry may be "
+                f"addressing a moved host: retry with --disable-cache "
+                f"or delete the cache file")
         with self._lock:
             common = None
             for ifaces in self._results.values():
@@ -277,20 +282,34 @@ def discover_common_interfaces(hostnames: List[str], spawn_task,
 
 def probe_common_and_rank0(hostnames: List[str], spawn_task,
                            secret_key: Optional[str] = None,
-                           timeout_s: float = 60.0, cache=None):
+                           timeout_s: float = 60.0, cache=None,
+                           validate_port: int = 22):
     """``(common_interfaces, {iface: rank0_ip})`` — the two facts a
     launcher consumes from the ring probe — with an optional on-disk TTL
     cache (reference ``runner/util/cache.py``: repeated launches against
     the same host set skip the ssh + probe round trip; an expired or
     missing entry re-probes).  Only interface/IP facts are cached —
-    ports are per-run ephemera."""
+    ports are per-run ephemera.
+
+    A hit is trusted only after a cheap TCP connect to a cached rank-0
+    IP (``validate_port``, normally the ssh port the launcher will use
+    anyway): hosts can re-IP inside the TTL, and a stale address would
+    otherwise surface as a full startup-timeout hang instead of one
+    extra probe round trip."""
     params = {"probe": hostnames}
     if cache is not None:
         hit = cache.get(params)
         if hit is not None:
-            hvd_logging.debug("NIC discovery: warm cache hit for %s",
-                              hostnames)
-            return hit["common"], hit["rank0"]
+            from horovod_tpu.runner.cache import tcp_reachable
+
+            ips = sorted(set(hit["rank0"].values()))
+            if any(tcp_reachable(ip, validate_port) for ip in ips):
+                hvd_logging.debug("NIC discovery: warm cache hit for %s",
+                                  hostnames)
+                return hit["common"], hit["rank0"]
+            hvd_logging.info(
+                "NIC discovery: cached rank-0 IP(s) %s failed the TCP "
+                "liveness check; falling through to a fresh probe", ips)
     common, driver = discover_common_interfaces(
         hostnames, spawn_task, secret_key, timeout_s)
     try:

@@ -210,7 +210,8 @@ def _discover_coordinator_addr(hosts: List[HostInfo], args) -> str:
 
             cache = DiscoveryCache()
         common, rank0_ips = probe_common_and_rank0(
-            hostnames, spawn, key, cache=cache)
+            hostnames, spawn, key, cache=cache,
+            validate_port=args.ssh_port or 22)
         if requested_nics is not None:
             # --network-interface: the user's list wins, but the probe
             # still supplies rank-0's IP on that interface (the launcher

@@ -60,6 +60,37 @@ class PingTask:
     while the task's fn is computing)."""
 
 
+class _SpawnEnvApplier:
+    """Per-spawn ``RunFunction.env`` application with restore-to-baseline.
+
+    An executor task serves MANY spawns in one process; a bare
+    ``os.environ.update(cmd.env)`` per spawn leaks every
+    ``HOROVOD_*``/extra_env key the next spawn does not overwrite —
+    e.g. a rank that moves between generations keeps the old spawn's
+    coordinator or generation number wherever the new env omits a key.
+    Before applying a spawn's env, every key the *previous* spawn set
+    is restored to its pre-first-spawn value (deleted if it was unset),
+    so each spawn starts from the executor's baseline environment."""
+
+    def __init__(self, environ=None):
+        self._environ = os.environ if environ is None else environ
+        self._baseline: Dict[str, Optional[str]] = {}
+        self._applied: tuple = ()
+
+    def apply(self, env: Dict[str, str]) -> None:
+        for k in self._applied:
+            old = self._baseline[k]
+            if old is None:
+                self._environ.pop(k, None)
+            else:
+                self._environ[k] = old
+        for k in env:
+            if k not in self._baseline:
+                self._baseline[k] = self._environ.get(k)
+        self._environ.update(env)
+        self._applied = tuple(env)
+
+
 class ElasticTaskResult:
     """Executor → driver: one spawn's return value (or ``_TaskError``)."""
 
@@ -109,6 +140,7 @@ def _elastic_task_fn(driver_addr, key: str, payload: bytes) -> Callable:
                 index, socket.gethostname(), hh, service.address,
                 task_id=uuid.uuid4().hex))
             func, fargs, fkwargs = cloudpickle.loads(payload)
+            env_applier = _e._SpawnEnvApplier()
             while True:
                 try:
                     cmd = cmds.get(timeout=60.0)
@@ -116,7 +148,9 @@ def _elastic_task_fn(driver_addr, key: str, payload: bytes) -> Callable:
                     continue     # idle growth capacity; keep serving pings
                 if cmd is None:
                     break
-                os.environ.update(cmd.env)
+                # stale HOROVOD_*/extra_env keys from the previous spawn
+                # must not leak into this one
+                env_applier.apply(cmd.env)
                 try:
                     value = func(*fargs, **fkwargs)
                 except BaseException as e:  # noqa: BLE001 - to the driver

@@ -56,6 +56,7 @@ class CollectiveOp:
     replica_groups: Optional[str]  # raw attribute text, None if absent
     group_size: Optional[int]      # devices per group, None if unknown
     line: str                      # the full HLO line (diagnostics)
+    asynchronous: bool = False     # issued as a -start/-done pair
 
     @property
     def dtypes(self) -> set:
@@ -117,17 +118,19 @@ def collective_ops(hlo_text: str) -> List[CollectiveOp]:
             continue
         result_type, kind, is_async = m.group(1), m.group(2), m.group(3)
         shapes = _parse_shapes(result_type)
-        # async starts of gather/permute carry `(input, output, ...)`
-        # tuples (plus scalar context values on TPU); the payload is the
-        # output alone — summing the whole tuple double-counts
-        if is_async and kind in ("all-gather", "collective-permute") \
+        # async starts of gather/scatter/permute carry `(input, output,
+        # ...)` tuples (plus scalar context values on TPU); the payload
+        # is the output alone — summing the whole tuple double-counts
+        if is_async and kind in ("all-gather", "reduce-scatter",
+                                 "collective-permute") \
                 and len(shapes) >= 2:
             shapes = [shapes[1]]
         raw, gsize = _replica_groups(line)
         ops.append(CollectiveOp(kind=kind, shapes=shapes,
                                 bytes=_nbytes(shapes),
                                 replica_groups=raw, group_size=gsize,
-                                line=line.strip()))
+                                line=line.strip(),
+                                asynchronous=bool(is_async)))
     return ops
 
 

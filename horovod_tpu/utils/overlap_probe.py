@@ -1,0 +1,169 @@
+"""Measured comm/compute overlap for the DP gradient exchange.
+
+The scaling model (``utils/scaling.py``, ``docs/scaling.md``) needs an
+``overlap_fraction`` — how much of the gradient collective hides under
+backward compute.  Until now that number was *assumed*; this probe
+measures it on whatever devices are present, the way the reference
+measures rather than models its benchmark tables
+(``docs/benchmarks.rst``).
+
+Method — three compiled programs over the same mesh, batch and
+parameters:
+
+* **backward-only**: forward + backward, gradients consumed locally
+  (no collective);
+* **exchange-only**: the bucketed reduce-scatter → allgather exchange
+  on gradient-shaped inputs (no model compute);
+* **fused**: the real train-step body — backward feeding the exchange
+  inside one program, where XLA's latency-hiding scheduler is free to
+  interleave them.
+
+If the scheduler achieves nothing, ``t_fused ≈ t_backward +
+t_exchange``; if the shorter phase hides completely under the longer,
+``t_fused ≈ max(t_backward, t_exchange)``.  The achieved fraction is::
+
+    overlap = (t_backward + t_exchange - t_fused) / min(t_backward,
+                                                        t_exchange)
+
+clamped to [0, 1].  Each timing fences on a host fetch of a scalar
+(the same discipline as ``bench.py``: ``block_until_ready`` can lie
+through remote-device tunnels) and takes the median over ``iters``
+calls.  On a 1-chip world the exchange is pure data movement with no
+wire, so the fraction is reported but near-meaningless — the probe
+exists to be run on real slices, and the bench records it per run so
+the scaling table can cite a measured number
+(``BENCH_*.json: overlap_fraction``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu.ops import collectives as C
+from horovod_tpu.ops.collectives import Average, ReduceOp
+from horovod_tpu.runtime import state
+from horovod_tpu.runtime.topology import GLOBAL_AXES
+
+AxisSpec = Union[str, Sequence[str]]
+
+
+@dataclasses.dataclass
+class OverlapReport:
+    """One probe run: the three phase timings and the derived overlap."""
+
+    backward_s: float
+    exchange_s: float
+    fused_s: float
+    overlap_fraction: float
+    world: int
+    payload_bytes: int
+
+    def as_bench_fields(self, prefix: str = "") -> dict:
+        """The fields ``bench.py`` merges into the bench JSON."""
+        return {
+            f"{prefix}overlap_fraction": round(self.overlap_fraction, 4),
+            f"{prefix}overlap_backward_s": round(self.backward_s, 6),
+            f"{prefix}overlap_exchange_s": round(self.exchange_s, 6),
+            f"{prefix}overlap_fused_s": round(self.fused_s, 6),
+        }
+
+
+def _median_time(fn, args, iters: int, warmup: int) -> float:
+    for _ in range(warmup):
+        out = fn(*args)
+        float(np.asarray(jax.device_get(out)))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        float(np.asarray(jax.device_get(out)))   # host fetch = fence
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def measure_overlap(loss_fn: Callable,
+                    params,
+                    batch,
+                    mesh=None,
+                    axis: AxisSpec = GLOBAL_AXES,
+                    op: ReduceOp = Average,
+                    bucket_bytes: Optional[int] = None,
+                    iters: int = 5,
+                    warmup: int = 2) -> OverlapReport:
+    """Measure backward/exchange/fused timings for ``loss_fn`` over the
+    (dcn, ici) mesh and return the achieved overlap fraction.
+
+    ``params`` replicated, ``batch`` sharded along ``axis`` — the same
+    contract as ``DistributedTrainStep``.  ``bucket_bytes`` buckets the
+    exchange exactly as ``exchange_bucket_bytes`` would in the train
+    step, so the probe measures the schedule the step will actually
+    run."""
+    mesh = mesh or state.global_state().mesh
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    world = 1
+    for a in axes:
+        world *= mesh.shape[a]
+
+    shard_map = jax.shard_map
+    in_p = (P(), P(axes))
+
+    def grads_of(params, batch):
+        _, grads = jax.value_and_grad(loss_fn)(params, batch)
+        return grads
+
+    def fingerprint(tree) -> jax.Array:
+        leaves = jax.tree_util.tree_leaves(tree)
+        return sum(jnp.sum(jnp.abs(x).astype(jnp.float32))
+                   for x in leaves)
+
+    def exchange(grads):
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        shards, spec = C.grouped_reducescatter(
+            leaves, op=op, axis=axes, bucket_bytes=bucket_bytes)
+        out = C.grouped_allgather(shards, spec, axis=axes)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def backward_only(params, batch):
+        return fingerprint(grads_of(params, batch))
+
+    def exchange_only(grads):
+        return fingerprint(exchange(grads))
+
+    def fused(params, batch):
+        return fingerprint(exchange(grads_of(params, batch)))
+
+    bwd = jax.jit(shard_map(backward_only, mesh=mesh, in_specs=in_p,
+                            out_specs=P(), check_vma=False))
+    fsd = jax.jit(shard_map(fused, mesh=mesh, in_specs=in_p,
+                            out_specs=P(), check_vma=False))
+
+    # gradient-shaped input for the exchange-only program: computed
+    # once, replicated, so its timing contains zero backward work
+    repl = NamedSharding(mesh, P())
+    grads = jax.device_put(
+        jax.jit(shard_map(grads_of, mesh=mesh, in_specs=in_p,
+                          out_specs=P(), check_vma=False))(params, batch),
+        repl)
+    exc = jax.jit(shard_map(exchange_only, mesh=mesh, in_specs=(P(),),
+                            out_specs=P(), check_vma=False))
+
+    t_bwd = _median_time(bwd, (params, batch), iters, warmup)
+    t_exc = _median_time(exc, (grads,), iters, warmup)
+    t_fsd = _median_time(fsd, (params, batch), iters, warmup)
+
+    saved = t_bwd + t_exc - t_fsd
+    denom = min(t_bwd, t_exc)
+    frac = saved / denom if denom > 0 else 0.0
+    payload = sum(x.size * x.dtype.itemsize
+                  for x in jax.tree_util.tree_leaves(grads))
+    return OverlapReport(
+        backward_s=t_bwd, exchange_s=t_exc, fused_s=t_fsd,
+        overlap_fraction=float(np.clip(frac, 0.0, 1.0)),
+        world=world, payload_bytes=int(payload))

@@ -8,7 +8,11 @@ asserts command composition as strings).
 
 import importlib
 import os
-import tomllib
+
+try:
+    import tomllib                      # 3.11+
+except ModuleNotFoundError:             # 3.10 image: same API from tomli
+    import tomli as tomllib
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -481,6 +481,77 @@ class TestNicDiscovery:
                                cache=expired)
         assert len(spawns) == 5
 
+    def test_tcp_reachable_semantics(self):
+        """Listening and connection-refused both prove the host is
+        alive and routable; only timeouts/route errors mark it stale."""
+        import socket
+
+        from horovod_tpu.runner.cache import tcp_reachable
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        s.listen(1)
+        port = s.getsockname()[1]
+        try:
+            assert tcp_reachable("127.0.0.1", port)
+        finally:
+            s.close()
+        # closed port: RST still comes from the host — alive
+        assert tcp_reachable("127.0.0.1", port)
+
+    def test_stale_cached_ip_falls_through_to_probe(self, tmp_path,
+                                                    monkeypatch):
+        """A warm hit whose rank-0 IP fails the TCP liveness check must
+        re-probe instead of handing the launcher a dead coordinator
+        address (ADVICE round 5)."""
+        import threading
+
+        import horovod_tpu.runner.cache as cache_mod
+        from horovod_tpu.runner.cache import DiscoveryCache
+        from horovod_tpu.runner.driver_service import (
+            probe_common_and_rank0,
+            run_probe_task,
+        )
+
+        hosts = ["localhost", "localhost"]
+        cache = DiscoveryCache(path=str(tmp_path / "cache.json"),
+                               ttl_s=3600)
+        cache.put({"probe": hosts},
+                  {"common": ["eth9"], "rank0": {"eth9": "192.0.2.1"}})
+
+        checked = []
+        monkeypatch.setattr(
+            cache_mod, "tcp_reachable",
+            lambda ip, port=22, timeout_s=1.0:
+            checked.append((ip, port)) or False)
+
+        spawns = []
+
+        def spawn(host, index, driver_addr):
+            spawns.append(index)
+            threading.Thread(target=run_probe_task,
+                             args=(driver_addr, index, "k"),
+                             daemon=True).start()
+
+        common, rank0 = probe_common_and_rank0(
+            hosts, spawn, "k", timeout_s=30, cache=cache,
+            validate_port=2222)
+        assert checked == [("192.0.2.1", 2222)]
+        assert len(spawns) == 2               # fell through to a probe
+        assert rank0 and "192.0.2.1" not in rank0.values()
+        # and the fresh (validatable) result replaced the stale entry
+        assert cache.get({"probe": hosts})["rank0"] == rank0
+
+    def test_probe_timeout_mentions_cache(self):
+        from horovod_tpu.runner.driver_service import ProbeDriver
+
+        driver = ProbeDriver(1, "k")
+        try:
+            with pytest.raises(TimeoutError, match="disable-cache"):
+                driver.wait_common_interfaces(timeout_s=0.05)
+        finally:
+            driver.shutdown()
+
     def test_discovery_cache_roundtrip_and_expiry(self, tmp_path):
         import time as _time
 

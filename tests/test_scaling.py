@@ -23,10 +23,12 @@ class TestWireBytes:
         assert effs[-1] < 2e9
 
     def test_step_payload_matches_compiled_step(self, hvd_runtime):
-        """The model's payload accounting equals the byte count of the
-        one fused all-reduce in the compiled step — the number
-        docs/scaling.md feeds the ring model is the compiled truth, not
-        an estimate."""
+        """The model's payload accounting equals the gradient bytes the
+        compiled step's all-reduces carry — the number docs/scaling.md
+        feeds the ring model is the compiled truth, not an estimate.
+        (This image's CPU XLA runs no all-reduce combiner pass, so the
+        payload may ride several per-leaf ops instead of one fused op;
+        the invariant is the byte SUM — gradients + the scalar loss.)"""
         hvd = hvd_runtime
 
         class Net(nn.Module):
@@ -47,8 +49,9 @@ class TestWireBytes:
         batch = step.shard_batch({"x": jnp.zeros((16, 32), jnp.float32),
                                   "y": jnp.zeros((16,), jnp.int32)})
         ops = H.collective_ops(step.compiled_text(params, opt, batch))
-        (ar,) = [o for o in ops if o.kind == "all-reduce"]
-        assert ar.bytes == S.step_payload_bytes(init)
+        ars = [o for o in ops if o.kind == "all-reduce"]
+        assert ars
+        assert sum(o.bytes for o in ars) == S.step_payload_bytes(init)
 
 
 class TestEfficiencyModel:

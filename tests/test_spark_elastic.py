@@ -118,6 +118,46 @@ class TestSparkElastic:
                                    num_proc=1, min_np=2, max_np=4)
 
 
+class TestSpawnEnvApplier:
+    """Env hygiene across elastic respawns (ADVICE round 5): keys set by
+    the previous RunFunction must restore to the executor's baseline
+    before the next spawn's env applies — no stale HOROVOD_* leaks."""
+
+    def test_stale_keys_restore_to_baseline(self):
+        from horovod_tpu.spark.elastic import _SpawnEnvApplier
+
+        env = {"PATH": "/bin", "HOROVOD_SECRET_KEY": "original"}
+        a = _SpawnEnvApplier(environ=env)
+        a.apply({"HOROVOD_ELASTIC_GENERATION": "0",
+                 "HOROVOD_COORDINATOR_ADDR": "10.0.0.1:99",
+                 "HOROVOD_SECRET_KEY": "k1",
+                 "MY_EXTRA": "x"})
+        assert env["HOROVOD_ELASTIC_GENERATION"] == "0"
+        assert env["MY_EXTRA"] == "x"
+        # next spawn omits MY_EXTRA and the coordinator: both must not
+        # leak through, and the pre-spawn secret must be restorable
+        a.apply({"HOROVOD_ELASTIC_GENERATION": "1",
+                 "HOROVOD_SECRET_KEY": "k2"})
+        assert env["HOROVOD_ELASTIC_GENERATION"] == "1"
+        assert env["HOROVOD_SECRET_KEY"] == "k2"
+        assert "MY_EXTRA" not in env
+        assert "HOROVOD_COORDINATOR_ADDR" not in env
+        assert env["PATH"] == "/bin"        # untouched keys untouched
+
+    def test_baseline_value_survives_on_off_on(self):
+        from horovod_tpu.spark.elastic import _SpawnEnvApplier
+
+        env = {"HOROVOD_LOG_LEVEL": "info"}
+        a = _SpawnEnvApplier(environ=env)
+        a.apply({"HOROVOD_LOG_LEVEL": "debug"})
+        a.apply({})                          # spawn without the key
+        assert env["HOROVOD_LOG_LEVEL"] == "info"
+        a.apply({"HOROVOD_LOG_LEVEL": "trace"})
+        assert env["HOROVOD_LOG_LEVEL"] == "trace"
+        a.apply({})
+        assert env["HOROVOD_LOG_LEVEL"] == "info"
+
+
 class TestExecutorPool:
     """Driver-side pool units: liveness completes dead tasks' runs and
     drops them from discovery; uuid keys survive index reuse."""
