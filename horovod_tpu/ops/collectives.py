@@ -420,6 +420,111 @@ def grouped_reducescatter(xs: Sequence[jax.Array],
     return shards, spec
 
 
+def exchange_index_axes(outer_axis: str = AXIS_DCN,
+                        inner_axis: str = AXIS_ICI) -> Tuple[str, str]:
+    """Axis tuple whose row-major linearization matches the shard
+    ownership of :func:`hierarchical_reducescatter`.
+
+    The two-level exchange reduce-scatters over ``inner_axis`` first
+    (the intra-slice ICI phase), then over ``outer_axis`` (the
+    cross-slice DCN phase), so the rank holding flat-buffer block ``k``
+    satisfies ``k = inner_index * outer_size + outer_index`` — row-major
+    over ``(inner, outer)``, NOT the mesh's usual ``(outer, inner)``.
+    Feed this tuple to :func:`local_fusion_shards` /
+    :func:`grouped_allgather` (and :func:`axis_index`) so parameter
+    slices and reassembly line up with the hierarchical ownership."""
+    return (inner_axis, outer_axis)
+
+
+def hierarchical_reducescatter(xs: Sequence[jax.Array],
+                               op: ReduceOp = Sum,
+                               outer_axis: str = AXIS_DCN,
+                               inner_axis: str = AXIS_ICI,
+                               prescale_factor: Optional[float] = None,
+                               postscale_factor: Optional[float] = None,
+                               quantized_bits: Optional[int] = None,
+                               bucket_bytes: Optional[int] = None,
+                               spec: Optional[FusionSpec] = None):
+    """Topology-aware two-level reduce-scatter — the reduce phase of the
+    hierarchical exchange (reference ``NCCLHierarchicalAllreduce``,
+    ``nccl_operations.cc:191-341``: NCCL inside the node, MPI across).
+
+    Phase 1 reduce-scatters each fused group buffer over ``inner_axis``
+    (chips within an ICI slice: the cheap torus hop carries the full
+    ``(n_ici-1)/n_ici·B``).  Phase 2 reduce-scatters the surviving
+    ``1/n_ici`` partial-sum block over ``outer_axis`` — the slow DCN hop
+    therefore carries only ``(n_dcn-1)/n_dcn·B/n_ici`` bytes, which is
+    the whole point of splitting the levels.  ``quantized_bits=8`` puts
+    the int8 shared-scale codec of :func:`quantized_reducescatter` on
+    the DCN phase ONLY: wire compression where the fabric is slow, full
+    precision where it is already fast (EQuARX's topology-scoped
+    compression argument, arXiv:2506.17615).  The codec scale is shared
+    per (bucket, dtype, inner-shard) block — per-leaf segment scales
+    cannot ride this hop because the inner scatter makes segment
+    boundaries rank-dependent (and XLA shapes must be static).
+
+    Returns ``(shards, spec)`` exactly like
+    :func:`grouped_reducescatter`, with the one twist that shard
+    ownership is linearized row-major over ``(inner, outer)`` — see
+    :func:`exchange_index_axes`.  Reassemble with
+    :func:`hierarchical_allgather` (cross-slice gather first, then
+    intra-slice — each level's traffic stays on its own fabric).
+
+    Degenerate axes (size-1 dcn on a single slice, or size-1 ici) fall
+    through cleanly: a ``psum_scatter`` over a 1-extent axis is the
+    local value, so the two-level form equals the flat one.
+    """
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("hierarchical_reducescatter supports "
+                         "op=Sum/Average")
+    n_inner = int(lax.axis_size(inner_axis))
+    n_outer = int(lax.axis_size(outer_axis))
+    world = n_inner * n_outer
+    if spec is None:
+        spec = make_fusion_spec(xs, world, bucket_bytes)
+    elif spec.world != world:
+        raise ValueError(
+            f"spec was planned for world {spec.world}, mesh "
+            f"({outer_axis},{inner_axis}) has {world}")
+    shards: Dict[str, jax.Array] = {}
+    for g in spec.groups:
+        flat = _group_flat(g, xs, prescale_factor)
+        floating = jnp.issubdtype(flat.dtype, jnp.floating)
+        if op == ReduceOp.AVERAGE and not floating:
+            raise ValueError(
+                f"op=Average requires floating dtypes, got {g.dtype}")
+        # phase 1 — intra-slice (ICI): full-precision reduce-scatter;
+        # g.padded is a multiple of world = n_inner * n_outer, so the
+        # surviving block length is still divisible by n_outer
+        block = lax.psum_scatter(flat, inner_axis, tiled=True)
+        # phase 2 — cross-slice (DCN) on the 1/n_inner block
+        if quantized_bits is not None and floating:
+            red = quantized_reducescatter(block, axis=outer_axis,
+                                          op=ReduceOp.SUM,
+                                          bits=quantized_bits)
+        else:
+            red = lax.psum_scatter(block, outer_axis, tiled=True)
+        if op == ReduceOp.AVERAGE:
+            red = _scale(red, 1.0 / world)
+        shards[g.key] = _scale(red, postscale_factor)
+    return shards, spec
+
+
+def hierarchical_allgather(shards: Dict[str, jax.Array], spec: FusionSpec,
+                           outer_axis: str = AXIS_DCN,
+                           inner_axis: str = AXIS_ICI) -> list:
+    """Reassemble the shards of :func:`hierarchical_reducescatter` —
+    the gather phase of the two-level exchange, mirrored: all-gather
+    across ``outer_axis`` first while the buffers are still 1/world
+    sized (the DCN hop moves the minimum possible bytes), then across
+    ``inner_axis`` on the fast fabric.  Gathering over the
+    ``(inner, outer)`` tuple makes the concatenation order row-major
+    over exactly the ownership linearization of the scatter (see
+    :func:`exchange_index_axes`), so this is its precise inverse."""
+    return grouped_allgather(
+        shards, spec, axis=exchange_index_axes(outer_axis, inner_axis))
+
+
 def grouped_allgather(shards: Dict[str, jax.Array], spec: FusionSpec,
                       axis: AxisSpec = GLOBAL_AXES) -> list:
     """Reassemble per-rank group shards into full tensors — the second

@@ -28,7 +28,7 @@ opt)``, wrapped in ``optax.MultiSteps`` when ``backward_passes_per_step >
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Sequence, Union
+from typing import NamedTuple, Optional, Sequence, Tuple, Union
 
 import os
 
@@ -38,7 +38,11 @@ import optax
 
 from horovod_tpu.ops import collectives as C
 from horovod_tpu.ops.collectives import Average, ReduceOp
-from horovod_tpu.runtime.topology import GLOBAL_AXES
+from horovod_tpu.runtime.topology import (
+    GLOBAL_AXES,
+    HIERARCHY_MODES,
+    resolve_hierarchy,
+)
 
 AxisSpec = Union[str, Sequence[str]]
 
@@ -230,6 +234,26 @@ def _static_world(axis: AxisSpec) -> int:
         "first")
 
 
+def _static_axis_sizes(axis: AxisSpec) -> Tuple[int, ...]:
+    """Per-axis extents of ``axis``, static — bound mesh axes when
+    tracing inside shard_map, else the runtime mesh (the same two
+    sources as :func:`_static_world`, kept per-axis so the hierarchy
+    decision can see the (dp_outer, dp_inner) factorization)."""
+    names = (axis,) if isinstance(axis, str) else tuple(axis)
+    try:
+        return tuple(int(C.axis_size(a)) for a in names)
+    except Exception:
+        pass
+    from horovod_tpu.runtime import state as _rt
+
+    if _rt.is_initialized():
+        mesh = _rt.global_state().mesh
+        return tuple(int(mesh.shape[a]) for a in names)
+    raise RuntimeError(
+        "hierarchy resolution needs a bound mesh axis (inside "
+        "shard_map) or an initialized runtime; call hvd.init() first")
+
+
 def sharded_distributed_update(optimizer: optax.GradientTransformation,
                                op: ReduceOp = Average,
                                axis: AxisSpec = GLOBAL_AXES,
@@ -237,11 +261,24 @@ def sharded_distributed_update(optimizer: optax.GradientTransformation,
                                postscale_factor: Optional[float] = None,
                                quantized_bits: Optional[int] = None,
                                bucket_bytes: Optional[int] = None,
-                               world: Optional[int] = None
+                               world: Optional[int] = None,
+                               hierarchy: str = "auto"
                                ) -> optax.GradientTransformation:
     """ZeRO-style sharded rewrite of ``chain(distributed_gradients,
     optimizer)``: reduce-scatter the gradients, run ``optimizer`` on
     this rank's 1/N flat shard only, allgather the resulting updates.
+
+    ``hierarchy`` selects the exchange topology.  ``"flat"`` is the
+    single-scope PR-1 exchange over the linearized ``axis`` tuple;
+    ``"two_level"`` reduce-scatters within each ICI slice first and
+    runs the cross-slice (DCN) phase on the 1/n_inner shards
+    (:func:`horovod_tpu.ops.collectives.hierarchical_reducescatter`),
+    requiring ``axis`` to name ``(dp_outer, dp_inner)`` mesh axes;
+    ``"auto"`` (default) consults the axis factorization and picks
+    two_level exactly when both extents exceed 1
+    (:func:`horovod_tpu.runtime.topology.resolve_hierarchy`).  With
+    ``quantized_bits``, the two-level form scopes the int8 wire codec
+    to the DCN hop only — ICI hops stay full precision.
 
     Numerically equivalent to allreduce-then-update for *elementwise*
     optimizers (SGD, momentum, Adam/AdamW, RMSProp, …): their update
@@ -273,6 +310,15 @@ def sharded_distributed_update(optimizer: optax.GradientTransformation,
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError("sharded_distributed_update supports "
                          "op=Sum/Average")
+    if hierarchy not in HIERARCHY_MODES:
+        raise ValueError(
+            f"hierarchy must be one of {HIERARCHY_MODES}, got "
+            f"{hierarchy!r}")
+    axes_names = (axis,) if isinstance(axis, str) else tuple(axis)
+    if hierarchy == "two_level" and len(axes_names) != 2:
+        raise ValueError(
+            "hierarchy='two_level' needs a 2-axis (dp_outer, dp_inner) "
+            f"axis spec, got {axes_names}")
 
     def _spec(leaves):
         # ``world`` pins the shard sizing when init runs outside any
@@ -291,19 +337,37 @@ def sharded_distributed_update(optimizer: optax.GradientTransformation,
 
     def update_fn(updates, state, params=None):
         leaves, treedef = jax.tree_util.tree_flatten(updates)
-        shards, spec = C.grouped_reducescatter(
-            leaves, op=op, axis=axis,
-            prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor,
-            quantized_bits=quantized_bits,
-            bucket_bytes=bucket_bytes)
+        # resolved at trace time: inside shard_map the axis extents are
+        # static, so the branch compiles away and the program contains
+        # exactly one exchange topology
+        mode = resolve_hierarchy(hierarchy, _static_axis_sizes(axis))
+        if mode == "two_level":
+            outer, inner_ax = axes_names
+            shards, spec = C.hierarchical_reducescatter(
+                leaves, op=op, outer_axis=outer, inner_axis=inner_ax,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                quantized_bits=quantized_bits,
+                bucket_bytes=bucket_bytes)
+            # shard ownership is row-major over (inner, outer) — the
+            # param slices and the reassembly must use that linearization
+            own_axes = C.exchange_index_axes(outer, inner_ax)
+        else:
+            shards, spec = C.grouped_reducescatter(
+                leaves, op=op, axis=axis,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                quantized_bits=quantized_bits,
+                bucket_bytes=bucket_bytes)
+            own_axes = axis
         p_shards = None
         if params is not None:
             p_leaves = jax.tree_util.tree_leaves(params)
-            p_shards = C.local_fusion_shards(p_leaves, spec, axis=axis)
+            p_shards = C.local_fusion_shards(p_leaves, spec,
+                                             axis=own_axes)
         upd_shards, inner = optimizer.update(shards, state.inner,
                                              p_shards)
-        out = C.grouped_allgather(upd_shards, spec, axis=axis)
+        out = C.grouped_allgather(upd_shards, spec, axis=own_axes)
         return jax.tree_util.tree_unflatten(treedef, out), \
             ShardedOptimizerState(inner=inner)
 
@@ -322,7 +386,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          sparse_params: Optional[dict] = None,
                          gradient_predivide_factor: float = 1.0,
                          shard_optimizer_states: bool = False,
-                         exchange_bucket_bytes: Optional[int] = None
+                         exchange_bucket_bytes: Optional[int] = None,
+                         hierarchy: str = "auto"
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so each update uses cross-replica-reduced
     gradients (reference ``DistributedOptimizer`` factory,
@@ -342,7 +407,11 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     within dtype tolerance, 1/N optimizer memory and update FLOPs per
     rank, and a two-phase wire XLA overlaps with backward.
     ``exchange_bucket_bytes`` chunks that exchange into
-    reverse-layer-order buckets for earlier overlap.  Requires
+    reverse-layer-order buckets for earlier overlap, and ``hierarchy``
+    selects its topology — ``"auto"`` (default) runs the two-level
+    ICI-then-DCN exchange whenever the dp axes factor into
+    ``(dp_outer, dp_inner)`` extents both > 1, ``"flat"``/``"two_level"``
+    force a mode (see :func:`sharded_distributed_update`).  Requires
     ``mode='shard_map'`` and an elementwise ``optimizer`` (see the
     sharded transform's docstring).
     """
@@ -350,6 +419,10 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     if exchange_bucket_bytes is not None and not shard_optimizer_states:
         raise ValueError(
             "exchange_bucket_bytes buckets the sharded exchange; pass "
+            "shard_optimizer_states=True to enable it")
+    if hierarchy != "auto" and not shard_optimizer_states:
+        raise ValueError(
+            "hierarchy selects the sharded exchange topology; pass "
             "shard_optimizer_states=True to enable it")
     if shard_optimizer_states:
         if mode != "shard_map":
@@ -390,7 +463,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
             quantized_bits=qbits,
-            bucket_bytes=exchange_bucket_bytes)
+            bucket_bytes=exchange_bucket_bytes,
+            hierarchy=hierarchy)
         if backward_passes_per_step > 1:
             return optax.MultiSteps(
                 chained, every_k_schedule=backward_passes_per_step)

@@ -77,7 +77,8 @@ class DistributedTrainStep:
                  fsdp_axis: Optional[str] = None,
                  fsdp_min_weight_size: Optional[int] = None,
                  shard_optimizer_states: bool = False,
-                 exchange_bucket_bytes: Optional[int] = None):
+                 exchange_bucket_bytes: Optional[int] = None,
+                 hierarchy: str = "auto"):
         """``steps_per_call > 1`` scans that many optimizer steps inside
         the one compiled program (the Keras ``steps_per_execution``
         knob): one dispatch amortizes per-call host/launch overhead —
@@ -104,7 +105,17 @@ class DistributedTrainStep:
         update FLOPs per rank, and a collective schedule XLA overlaps
         with backward.  ``exchange_bucket_bytes`` splits the exchange
         into reverse-layer-order buckets for earlier overlap (measured
-        by ``utils/overlap_probe.py``)."""
+        by ``utils/overlap_probe.py``).
+
+        ``hierarchy`` picks the sharded exchange's topology:
+        ``"auto"`` (default) resolves against the data-axes
+        factorization — the two-level ICI-then-DCN exchange whenever
+        both ``(dp_outer, dp_inner)`` extents exceed 1, flat otherwise
+        (:func:`horovod_tpu.runtime.topology.resolve_hierarchy`);
+        ``"flat"``/``"two_level"`` force a mode.  When unset here, the
+        runtime config's ``HOROVOD_EXCHANGE_HIERARCHY`` /
+        ``HOROVOD_EXCHANGE_BUCKET_BYTES`` env knobs supply the
+        defaults (docs/overlap.md)."""
         self._mesh = mesh or state.global_state().mesh
         self._mode = mode
         self._optimizer = optimizer
@@ -129,6 +140,19 @@ class DistributedTrainStep:
             raise ValueError(
                 "exchange_bucket_bytes buckets the sharded exchange; "
                 "pass shard_optimizer_states=True to enable it")
+        elif hierarchy != "auto":
+            raise ValueError(
+                "hierarchy selects the sharded exchange topology; pass "
+                "shard_optimizer_states=True to enable it")
+        if shard_optimizer_states and state.is_initialized():
+            # env-contract defaults (HOROVOD_EXCHANGE_*): explicit
+            # arguments rule; unset knobs fall back to runtime config
+            cfg = state.global_state().config
+            if exchange_bucket_bytes is None:
+                exchange_bucket_bytes = cfg.exchange_bucket_bytes
+            if hierarchy == "auto" and cfg.exchange_hierarchy:
+                hierarchy = cfg.exchange_hierarchy
+        self._hierarchy = hierarchy
         self._shard_opt = shard_optimizer_states
         if fsdp_axis is not None and mode != "pjit":
             raise ValueError(
@@ -252,7 +276,15 @@ class DistributedTrainStep:
                     optimizer, op=op, axis=axes,
                     quantized_bits=qbits,
                     bucket_bytes=exchange_bucket_bytes,
-                    world=world)
+                    world=world,
+                    hierarchy=hierarchy)
+                from horovod_tpu.runtime.topology import resolve_hierarchy
+
+                # the mode the compiled step will actually run (the
+                # "auto" decision made static against this mesh) — what
+                # bench.py emits as exchange_hierarchy
+                self._hierarchy = resolve_hierarchy(
+                    hierarchy, [self._mesh.shape[a] for a in axes])
             elif op is not None:
                 from horovod_tpu.optim.optimizer import distributed_gradients
 
@@ -302,6 +334,13 @@ class DistributedTrainStep:
         self._compiled_cache: dict = {}      # insertion-ordered LRU
 
     _COMPILED_CACHE_MAX = 16
+
+    @property
+    def exchange_hierarchy(self):
+        """The exchange topology this step runs: ``"two_level"``/
+        ``"flat"`` once resolved against the mesh (sharded exchange),
+        the raw knob (``"auto"``) when no sharded exchange is active."""
+        return self._hierarchy
 
     def init(self, params):
         """Place params on the mesh replicated and build optimizer state.

@@ -82,6 +82,12 @@ class Config:
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
 
+    # -- sharded gradient exchange (shard_optimizer_states paths):
+    # bucket byte cap and hierarchy mode defaults, overridable per
+    # train step; "auto" consults the mesh factorization at build time
+    exchange_bucket_bytes: Optional[int] = None
+    exchange_hierarchy: str = "auto"
+
     # -- autotune (reference parameter_manager.h:58-78)
     autotune: bool = False
     autotune_log: Optional[str] = None
@@ -125,6 +131,8 @@ class Config:
         mark("HOROVOD_CACHE_CAPACITY", "cache_capacity")
         mark("HOROVOD_HIERARCHICAL_ALLREDUCE", "hierarchical_allreduce")
         mark("HOROVOD_HIERARCHICAL_ALLGATHER", "hierarchical_allgather")
+        mark("HOROVOD_EXCHANGE_BUCKET_BYTES", "exchange_bucket_bytes")
+        mark("HOROVOD_EXCHANGE_HIERARCHY", "exchange_hierarchy")
 
         def opt_int(name: str) -> Optional[int]:
             v = os.environ.get(name)
@@ -159,6 +167,9 @@ class Config:
                 "HOROVOD_HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=_env_bool(
                 "HOROVOD_HIERARCHICAL_ALLGATHER", False),
+            exchange_bucket_bytes=opt_int("HOROVOD_EXCHANGE_BUCKET_BYTES"),
+            exchange_hierarchy=_env_str(
+                "HOROVOD_EXCHANGE_HIERARCHY", "auto").lower(),
             autotune=_env_bool("HOROVOD_AUTOTUNE", False),
             autotune_log=os.environ.get("HOROVOD_AUTOTUNE_LOG"),
             autotune_warmup_samples=_env_int("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3),

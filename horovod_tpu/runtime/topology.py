@@ -94,3 +94,46 @@ def build_mesh(mesh_shape: Optional[str] = None,
 
 def mesh_size(mesh: Mesh) -> int:
     return int(np.prod(list(mesh.shape.values())))
+
+
+#: Valid values of the exchange ``hierarchy`` knob
+#: (DistributedOptimizer / DistributedTrainStep / sharded exchange).
+HIERARCHY_MODES = ("auto", "flat", "two_level")
+
+
+def num_slices(devices: Optional[Sequence[jax.Device]] = None) -> int:
+    """Public form of the slice detector :func:`build_mesh` uses for the
+    dcn extent: distinct ``slice_index`` values (process count off-TPU)."""
+    return _detect_num_slices(jax.devices() if devices is None else devices)
+
+
+def resolve_hierarchy(hierarchy: str, axis_sizes: Sequence[int]) -> str:
+    """Resolve the ``hierarchy="auto"|"flat"|"two_level"`` knob against
+    the data-parallel axis factorization — the decision rule of the
+    two-level exchange.
+
+    ``axis_sizes`` are the extents of the dp axis spec in mesh order,
+    i.e. ``(dp_outer, dp_inner)`` = ``(dcn, ici)`` for the runtime mesh.
+    ``"auto"`` picks ``"two_level"`` exactly when the factorization is
+    real — two axes, both extent > 1 — because that is when the two
+    fabrics are actually distinct: a 1-slice mesh (dcn=1) has no DCN hop
+    to scope, and a 1-chip-per-slice mesh has no ICI phase to exploit,
+    so both degenerate to ``"flat"`` (identical wire, one less collective
+    scope to schedule).  ``"two_level"`` demands the 2-D factorization
+    and raises otherwise — an explicit request must not silently flatten.
+    """
+    if hierarchy not in HIERARCHY_MODES:
+        raise ValueError(
+            f"hierarchy must be one of {HIERARCHY_MODES}, got "
+            f"{hierarchy!r}")
+    sizes = [int(s) for s in axis_sizes]
+    factored = len(sizes) == 2 and all(s > 1 for s in sizes)
+    if hierarchy == "two_level":
+        if len(sizes) != 2:
+            raise ValueError(
+                "hierarchy='two_level' needs a 2-axis (dp_outer, "
+                f"dp_inner) data-parallel spec, got {len(sizes)} axis/es")
+        return "two_level"
+    if hierarchy == "flat":
+        return "flat"
+    return "two_level" if factored else "flat"

@@ -139,3 +139,17 @@ def count_by_kind(ops: List[CollectiveOp]) -> dict:
     for o in ops:
         out[o.kind] = out.get(o.kind, 0) + 1
     return out
+
+
+def scopes_by_kind(ops: List[CollectiveOp]) -> dict:
+    """kind → sorted tuple of distinct replica-group sizes — the
+    *scope* structure of a module's collectives.  The hierarchical
+    exchange's signature is ``{"reduce-scatter": (dcn, ici), ...}``:
+    two distinct scopes, one per mesh level, where the flat exchange
+    shows a single world-sized scope.  ``None`` group sizes (HLO's
+    "all devices" spellings) are kept so a scopeless op can't hide."""
+    out: dict = {}
+    for o in ops:
+        out.setdefault(o.kind, set()).add(o.group_size)
+    return {k: tuple(sorted(v, key=lambda s: (s is None, s)))
+            for k, v in out.items()}

@@ -49,14 +49,18 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from horovod_tpu.ops import collectives as C
 from horovod_tpu.ops.collectives import Average, ReduceOp
 from horovod_tpu.runtime import state
-from horovod_tpu.runtime.topology import GLOBAL_AXES
+from horovod_tpu.runtime.topology import GLOBAL_AXES, resolve_hierarchy
+from horovod_tpu.utils import hlo as H
 
 AxisSpec = Union[str, Sequence[str]]
 
 
 @dataclasses.dataclass
 class OverlapReport:
-    """One probe run: the three phase timings and the derived overlap."""
+    """One probe run: the three phase timings, the derived overlap, and
+    — for the hierarchical exchange — the per-level attribution plus the
+    compiled collective structure (which scopes actually exist on the
+    wire, straight from the optimized HLO of the exchange program)."""
 
     backward_s: float
     exchange_s: float
@@ -64,15 +68,34 @@ class OverlapReport:
     overlap_fraction: float
     world: int
     payload_bytes: int
+    hierarchy: str = "flat"
+    # two-level only: the intra-slice (ICI) share of the exchange time
+    # and the cross-slice (DCN) remainder — measured, not modeled
+    exchange_intra_s: Optional[float] = None
+    exchange_cross_s: Optional[float] = None
+    # compiled structure of the exchange program: kind → distinct
+    # replica-group sizes (two reduce-scatter scopes == two levels)
+    rs_scopes: tuple = ()
+    ag_scopes: tuple = ()
+    grad_sized_allreduces: int = 0
 
     def as_bench_fields(self, prefix: str = "") -> dict:
         """The fields ``bench.py`` merges into the bench JSON."""
-        return {
+        fields = {
             f"{prefix}overlap_fraction": round(self.overlap_fraction, 4),
             f"{prefix}overlap_backward_s": round(self.backward_s, 6),
             f"{prefix}overlap_exchange_s": round(self.exchange_s, 6),
             f"{prefix}overlap_fused_s": round(self.fused_s, 6),
+            f"{prefix}exchange_hierarchy": self.hierarchy,
         }
+        if self.exchange_intra_s is not None:
+            fields[f"{prefix}overlap_exchange_intra_s"] = \
+                round(self.exchange_intra_s, 6)
+            fields[f"{prefix}overlap_exchange_cross_s"] = \
+                round(self.exchange_cross_s, 6)
+        if self.rs_scopes:
+            fields[f"{prefix}exchange_rs_scopes"] = list(self.rs_scopes)
+        return fields
 
 
 def _median_time(fn, args, iters: int, warmup: int) -> float:
@@ -95,6 +118,7 @@ def measure_overlap(loss_fn: Callable,
                     axis: AxisSpec = GLOBAL_AXES,
                     op: ReduceOp = Average,
                     bucket_bytes: Optional[int] = None,
+                    hierarchy: str = "auto",
                     iters: int = 5,
                     warmup: int = 2) -> OverlapReport:
     """Measure backward/exchange/fused timings for ``loss_fn`` over the
@@ -103,13 +127,25 @@ def measure_overlap(loss_fn: Callable,
     ``params`` replicated, ``batch`` sharded along ``axis`` — the same
     contract as ``DistributedTrainStep``.  ``bucket_bytes`` buckets the
     exchange exactly as ``exchange_bucket_bytes`` would in the train
-    step, so the probe measures the schedule the step will actually
-    run."""
+    step, and ``hierarchy`` selects its topology exactly as the step's
+    knob would (``"auto"`` resolves against the mesh factorization), so
+    the probe measures the schedule the step will actually run.
+
+    Two-level runs additionally report (a) per-level timing
+    attribution — an intra-slice-only RS/AG program is timed separately
+    and the cross-slice remainder is the difference, clamped at zero —
+    and (b) the compiled collective *structure* of the exchange program
+    (distinct reduce-scatter/all-gather scopes, count of gradient-sized
+    all-reduces), parsed from its optimized HLO.  The structure fields
+    are what the HLO guard tests pin; the bench JSON carries them so a
+    silent topology regression is visible in the run artifact too."""
     mesh = mesh or state.global_state().mesh
     axes = (axis,) if isinstance(axis, str) else tuple(axis)
     world = 1
     for a in axes:
         world *= mesh.shape[a]
+    mode = resolve_hierarchy(hierarchy,
+                             [mesh.shape[a] for a in axes])
 
     shard_map = jax.shard_map
     in_p = (P(), P(axes))
@@ -125,9 +161,27 @@ def measure_overlap(loss_fn: Callable,
 
     def exchange(grads):
         leaves, treedef = jax.tree_util.tree_flatten(grads)
+        if mode == "two_level":
+            outer, inner = axes
+            shards, spec = C.hierarchical_reducescatter(
+                leaves, op=op, outer_axis=outer, inner_axis=inner,
+                bucket_bytes=bucket_bytes)
+            out = C.hierarchical_allgather(shards, spec,
+                                           outer_axis=outer,
+                                           inner_axis=inner)
+        else:
+            shards, spec = C.grouped_reducescatter(
+                leaves, op=op, axis=axes, bucket_bytes=bucket_bytes)
+            out = C.grouped_allgather(shards, spec, axis=axes)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def intra_exchange(grads):
+        # the ICI phase in isolation: RS/AG over the inner axis only —
+        # its timing is the intra-slice share of the full exchange
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
         shards, spec = C.grouped_reducescatter(
-            leaves, op=op, axis=axes, bucket_bytes=bucket_bytes)
-        out = C.grouped_allgather(shards, spec, axis=axes)
+            leaves, op=op, axis=axes[-1], bucket_bytes=bucket_bytes)
+        out = C.grouped_allgather(shards, spec, axis=axes[-1])
         return jax.tree_util.tree_unflatten(treedef, out)
 
     def backward_only(params, batch):
@@ -135,6 +189,9 @@ def measure_overlap(loss_fn: Callable,
 
     def exchange_only(grads):
         return fingerprint(exchange(grads))
+
+    def intra_only(grads):
+        return fingerprint(intra_exchange(grads))
 
     def fused(params, batch):
         return fingerprint(exchange(grads_of(params, batch)))
@@ -154,16 +211,42 @@ def measure_overlap(loss_fn: Callable,
     exc = jax.jit(shard_map(exchange_only, mesh=mesh, in_specs=(P(),),
                             out_specs=P(), check_vma=False))
 
+    # compiled structure of the exchange program (scopes per kind)
+    rs_scopes: tuple = ()
+    ag_scopes: tuple = ()
+    grad_ars = 0
+    payload = sum(x.size * x.dtype.itemsize
+                  for x in jax.tree_util.tree_leaves(grads))
+    try:
+        ops = H.collective_ops(
+            exc.lower(grads).compile().as_text())
+        scopes = H.scopes_by_kind(ops)
+        rs_scopes = scopes.get("reduce-scatter", ())
+        ag_scopes = scopes.get("all-gather", ())
+        grad_ars = sum(1 for o in ops if o.kind == "all-reduce"
+                       and o.bytes >= payload)
+    except Exception:      # noqa: BLE001 — structure report is advisory
+        pass
+
     t_bwd = _median_time(bwd, (params, batch), iters, warmup)
     t_exc = _median_time(exc, (grads,), iters, warmup)
     t_fsd = _median_time(fsd, (params, batch), iters, warmup)
 
+    t_intra = t_cross = None
+    if mode == "two_level":
+        itr = jax.jit(shard_map(intra_only, mesh=mesh, in_specs=(P(),),
+                                out_specs=P(), check_vma=False))
+        t_intra = _median_time(itr, (grads,), iters, warmup)
+        t_cross = max(0.0, t_exc - t_intra)
+
     saved = t_bwd + t_exc - t_fsd
     denom = min(t_bwd, t_exc)
     frac = saved / denom if denom > 0 else 0.0
-    payload = sum(x.size * x.dtype.itemsize
-                  for x in jax.tree_util.tree_leaves(grads))
     return OverlapReport(
         backward_s=t_bwd, exchange_s=t_exc, fused_s=t_fsd,
         overlap_fraction=float(np.clip(frac, 0.0, 1.0)),
-        world=world, payload_bytes=int(payload))
+        world=world, payload_bytes=int(payload),
+        hierarchy=mode,
+        exchange_intra_s=t_intra, exchange_cross_s=t_cross,
+        rs_scopes=rs_scopes, ag_scopes=ag_scopes,
+        grad_sized_allreduces=grad_ars)

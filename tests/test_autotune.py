@@ -132,6 +132,41 @@ class TestThroughputAutotuner:
         assert "units_per_sec" in rows[0] and "best" in rows[0]
         assert sum(1 for r in rows[1:] if r.endswith("*")) == 1
 
+    def test_cold_start_recovers_exchange_schedule(self, tmp_path):
+        """The exchange-schedule axes of bench.py --autotune
+        --shard-optimizer-states: (exchange_bucket_bytes, hierarchy)
+        must be recoverable from the un-tuned midpoint seed — the
+        cold-start contract spc/flash_block already satisfy — with
+        every sample in the CSV artifact."""
+        from horovod_tpu.utils.bench_autotune import ThroughputAutotuner
+
+        MiB = 1 << 20
+        # plausible surface: two_level helps at every bucket size (the
+        # DCN hop shrinks), bucketing peaks at 4 MiB then decays as
+        # per-collective latency dominates
+        bucket_gain = {0: 0.80, 1 * MiB: 0.95, 4 * MiB: 1.0,
+                       16 * MiB: 0.97, 64 * MiB: 0.9}
+        hier_gain = {"flat": 0.9, "two_level": 1.0}
+
+        def measure(point):
+            return 25_000 * bucket_gain[point["exchange_bucket_bytes"]] \
+                * hier_gain[point["hierarchy"]]
+
+        log = tmp_path / "exchange.csv"
+        tuner = ThroughputAutotuner(
+            measure,
+            {"exchange_bucket_bytes": [0, 1 * MiB, 4 * MiB,
+                                       16 * MiB, 64 * MiB],
+             "hierarchy": ["flat", "two_level"]},
+            log_path=str(log))
+        best, rate = tuner.run()
+        assert best == {"exchange_bucket_bytes": 4 * MiB,
+                        "hierarchy": "two_level"}
+        assert rate == 25_000
+        rows = log.read_text().splitlines()
+        assert "hierarchy" in rows[0] and "exchange_bucket_bytes" in rows[0]
+        assert sum(1 for r in rows[1:] if r.endswith("*")) == 1
+
     def test_seed_and_single_axis(self, tmp_path):
         from horovod_tpu.utils.bench_autotune import ThroughputAutotuner
 

@@ -1,0 +1,82 @@
+"""bench.py measurement-layer unit tests.
+
+Pins the trailing-window anomaly handling in ``median_rate`` (the
+BENCH_r05 finding: transformer iter 4 collapsing 25,364 -> 3,061 tok/s
+because deferred teardown work drained at the final timed fence): a
+sole final-iteration collapse is drained and re-measured once; genuine
+slowdowns and mid-run outliers are never rewritten.
+"""
+
+import time
+
+import pytest
+
+import bench
+
+
+def make_step(durations):
+    """step_fn whose i-th call sleeps durations[i] (0 when exhausted) —
+    the timed wall-clock is fully scripted."""
+    it = iter(durations)
+
+    def step(state):
+        time.sleep(next(it, 0.0))
+        return (0.5,)
+
+    return step
+
+
+FAST, SLOW = 0.01, 0.12
+
+
+def run(durations, iters=4):
+    return bench.median_rate(make_step(durations), (0.5,),
+                             warmup_batches=1, iters=iters,
+                             batches_per_iter=1, units_per_batch=1.0,
+                             label="test")
+
+
+class TestTrailingCollapse:
+    def test_sole_final_outlier_is_remeasured(self, capsys):
+        # warmup + 3 fast iters + 1 collapsed final; the drain and the
+        # re-measure both come back fast -> the collapse was teardown
+        # cost, the final rate is substituted and no warning fires
+        rate = run([0.0, FAST, FAST, FAST, SLOW, FAST, FAST])
+        assert rate == pytest.approx(1.0 / FAST, rel=0.5)
+        err = capsys.readouterr().err
+        assert "substituting" in err
+        assert "WARNING" not in err
+
+    def test_reproduced_slow_final_is_kept(self, capsys):
+        # the re-measure is just as slow -> a genuine trend, original
+        # rate stays and the deviation warning still fires
+        run([0.0, FAST, FAST, FAST, SLOW, SLOW, SLOW])
+        err = capsys.readouterr().err
+        assert "keeping the original" in err
+        assert "WARNING" in err
+
+    def test_mid_run_outlier_untouched(self, capsys):
+        # an outlier that is NOT the final window gets no re-measure
+        # (nothing to drain mid-run; it warns like before)
+        run([0.0, FAST, SLOW, FAST, FAST])
+        err = capsys.readouterr().err
+        assert "re-measure" not in err
+        assert "WARNING" in err
+
+    def test_fast_final_outlier_untouched(self, capsys):
+        # only LOW final outliers are teardown-shaped; an anomalously
+        # fast final window is left alone
+        run([0.0, SLOW, SLOW, SLOW, FAST])
+        err = capsys.readouterr().err
+        assert "re-measure" not in err
+
+    def test_clean_run_is_untouched(self, capsys):
+        rate = run([0.0, FAST, FAST, FAST, FAST])
+        assert rate == pytest.approx(1.0 / FAST, rel=0.5)
+        err = capsys.readouterr().err
+        assert "re-measure" not in err and "WARNING" not in err
+
+    def test_two_iter_runs_skip_the_heuristic(self, capsys):
+        # <3 samples can't distinguish an outlier from a trend
+        run([0.0, FAST, SLOW], iters=2)
+        assert "re-measure" not in capsys.readouterr().err

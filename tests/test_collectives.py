@@ -480,6 +480,141 @@ class TestBucketPlanner:
         assert plan_buckets([1, 2, 3], 0, reverse=False) == [[0, 1, 2]]
         assert plan_buckets([], 8) == []
 
+    def test_zero_byte_leaves_keep_their_slot(self):
+        from horovod_tpu.ops.bucketing import plan_buckets
+
+        # zero-element leaves (e.g. a frozen scalar head) cost nothing
+        # but must still land in exactly one bucket — dropping an index
+        # would desync the fusion spec's leaf accounting
+        plan = plan_buckets([0, 4, 0, 4], 4)
+        assert sorted(i for b in plan for i in b) == [0, 1, 2, 3]
+        # zero-byte leaves never close a bucket on their own
+        assert plan == [[3, 2], [1, 0]]
+
+    def test_all_zero_leaves_single_bucket(self):
+        from horovod_tpu.ops.bucketing import plan_buckets
+
+        assert plan_buckets([0, 0, 0], 8) == [[2, 1, 0]]
+
+    def test_boundary_exact_fit_closes_bucket(self):
+        from horovod_tpu.ops.bucketing import plan_buckets
+
+        # an exact fit does NOT split (cap is inclusive); one byte
+        # over does
+        assert plan_buckets([4, 4], 8) == [[1, 0]]
+        assert plan_buckets([4, 5], 8) == [[1], [0]]
+
+
+class TestFusionSpecEdgeCases:
+    """make_fusion_spec invariants for the planner's corner shapes —
+    zero-element leaves, dtype splits at bucket boundaries, oversized
+    single params, and shard sizing under a 2-D (dp_outer, dp_inner)
+    mesh factorization (the hierarchical exchange's world)."""
+
+    def test_zero_element_leaf_roundtrips(self):
+        """A zero-element leaf rides the exchange without corrupting
+        its bucket neighbours and comes back with its 0-shape."""
+        base = np.arange(22, dtype=np.float32)
+
+        def f():
+            xs = [jnp.asarray(base[:15]), jnp.zeros((0,), jnp.float32),
+                  jnp.asarray(base[15:])]
+            shards, spec = C.grouped_reducescatter(xs, op=C.Average)
+            out = C.grouped_allgather(shards, spec)
+            assert out[1].shape == (0,)
+            return out[0][None], out[2][None]
+
+        o0, o2 = run_spmd(f, out_specs=(P(GLOBAL_AXES), P(GLOBAL_AXES)))
+        for r in range(N):
+            np.testing.assert_allclose(np.asarray(o0)[r], base[:15],
+                                       rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(o2)[r], base[15:],
+                                       rtol=1e-6)
+
+    def test_all_empty_group_pads_to_world(self):
+        """A (bucket, dtype) cell of only zero-element leaves still
+        plans a minimal world-divisible wire buffer (padded >= world,
+        shard >= 1) — psum_scatter cannot tile a 0-length buffer."""
+        xs = [np.zeros((0,), np.float32)]
+        spec = C.make_fusion_spec([jnp.asarray(x) for x in xs], 8)
+        (g,) = spec.groups
+        assert g.padded == 8 and g.shard == 1
+        assert g.sizes == (0,)
+
+    def test_mixed_dtypes_split_within_one_bucket(self):
+        """Mixed dtypes at a bucket boundary: the bucket keeps ONE
+        index set but plans one wire group per member dtype, each
+        separately padded — no cross-dtype concatenation."""
+        leaves = [jnp.zeros((10,), jnp.float32),
+                  jnp.zeros((6,), jnp.bfloat16),
+                  jnp.zeros((5,), jnp.float32)]
+        # cap big enough for everything: single bucket, two dtype cells
+        spec = C.make_fusion_spec(leaves, 8, bucket_bytes=1 << 20)
+        keys = sorted(g.key for g in spec.groups)
+        assert keys == ["b0/bfloat16", "b0/float32"]
+        by_dtype = {g.dtype: g for g in spec.groups}
+        # reverse-layer walk: leaf 2 precedes leaf 0 in the f32 cell
+        assert by_dtype["float32"].indices == (2, 0)
+        assert by_dtype["float32"].padded == 16    # 15 -> 16
+        assert by_dtype["bfloat16"].padded == 8    # 6 -> 8
+
+    def test_single_param_larger_than_cap(self):
+        """One leaf bigger than exchange_bucket_bytes still gets its
+        own bucket and full-length (padded) wire buffer — the cap
+        bounds fusion, never truncates a tensor."""
+        leaves = [jnp.zeros((3,), jnp.float32),
+                  jnp.zeros((1000,), jnp.float32)]
+        spec = C.make_fusion_spec(leaves, 8, bucket_bytes=64)
+        assert len(spec.groups) == 2
+        big = next(g for g in spec.groups if g.indices == (1,))
+        assert big.padded == 1000 and big.shard == 125
+        small = next(g for g in spec.groups if g.indices == (0,))
+        assert small.padded == 8
+
+    def test_world_divisibility_under_2d_mesh(self):
+        """Bucket plans under a (dp_outer, dp_inner) = (2, 4) mesh:
+        every group's padded length divides world=8 AND the inner
+        extent, so the two-level exchange's phase-1 block (padded/4)
+        still tiles evenly over the outer extent — the invariant
+        hierarchical_reducescatter relies on."""
+        rng = np.random.RandomState(5)
+        leaves = [jnp.asarray(rng.randn(n).astype(np.float32))
+                  for n in (1, 3, 17, 129, 1000)]
+        for cap in (None, 64, 4 * 1024):
+            spec = C.make_fusion_spec(leaves, 8, bucket_bytes=cap)
+            assert sorted(i for g in spec.groups
+                          for i in g.indices) == list(range(5))
+            for g in spec.groups:
+                assert g.padded % 8 == 0
+                assert g.shard * 8 == g.padded
+                block = g.padded // 4          # after the ici phase
+                assert block % 2 == 0          # tiles over dcn
+
+    def test_2d_mesh_bucketed_two_level_roundtrip(self):
+        """End-to-end: byte-capped buckets + the two-level exchange on
+        the (2, 4) mesh reproduce the flat exchange's values for every
+        leaf — the planner's output is topology-agnostic."""
+        rng = np.random.RandomState(6)
+        base = [rng.randn(8, 15).astype(np.float32),
+                rng.randn(8, 7).astype(np.float32),
+                rng.randn(8, 13).astype(np.float32)]
+
+        def f():
+            r = C.axis_index(GLOBAL_AXES)
+            xs = [jnp.asarray(b)[r] for b in base]
+            shards, spec = C.hierarchical_reducescatter(
+                xs, op=C.Average, bucket_bytes=24 * 4)
+            out = C.hierarchical_allgather(shards, spec)
+            return tuple(x[None] for x in out)
+
+        outs = jax.jit(jax.shard_map(
+            f, mesh=make_mesh(), in_specs=(),
+            out_specs=tuple([P(GLOBAL_AXES)] * 3), check_vma=False))()
+        for got, b in zip(outs, base):
+            np.testing.assert_allclose(np.asarray(got),
+                                       np.broadcast_to(b.mean(0), b.shape),
+                                       rtol=1e-6, atol=1e-6)
+
 
 class TestControlPrimitives:
     def test_barrier(self):

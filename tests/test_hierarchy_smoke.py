@@ -1,0 +1,303 @@
+"""Fast two-level exchange smoke — tier-1's proof that the
+topology-aware hierarchical exchange equals the flat one.
+
+Runs entirely on the 8-device virtual CPU mesh (2 slices x 4 chips, the
+conftest default): reduce-scatter within each "ICI slice", cross-slice
+phase on the 1/4-sized shards, intra-slice allgather — and asserts
+parameter parity with the flat PR-1 exchange, tolerance-pinned in the
+same style as the allreduce-vs-RS/AG parity tests
+(``test_optimizer.py::TestShardedOptimizerStates``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.ops import collectives as C
+from horovod_tpu.runtime.topology import (
+    AXIS_DCN,
+    AXIS_ICI,
+    GLOBAL_AXES,
+    resolve_hierarchy,
+)
+
+
+@pytest.fixture(autouse=True)
+def runtime():
+    hvd.init()
+    yield
+    hvd.shutdown()
+
+
+def make_mesh():
+    devs = np.asarray(jax.devices("cpu")[:8]).reshape(2, 4)
+    return Mesh(devs, GLOBAL_AXES)
+
+
+def loss_fn(params, batch):
+    h = jnp.tanh(batch["x"] @ params["w1"] + params["b1"])
+    pred = h @ params["w2"] + params["b2"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def make_params(key):
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": jax.random.normal(k1, (4, 16)) * 0.1,
+        "b1": jnp.zeros((16,)),
+        "w2": jax.random.normal(k2, (16, 1)) * 0.1,
+        "b2": jnp.zeros((1,)),
+    }
+
+
+def make_batch(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = (x.sum(axis=1, keepdims=True) > 0).astype(np.float32)
+    return {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+
+
+class TestResolveHierarchy:
+    def test_auto_picks_two_level_on_factored_mesh(self):
+        assert resolve_hierarchy("auto", (2, 4)) == "two_level"
+
+    def test_auto_flattens_degenerate_axes(self):
+        assert resolve_hierarchy("auto", (1, 8)) == "flat"
+        assert resolve_hierarchy("auto", (8, 1)) == "flat"
+        assert resolve_hierarchy("auto", (8,)) == "flat"
+
+    def test_explicit_modes(self):
+        assert resolve_hierarchy("flat", (2, 4)) == "flat"
+        assert resolve_hierarchy("two_level", (2, 4)) == "two_level"
+        # an explicit two_level request must not silently flatten
+        with pytest.raises(ValueError, match="2-axis"):
+            resolve_hierarchy("two_level", (8,))
+        with pytest.raises(ValueError, match="hierarchy"):
+            resolve_hierarchy("bogus", (2, 4))
+
+
+class TestHierarchicalExchangeNumerics:
+    """RS -> AG roundtrip of the two-level exchange equals the flat
+    exchange and the closed-form psum, leaf for leaf."""
+
+    def _leaves(self):
+        r = C.axis_index(GLOBAL_AXES)
+        return [jnp.arange(10, dtype=jnp.float32) * (r + 1),
+                jnp.ones((3, 5), jnp.float32) * (r + 1),
+                jnp.full((7,), 2.0, jnp.float32) * (r + 1)]
+
+    def test_roundtrip_matches_flat_and_psum(self):
+        def inner():
+            leaves = self._leaves()
+            f_shards, f_spec = C.grouped_reducescatter(
+                leaves, op=C.Sum, axis=GLOBAL_AXES)
+            flat = C.grouped_allgather(f_shards, f_spec, axis=GLOBAL_AXES)
+            h_shards, h_spec = C.hierarchical_reducescatter(
+                leaves, op=C.Sum, outer_axis=AXIS_DCN, inner_axis=AXIS_ICI)
+            two = C.hierarchical_allgather(h_shards, h_spec,
+                                           outer_axis=AXIS_DCN,
+                                           inner_axis=AXIS_ICI)
+            exact = [lax_psum(x) for x in leaves]
+            return tuple(x[None] for x in two + flat + exact)
+
+        def lax_psum(x):
+            return jax.lax.psum(x, GLOBAL_AXES)
+
+        n = 3
+        out = jax.jit(jax.shard_map(
+            inner, mesh=make_mesh(), in_specs=(),
+            out_specs=(P(GLOBAL_AXES),) * (3 * n), check_vma=False))()
+        two, flat, exact = out[:n], out[n:2 * n], out[2 * n:]
+        for t, f, e in zip(two, flat, exact):
+            np.testing.assert_allclose(np.asarray(t), np.asarray(e),
+                                       rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(t), np.asarray(f),
+                                       rtol=1e-6)
+
+    def test_average_and_bucketed(self):
+        def inner():
+            leaves = self._leaves()
+            h_shards, h_spec = C.hierarchical_reducescatter(
+                leaves, op=C.Average, bucket_bytes=64)
+            two = C.hierarchical_allgather(h_shards, h_spec)
+            exact = [jax.lax.psum(x, GLOBAL_AXES) / 8.0 for x in leaves]
+            return tuple(x[None] for x in two + exact)
+
+        out = jax.jit(jax.shard_map(
+            inner, mesh=make_mesh(), in_specs=(),
+            out_specs=(P(GLOBAL_AXES),) * 6, check_vma=False))()
+        for t, e in zip(out[:3], out[3:]):
+            np.testing.assert_allclose(np.asarray(t), np.asarray(e),
+                                       rtol=1e-6)
+
+    def test_param_shards_align_with_ownership(self):
+        """local_fusion_shards over the exchange's (inner, outer)
+        linearization must slice exactly the parameter block whose
+        gradients this rank received — pin by reassembling the param
+        slices through the hierarchical allgather."""
+        def inner():
+            leaves = [jnp.arange(16, dtype=jnp.float32),
+                      jnp.arange(8, dtype=jnp.float32) + 100.0]
+            spec = C.make_fusion_spec(leaves, 8)
+            own = C.exchange_index_axes()
+            p_shards = C.local_fusion_shards(leaves, spec, axis=own)
+            back = C.hierarchical_allgather(p_shards, spec)
+            return tuple(x[None] for x in back)
+
+        out = jax.jit(jax.shard_map(
+            inner, mesh=make_mesh(), in_specs=(),
+            out_specs=(P(GLOBAL_AXES),) * 2, check_vma=False))()
+        for got, want in zip(out, [np.arange(16, dtype=np.float32),
+                                   np.arange(8, dtype=np.float32) + 100]):
+            for r in range(8):
+                np.testing.assert_allclose(np.asarray(got)[r], want)
+
+    def test_int8_dcn_wire_close_to_exact(self):
+        """quantized_bits=8 compresses the cross-slice hop only; the
+        result stays within the shared-scale codec's error bound."""
+        rng = np.random.RandomState(3)
+        data = rng.randn(8, 24).astype(np.float32)
+
+        def inner():
+            r = C.axis_index(GLOBAL_AXES)
+            leaves = [jnp.asarray(data)[r]]
+            shards, spec = C.hierarchical_reducescatter(
+                leaves, op=C.Average, quantized_bits=8)
+            (two,) = C.hierarchical_allgather(shards, spec)
+            return two[None]
+
+        out = np.asarray(jax.jit(jax.shard_map(
+            inner, mesh=make_mesh(), in_specs=(),
+            out_specs=P(GLOBAL_AXES), check_vma=False))())
+        exact = data.mean(axis=0)
+        # the ICI phase is exact; only the 2-way DCN hop quantizes the
+        # partial sums, so the bound is one absmax/127 rounding of the
+        # 4-way partials (divided back by world)
+        tol = np.abs(data).sum(axis=0).max() / 127.0
+        np.testing.assert_allclose(out[0], exact, atol=tol)
+
+
+class TestTwoLevelTrainStepParity:
+    """The acceptance pin: two-level exchange == flat exchange == the
+    PR-1 baseline, on parameters, after real optimizer steps."""
+
+    def _train(self, hierarchy, steps=8, bucket_bytes=None,
+               opt=None):
+        step = hvd.DistributedTrainStep(
+            loss_fn, opt or optax.adamw(1e-2), mode="shard_map",
+            donate=False, shard_optimizer_states=True,
+            exchange_bucket_bytes=bucket_bytes, hierarchy=hierarchy)
+        params, opt_state = step.init(make_params(jax.random.PRNGKey(7)))
+        batch = step.shard_batch(make_batch())
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, batch)
+        return jax.device_get(params), float(loss)
+
+    def test_two_level_matches_flat(self):
+        two, loss_t = self._train("two_level")
+        flat, loss_f = self._train("flat")
+        for k in flat:
+            np.testing.assert_allclose(np.asarray(two[k]),
+                                       np.asarray(flat[k]),
+                                       rtol=1e-5, atol=1e-6)
+        assert abs(loss_t - loss_f) < 1e-5
+
+    def test_auto_resolves_two_level_on_this_mesh(self):
+        step = hvd.DistributedTrainStep(
+            loss_fn, optax.sgd(0.1), mode="shard_map",
+            shard_optimizer_states=True, hierarchy="auto")
+        assert step.exchange_hierarchy == "two_level"
+        flat = hvd.DistributedTrainStep(
+            loss_fn, optax.sgd(0.1), mode="shard_map",
+            shard_optimizer_states=True, hierarchy="flat")
+        assert flat.exchange_hierarchy == "flat"
+
+    def test_bucketed_two_level_matches(self):
+        two, _ = self._train("two_level", bucket_bytes=64)
+        flat, _ = self._train("flat")
+        for k in flat:
+            np.testing.assert_allclose(np.asarray(two[k]),
+                                       np.asarray(flat[k]),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_momentum_state_shards_commute(self):
+        opt = optax.sgd(0.05, momentum=0.9)
+        two, _ = self._train("two_level", opt=opt)
+        flat, _ = self._train("flat", opt=opt)
+        for k in flat:
+            np.testing.assert_allclose(np.asarray(two[k]),
+                                       np.asarray(flat[k]),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_hierarchy_knob_validation(self):
+        with pytest.raises(ValueError, match="hierarchy"):
+            hvd.DistributedTrainStep(loss_fn, optax.sgd(0.1),
+                                     hierarchy="two_level")
+        with pytest.raises(ValueError, match="hierarchy"):
+            hvd.DistributedOptimizer(optax.sgd(0.1),
+                                     hierarchy="two_level")
+        with pytest.raises(ValueError, match="hierarchy"):
+            hvd.DistributedOptimizer(optax.sgd(0.1),
+                                     shard_optimizer_states=True,
+                                     hierarchy="diagonal")
+
+    def test_overlap_probe_reports_per_level_structure(self):
+        """measure_overlap(hierarchy='auto') on the 2x4 runtime mesh:
+        resolves two_level, times both levels, and reports the two
+        reduce-scatter scopes it found in the exchange program's HLO —
+        the fields bench.py emits into BENCH JSON."""
+        from jax.sharding import NamedSharding
+        from horovod_tpu.runtime import state as rt_state
+        from horovod_tpu.utils.overlap_probe import measure_overlap
+
+        mesh = rt_state.global_state().mesh
+        params = jax.device_put(make_params(jax.random.PRNGKey(0)),
+                                NamedSharding(mesh, P()))
+        batch = jax.device_put(make_batch(),
+                               NamedSharding(mesh, P(GLOBAL_AXES)))
+        rep = measure_overlap(loss_fn, params, batch, iters=1, warmup=0)
+        assert rep.hierarchy == "two_level"
+        assert rep.rs_scopes == (2, 4)          # dcn and ici scopes
+        assert rep.grad_sized_allreduces == 0
+        assert rep.exchange_intra_s is not None
+        assert rep.exchange_cross_s is not None
+        fields = rep.as_bench_fields()
+        assert fields["exchange_hierarchy"] == "two_level"
+        assert fields["exchange_rs_scopes"] == [2, 4]
+        assert "overlap_exchange_intra_s" in fields
+        # flat request on the same mesh: single world-sized scope
+        flat = measure_overlap(loss_fn, params, batch, hierarchy="flat",
+                               iters=1, warmup=0)
+        assert flat.hierarchy == "flat" and flat.rs_scopes == (8,)
+        assert flat.exchange_intra_s is None
+
+    def test_optimizer_factory_two_level_matches_flat(self):
+        """DistributedOptimizer(hierarchy=...) inside a hand-written
+        shard_map: one update, both topologies, identical results."""
+        data = np.linspace(-1, 1, 8 * 12).reshape(8, 12).astype(np.float32)
+
+        def f(hierarchy):
+            def inner():
+                r = C.axis_index(GLOBAL_AXES)
+                tx = hvd.DistributedOptimizer(
+                    optax.adam(0.1), shard_optimizer_states=True,
+                    hierarchy=hierarchy)
+                params = {"a": jnp.ones((8,)), "b": jnp.zeros((4,))}
+                g = {"a": jnp.asarray(data)[r, :8],
+                     "b": jnp.asarray(data)[r, 8:]}
+                u, _ = tx.update(g, tx.init(params), params)
+                return u["a"][None], u["b"][None]
+
+            return map(np.asarray, jax.jit(jax.shard_map(
+                inner, mesh=make_mesh(), in_specs=(),
+                out_specs=(P(GLOBAL_AXES), P(GLOBAL_AXES)),
+                check_vma=False))())
+
+        ta, tb = f("two_level")
+        fa, fb = f("flat")
+        np.testing.assert_allclose(ta, fa, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(tb, fb, rtol=1e-5, atol=1e-6)

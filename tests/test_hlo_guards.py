@@ -306,6 +306,113 @@ class TestShardedExchangeHLO:
                 "TPU compile issued the sharded exchange synchronously"
 
 
+class TestHierarchicalExchangeHLO:
+    """Guards for the two-level (topology-aware) exchange: the compiled
+    step must carry TWO distinct reduce-scatter scopes — the intra-slice
+    (ici, group size 4 on the 2x4 mesh) and cross-slice (dcn, group
+    size 2) levels — and still no gradient-sized all-reduce.  A silent
+    fallback to the flat single-scope exchange would pass every
+    numerics test (same math) and only show up as full-payload DCN
+    traffic on a real pod; these guards fail instead."""
+
+    def _two_level_ops(self, net_setup, **kw):
+        hvd, model, init, bdata = net_setup
+        step = hvd.DistributedTrainStep(_loss_fn(model), optax.adamw(1e-3),
+                                        mode="shard_map",
+                                        shard_optimizer_states=True,
+                                        hierarchy="two_level", **kw)
+        params, opt = step.init(init)
+        batch = step.shard_batch(bdata)
+        return step, H.collective_ops(step.compiled_text(params, opt,
+                                                         batch))
+
+    def test_two_distinct_reduce_scatter_scopes(self, net_setup):
+        _, ops = self._two_level_ops(net_setup)
+        scopes = H.scopes_by_kind(ops)
+        # one scope per mesh level: ici (4) and dcn (2); the flat
+        # exchange would show a single world-sized (8) scope
+        assert scopes.get("reduce-scatter") == (2, 4), scopes
+        assert 8 not in scopes.get("reduce-scatter", ()), scopes
+        # the gather phase mirrors the scopes (cross-slice + intra)
+        assert set(scopes.get("all-gather", ())) == {2, 4}, scopes
+
+    def test_no_gradient_sized_allreduce(self, net_setup):
+        _, ops = self._two_level_ops(net_setup)
+        ars = [o for o in ops if o.kind == "all-reduce"]
+        # the ONLY all-reduce left is the 4-byte scalar loss
+        assert all(o.bytes == 4 for o in ars), \
+            [(o.bytes, o.line) for o in ars]
+        # payload conservation: intra-level reduce-scatter shard
+        # outputs cover the (padded) gradient pytree
+        rs_bytes = sum(o.bytes for o in ops
+                       if o.kind == "reduce-scatter" and o.group_size == 4)
+        assert rs_bytes * 4 >= _grad_bytes(net_setup[2])
+
+    def test_bucketed_two_level_splits_both_scopes(self, net_setup):
+        """exchange_bucket_bytes composes with the hierarchy: each
+        bucket gets its own intra- AND cross-slice reduce-scatter."""
+        _, ops = self._two_level_ops(net_setup,
+                                     exchange_bucket_bytes=128 * 1024)
+        per_scope: dict = {}
+        for o in ops:
+            if o.kind == "reduce-scatter":
+                per_scope[o.group_size] = per_scope.get(o.group_size, 0) + 1
+        assert per_scope.get(4, 0) >= 2, per_scope
+        assert per_scope.get(2, 0) >= 2, per_scope
+
+    def test_async_start_done_pairing(self, net_setup):
+        """Every -start collective of the two-level exchange closes
+        with a matching -done (the async issuance the per-level overlap
+        depends on; the CPU backend may issue synchronously — zero
+        pairs — which is compliant here, required async on TPU)."""
+        hvd, model, init, bdata = net_setup
+        step = hvd.DistributedTrainStep(_loss_fn(model), optax.adamw(1e-3),
+                                        mode="shard_map",
+                                        shard_optimizer_states=True,
+                                        hierarchy="two_level")
+        params, opt = step.init(init)
+        batch = step.shard_batch(bdata)
+        txt = step.compiled_text(params, opt, batch)
+        for kind in ("reduce-scatter", "all-gather", "all-reduce"):
+            starts = txt.count(f"{kind}-start(")
+            dones = txt.count(f"{kind}-done(")
+            assert starts == dones, (kind, starts, dones)
+        if jax.devices()[0].platform == "tpu":
+            ops = H.collective_ops(txt)
+            assert any(o.asynchronous for o in ops
+                       if o.kind in ("reduce-scatter", "all-gather")), \
+                "TPU compile issued the two-level exchange synchronously"
+
+    def test_auto_on_factored_mesh_equals_two_level_structure(
+            self, net_setup):
+        """hierarchy='auto' on the 2x4 mesh must compile the SAME
+        scope structure as the explicit two_level — the auto decision
+        is structural, not advisory."""
+        hvd, model, init, bdata = net_setup
+        step = hvd.DistributedTrainStep(_loss_fn(model), optax.adamw(1e-3),
+                                        mode="shard_map",
+                                        shard_optimizer_states=True,
+                                        hierarchy="auto")
+        assert step.exchange_hierarchy == "two_level"
+        params, opt = step.init(init)
+        batch = step.shard_batch(bdata)
+        ops = H.collective_ops(step.compiled_text(params, opt, batch))
+        assert H.scopes_by_kind(ops).get("reduce-scatter") == (2, 4)
+
+    def test_flat_keeps_single_scope(self, net_setup):
+        """hierarchy='flat' pins the PR-1 single-scope exchange — the
+        knob must actually select topologies, not alias them."""
+        hvd, model, init, bdata = net_setup
+        step = hvd.DistributedTrainStep(_loss_fn(model), optax.adamw(1e-3),
+                                        mode="shard_map",
+                                        shard_optimizer_states=True,
+                                        hierarchy="flat")
+        params, opt = step.init(init)
+        batch = step.shard_batch(bdata)
+        ops = H.collective_ops(step.compiled_text(params, opt, batch))
+        assert H.scopes_by_kind(ops).get("reduce-scatter") == (8,)
+
+
 class TestHloParser:
     def test_parses_tuple_allreduce(self):
         line = ("  %all-reduce.7 = (f32[256]{0}, bf16[256,64]{1,0}, f32[]) "

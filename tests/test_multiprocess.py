@@ -14,6 +14,11 @@ import textwrap
 
 import pytest
 
+# cross-process collectives: jax 0.4.37's CPU backend cannot run them
+# (pre-existing, documented in CHANGES.md), so this suite is excluded
+# from tier-1 by the slow mark and runs where real worlds exist
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -202,6 +202,24 @@ class TestClusterEnv:
         cfg = Config.from_env()
         assert cfg.rank == 2 and cfg.size == 4
 
+    def test_exchange_env_knobs(self, monkeypatch):
+        """HOROVOD_EXCHANGE_BUCKET_BYTES / HOROVOD_EXCHANGE_HIERARCHY
+        feed the sharded-exchange defaults and count as user-fixed
+        knobs (never autotuned over)."""
+        from horovod_tpu.runtime.config import Config
+
+        cfg = Config.from_env()
+        assert cfg.exchange_bucket_bytes is None
+        assert cfg.exchange_hierarchy == "auto"
+        monkeypatch.setenv("HOROVOD_EXCHANGE_BUCKET_BYTES",
+                           str(4 * 1024 * 1024))
+        monkeypatch.setenv("HOROVOD_EXCHANGE_HIERARCHY", "two_level")
+        cfg = Config.from_env()
+        assert cfg.exchange_bucket_bytes == 4 * 1024 * 1024
+        assert cfg.exchange_hierarchy == "two_level"
+        assert "exchange_bucket_bytes" in cfg.fixed_knobs
+        assert "exchange_hierarchy" in cfg.fixed_knobs
+
 
 class TestJsRun:
     """jsrun command + ERF rankfile composed as strings, no LSF needed

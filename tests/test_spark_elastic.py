@@ -80,7 +80,8 @@ def _elastic_churn_fn():
 
 
 class TestSparkElastic:
-    def test_static_world_completes(self, monkeypatch):
+    @pytest.mark.slow          # real jax.distributed e2e world — no
+    def test_static_world_completes(self, monkeypatch):  # CPU collectives
         """No churn: 2 executor tasks register, become ranks 0/1, run
         the elastic loop once, and per-rank results come back in rank
         order — run()'s contract on the elastic path."""
@@ -93,6 +94,7 @@ class TestSparkElastic:
         assert all(o["epoch"] == 2 for o in out)
         assert all(o["rendezvous"] == 1 for o in out)
 
+    @pytest.mark.slow          # real jax.distributed e2e world — no
     def test_executor_loss_shrinks_world_mid_fit(self, monkeypatch):
         """The VERDICT scenario: 2 local executors, one SIGKILLed at
         epoch 2; the liveness ping discovers the loss, the world shrinks
