@@ -243,13 +243,19 @@ def current_operations() -> str:
 
 
 def cache_stats() -> dict:
-    """Signature-cache hit/miss counters of the negotiation layer
-    (reference response-cache observability, ``response_cache.{h,cc}``).
-    Returns ``{"hits": int, "misses": int}``."""
+    """Compiled-executable cache counters (reference response-cache
+    observability, ``response_cache.{h,cc}``): ``hits``/``misses``
+    count the in-memory signature caches (eager negotiation layer and
+    each ``DistributedTrainStep``'s executable LRU, bounded by
+    ``HOROVOD_CACHE_CAPACITY``); ``aot_disk_hits``/``aot_disk_misses``
+    count the persistent warm-start AOT store
+    (:mod:`horovod_tpu.runtime.compile_cache`).  ``bench.py`` surfaces
+    all four in the BENCH JSON."""
     from horovod_tpu.runtime import state as _state
 
     if not _state.is_initialized():
-        return {"hits": 0, "misses": 0}
+        return {"hits": 0, "misses": 0,
+                "aot_disk_hits": 0, "aot_disk_misses": 0}
     return dict(_state.global_state().cache_stats)
 
 

@@ -1,17 +1,44 @@
-"""Checkpoint/resume: the rank-0-save + broadcast-restore pattern.
+"""Checkpoint/resume: rank-0-save + broadcast-restore, off the train clock.
 
 Reference (SURVEY §5.4): Horovod ships no checkpoint format; its
 examples save on rank 0 only and restore with
 ``broadcast_variables``/``broadcast_optimizer_state``
 (``examples/tensorflow2_keras_mnist.py``, ``tensorflow/functions.py:47``,
 ``torch/functions.py:30,62``).  This module packages that pattern with
-an orbax backend (the TPU-native checkpoint store, async-capable) and a
-msgpack/numpy fallback.
+an orbax backend (the TPU-native checkpoint store) and a msgpack/numpy
+fallback — and, since the warm-start PR, takes serialization off the
+training clock:
+
+**Async snapshotting** (default): ``save()`` blocks only for the
+device→host copy — the consistent cut; the arrays the train loop will
+donate next step are copied out before ``save()`` returns — then
+pickling, fsync and retention run on a background writer thread.
+``wait()`` is the barrier: it re-raises writer errors, and ``save()``
+calls it first so two writes never interleave (at steady state the
+previous write has long finished and the barrier is free).
+
+**Crash consistency**: a checkpoint file becomes visible only via
+atomic ``os.replace`` after its bytes are fsynced, and the directory
+entry is fsynced after the rename; a crash mid-write leaves only
+``*.tmp*`` files, which every reader ignores and the next writer
+removes.  The previous checkpoint is never touched until the new one
+is durable (retention runs after the rename).
+
+**Sharded (ZeRO) optimizer state** (PR 1 ``shard_optimizer_states``):
+each rank owns 1/N of the flat fused state, so the rank-0-only rule
+doesn't apply — :meth:`save_sharded` has every rank write its own
+shard file and :meth:`restore_sharded` reassembles the full flat
+buffer and re-slices it for the restoring world size, which may
+differ (elastic resize).  The zero-padding the fusion spec adds is
+preserved by construction (padded gradient tails are zero, so padded
+state tails stay zero), so trimming/re-padding at a new world size is
+exact.  See docs/warmstart.md.
 
 ::
 
     ckpt = hvd.checkpoint.Checkpointer("/tmp/run1")
     ckpt.save(step, {"params": params, "opt_state": opt_state})   # rank 0
+    ckpt.wait()                                                   # barrier
     state = ckpt.restore_and_broadcast({"params": params, ...})   # all
 """
 
@@ -19,6 +46,8 @@ from __future__ import annotations
 
 import os
 import pickle
+import threading
+import time
 from typing import Any, Optional
 
 import jax
@@ -32,18 +61,61 @@ def _is_root() -> bool:
     return jax.process_index() == 0
 
 
-class Checkpointer:
-    """Directory-per-step checkpoints, written by rank 0 only.
+def _host_copy(state: Any) -> Any:
+    """The consistent cut: synchronous device→host copy of every array
+    leaf.  After this returns, the snapshot is immune to donation —
+    the train loop may overwrite the device buffers in place."""
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if hasattr(x, "shape") else x, state)
 
-    Uses orbax when available (``use_orbax=None`` autodetects); the
-    fallback serializes the pytree's numpy leaves with pickle — same
-    layout, no extra deps.
+
+def _atomic_write(path: str, payload: Any) -> None:
+    """Pickle ``payload`` to ``path`` durably: tmp file → fsync →
+    atomic rename → fsync of the directory entry."""
+    d = os.path.dirname(path)
+    tmp = os.path.join(d, f".tmp.{os.path.basename(path)}.{os.getpid()}")
+    with open(tmp, "wb") as f:
+        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    try:
+        dirfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+    except OSError:  # pragma: no cover - exotic filesystems
+        pass
+
+
+class Checkpointer:
+    """Directory-per-step checkpoints with an async writer thread.
+
+    Replicated state is written by rank 0 only (the reference's
+    "checkpoint on rank 0" rule); sharded state is written by every
+    rank through :meth:`save_sharded`.  Uses orbax when available
+    (``use_orbax=None`` autodetects); the fallback serializes the
+    pytree's numpy leaves with pickle — same layout, no extra deps.
+
+    ``async_save=False`` restores the old fully-synchronous behavior
+    (save returns only when bytes are durable) — what the bench's
+    ``checkpoint_sync_s`` reference number measures.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 use_orbax: Optional[bool] = None):
+                 use_orbax: Optional[bool] = None,
+                 async_save: bool = True):
         self._dir = os.path.abspath(directory)
         self._max_to_keep = max_to_keep
+        self._async = async_save
+        self._writer: Optional[threading.Thread] = None
+        self._writer_error: Optional[BaseException] = None
+        # observability for the bench probe: the train-loop blocking
+        # time of the last save (D2H cut only, async) and the last
+        # end-to-end write duration (background, after wait())
+        self.last_stall_s: Optional[float] = None
+        self.last_write_s: Optional[float] = None
         if use_orbax is None:
             try:
                 import orbax.checkpoint  # noqa: F401
@@ -66,8 +138,7 @@ class Checkpointer:
                 "automatically; reads remain layout-agnostic).")
         self._use_orbax = use_orbax
         self._manager = None
-        if _is_root():
-            os.makedirs(self._dir, exist_ok=True)
+        os.makedirs(self._dir, exist_ok=True)
         if use_orbax and _is_root():
             import orbax.checkpoint as ocp
 
@@ -76,27 +147,112 @@ class Checkpointer:
                 options=ocp.CheckpointManagerOptions(
                     max_to_keep=max_to_keep, create=True))
 
-    # -- write (rank 0) -----------------------------------------------------
+    # -- async writer machinery ---------------------------------------------
+
+    def wait(self) -> None:
+        """Barrier: block until the pending background write (if any)
+        is durable; re-raise any error it hit.  ``save()`` runs this
+        first, so callers that never touch ``wait()`` still get the
+        one-outstanding-write guarantee."""
+        w = self._writer
+        if w is not None:
+            w.join()
+            self._writer = None
+        if self._writer_error is not None:
+            err, self._writer_error = self._writer_error, None
+            raise err
+
+    def _dispatch(self, fn) -> None:
+        """Run ``fn`` on the writer thread (async) or inline (sync)."""
+
+        def run():
+            t0 = time.perf_counter()
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 — surfaced at wait()
+                self._writer_error = e
+            finally:
+                self.last_write_s = time.perf_counter() - t0
+
+        if not self._async:
+            run()
+            if self._writer_error is not None:
+                err, self._writer_error = self._writer_error, None
+                raise err
+            return
+        # non-daemon: a process exiting right after save() (last epoch,
+        # worker retirement) joins the writer at interpreter shutdown
+        # instead of truncating the write — durability over exit speed
+        self._writer = threading.Thread(
+            target=run, daemon=False, name="hvd_tpu_ckpt_writer")
+        self._writer.start()
+
+    # -- write --------------------------------------------------------------
 
     def save(self, step: int, state: Any) -> bool:
         """Write a checkpoint on rank 0; no-op elsewhere (the reference's
-        "checkpoint on rank 0 only" rule)."""
+        "checkpoint on rank 0 only" rule).  Blocks only for the D2H
+        copy when ``async_save`` (the default); durability is reached
+        in the background and checkable via :meth:`wait`."""
         if not _is_root():
             return False
-        host_state = jax.tree_util.tree_map(
-            lambda x: np.asarray(x) if hasattr(x, "shape") else x, state)
-        if self._manager is not None:
-            import orbax.checkpoint as ocp
+        self.wait()                       # one outstanding write, ever
+        t0 = time.perf_counter()
+        host_state = _host_copy(state)    # the consistent cut
+        self.last_stall_s = time.perf_counter() - t0
 
-            self._manager.save(step, args=ocp.args.StandardSave(host_state))
-            self._manager.wait_until_finished()
+        if self._manager is not None:
+            def write():
+                import orbax.checkpoint as ocp
+
+                self._manager.save(step,
+                                   args=ocp.args.StandardSave(host_state))
+                self._manager.wait_until_finished()
+                hvd_logging.info("checkpoint: saved step %d to %s",
+                                 step, self._dir)
         else:
+            def write():
+                path = os.path.join(self._dir, f"step_{step}")
+                os.makedirs(path, exist_ok=True)
+                _atomic_write(os.path.join(path, "state.pkl"), host_state)
+                self._gc()
+                hvd_logging.info("checkpoint: saved step %d to %s",
+                                 step, self._dir)
+
+        self._dispatch(write)
+        return True
+
+    def save_sharded(self, step: int, shard_state: Any,
+                     shard_rank: int, shard_count: int) -> bool:
+        """Write THIS rank's 1/N shard of a sharded (ZeRO) state tree.
+
+        Every rank calls this with its own ``shard_state`` — the
+        per-rank optimizer state of ``shard_optimizer_states=True``
+        (flat ``(shard,)`` leaves keyed by fusion group).  Same async
+        contract as :meth:`save`: blocks for the D2H copy only.  The
+        step is complete once all ``shard_count`` files exist —
+        :meth:`restore_sharded` verifies that."""
+        if not 0 <= shard_rank < shard_count:
+            raise ValueError(
+                f"shard_rank {shard_rank} out of range for "
+                f"shard_count {shard_count}")
+        self.wait()
+        t0 = time.perf_counter()
+        host_state = _host_copy(shard_state)
+        self.last_stall_s = time.perf_counter() - t0
+
+        def write():
             path = os.path.join(self._dir, f"step_{step}")
             os.makedirs(path, exist_ok=True)
-            with open(os.path.join(path, "state.pkl"), "wb") as f:
-                pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
-            self._gc()
-        hvd_logging.info("checkpoint: saved step %d to %s", step, self._dir)
+            _atomic_write(
+                os.path.join(path, _shard_name(shard_rank, shard_count)),
+                {"shard_rank": shard_rank, "shard_count": shard_count,
+                 "state": host_state})
+            hvd_logging.info(
+                "checkpoint: saved shard %d/%d of step %d to %s",
+                shard_rank, shard_count, step, self._dir)
+
+        self._dispatch(write)
         return True
 
     def _gc(self) -> None:
@@ -116,7 +272,10 @@ class Checkpointer:
         """Steps present on disk, in EITHER layout.  The write format
         depends on availability and process count, but a run resumed or
         evaluated with a different process count must still find its
-        existing checkpoints — reads are layout-agnostic."""
+        existing checkpoints — reads are layout-agnostic.  Only steps
+        with at least one finalized (non-tmp) payload file count, so a
+        crash mid-first-write never surfaces an empty step."""
+        self.wait()   # read-your-writes: surface our own pending save
         if not os.path.isdir(self._dir):
             return []
         steps = set(self._pickle_steps())
@@ -129,15 +288,31 @@ class Checkpointer:
             try:
                 from orbax.checkpoint import utils as ocp_utils
 
-                steps.update(int(s)
-                             for s in ocp_utils.checkpoint_steps(self._dir))
+                # only steps living in orbax's plain-digit layout: the
+                # pickle layout's step_N dirs must not round-trip through
+                # orbax's scanner, which would resurface an incomplete
+                # (crash-torso) pickle step _pickle_steps just filtered
+                steps.update(
+                    int(s) for s in ocp_utils.checkpoint_steps(self._dir)
+                    if os.path.isdir(os.path.join(self._dir, str(int(s)))))
             except ImportError:
                 pass
         return sorted(steps)
 
     def _pickle_steps(self) -> list:
-        return [int(d.split("_", 1)[1]) for d in os.listdir(self._dir)
-                if d.startswith("step_") and d.split("_", 1)[1].isdigit()]
+        out = []
+        for d in os.listdir(self._dir):
+            if not (d.startswith("step_") and d.split("_", 1)[1].isdigit()):
+                continue
+            full = os.path.join(self._dir, d)
+            try:
+                final = [n for n in os.listdir(full)
+                         if n.endswith(".pkl") and not n.startswith(".tmp")]
+            except NotADirectoryError:
+                continue
+            if final:
+                out.append(int(d.split("_", 1)[1]))
+        return out
 
     def latest_step(self) -> Optional[int]:
         steps = self.all_steps()
@@ -146,6 +321,7 @@ class Checkpointer:
     def restore(self, target: Any, step: Optional[int] = None) -> Any:
         """Load a checkpoint on this process (every rank reads — use
         :meth:`restore_and_broadcast` for the read-once pattern)."""
+        self.wait()
         if step is None:
             step = self._resolve_step()
         if step is None:
@@ -161,9 +337,7 @@ class Checkpointer:
                 f"(available: {self.all_steps()})")
         import orbax.checkpoint as ocp
 
-        host_target = jax.tree_util.tree_map(
-            lambda x: np.asarray(x) if hasattr(x, "shape") else x,
-            target)
+        host_target = _host_copy(target)
         if self._manager is not None and \
                 step in set(self._manager.all_steps()):
             return self._manager.restore(
@@ -173,6 +347,40 @@ class Checkpointer:
         # Layout is the manager's: <dir>/<step>/default.
         return ocp.StandardCheckpointer().restore(
             os.path.join(self._dir, str(step), "default"), host_target)
+
+    def restore_sharded(self, target: Any, shard_rank: int,
+                        shard_count: int,
+                        step: Optional[int] = None) -> Any:
+        """Rebuild THIS rank's shard of a sharded state saved at any
+        world size.
+
+        The saved shards concatenate back into the full flat buffer
+        (padded to the *saving* world's multiple); ``target``'s leaf
+        shapes define the *restoring* world's shard sizes, so the
+        buffer is re-padded (or pad-trimmed — the tail is zeros by the
+        fusion-spec invariant) to ``shard * shard_count`` and re-sliced
+        at ``shard_rank``.  Scalar leaves (optimizer step counters) are
+        replicated across shards; the saving rank 0's value wins."""
+        self.wait()
+        if step is None:
+            step = self._resolve_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self._dir}")
+        path = os.path.join(self._dir, f"step_{step}")
+        shards = _load_shards(path)
+        saved_trees = [s["state"] for s in shards]
+        t_leaves, treedef = jax.tree_util.tree_flatten(target)
+        shard_leaves = [jax.tree_util.tree_flatten(t)[0]
+                        for t in saved_trees]
+        if any(len(sl) != len(t_leaves) for sl in shard_leaves):
+            raise ValueError(
+                f"sharded checkpoint at {path} has a different tree "
+                f"structure than the restore target")
+        out = []
+        for i, t in enumerate(t_leaves):
+            saved = [sl[i] for sl in shard_leaves]
+            out.append(_reshard_leaf(t, saved, shard_rank, shard_count))
+        return jax.tree_util.tree_unflatten(treedef, out)
 
     def _resolve_step(self) -> Optional[int]:
         """Pick the latest step, agreed across ranks.
@@ -212,3 +420,73 @@ class Checkpointer:
             state = target
         return F.broadcast_variables(state, root_rank=root_rank,
                                      name="checkpoint_restore")
+
+
+def _shard_name(rank: int, count: int) -> str:
+    return f"shard_{rank}_of_{count}.pkl"
+
+
+def _load_shards(path: str) -> list:
+    """All shard payloads of one step, ordered by shard rank; validates
+    the set is complete and from one world size."""
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"no checkpoint directory {path}")
+    names = [n for n in os.listdir(path)
+             if n.startswith("shard_") and n.endswith(".pkl")
+             and not n.startswith(".tmp")]
+    if not names:
+        raise FileNotFoundError(f"no shard files in {path}")
+    payloads = []
+    for n in sorted(names):
+        with open(os.path.join(path, n), "rb") as f:
+            payloads.append(pickle.load(f))
+    counts = {p["shard_count"] for p in payloads}
+    if len(counts) != 1:
+        raise ValueError(
+            f"mixed shard_count values {sorted(counts)} in {path} — "
+            f"partial overwrite from two world sizes?")
+    count = counts.pop()
+    ranks = sorted(p["shard_rank"] for p in payloads)
+    if ranks != list(range(count)):
+        missing = sorted(set(range(count)) - set(ranks))
+        raise FileNotFoundError(
+            f"incomplete sharded checkpoint in {path}: missing shard(s) "
+            f"{missing} of {count}")
+    payloads.sort(key=lambda p: p["shard_rank"])
+    return payloads
+
+
+def _reshard_leaf(target, saved: list, shard_rank: int, shard_count: int):
+    """One leaf's re-shard: concat the saved per-rank pieces, fix the
+    padded length to the restoring world's, slice this rank's piece."""
+    if not hasattr(target, "shape") or np.ndim(target) == 0:
+        # replicated scalar (e.g. optax count): saving rank 0's value
+        return saved[0]
+    t_shape = tuple(np.shape(target))
+    s0 = np.asarray(saved[0])
+    if tuple(s0.shape) == t_shape and len(saved) == shard_count:
+        # same world size: this rank's own shard, no reassembly
+        return saved[shard_rank]
+    if s0.ndim != 1 or len(t_shape) != 1:
+        raise ValueError(
+            f"cannot re-shard a non-flat leaf of shape {s0.shape} to "
+            f"{t_shape}: sharded state leaves are 1-D fusion-buffer "
+            f"slices (shard_optimizer_states contract)")
+    full = np.concatenate([np.asarray(s) for s in saved])
+    new_padded = t_shape[0] * shard_count
+    if new_padded < full.shape[0]:
+        # the fusion spec pads with zeros and padded gradient tails are
+        # zero, so state tails are zero — trimming drops only padding
+        tail = full[new_padded:]
+        if np.any(tail != 0):
+            raise ValueError(
+                "re-shard would trim non-zero state: the restore "
+                f"target's padded length {new_padded} is shorter than "
+                f"the saved buffer {full.shape[0]} and the excess is "
+                "not fusion padding")
+        full = full[:new_padded]
+    elif new_padded > full.shape[0]:
+        full = np.concatenate([
+            full, np.zeros((new_padded - full.shape[0],), full.dtype)])
+    shard = full.shape[0] // shard_count
+    return full[shard_rank * shard:(shard_rank + 1) * shard]

@@ -76,6 +76,13 @@ class ElasticDriver:
         # (worker-initiated re-rendezvous, see _handle)
         self._regen_requests: set = set()
         self._generation = 0
+        # recovery observability: wall-clock from each generation's
+        # assignment to every assigned worker reporting READY — the
+        # number the warm-start compile cache is meant to collapse from
+        # ~full-compile (42-51 s per flagship model) to seconds
+        self._generation_started: float = time.monotonic()
+        self._generation_ready_logged = -1
+        self.last_recovery_s: Optional[float] = None
         self._coordinator_addr = ""
         # Driver-hosted per-generation coordination services.  Old
         # generations are retired, NOT shut down, until job completion: a
@@ -125,6 +132,7 @@ class ElasticDriver:
             return AckResponse()
         if isinstance(req, WorkerReadyRequest):
             self._registry.record_ready(req.host, req.local_rank)
+            self._check_generation_ready()
             return AckResponse()
         if isinstance(req, GetRankAndSizeRequest):
             with self._lock:
@@ -154,8 +162,38 @@ class ElasticDriver:
                 # — the reference records READY at the rendezvous GET
                 # (``elastic/rendezvous.py`` → driver.record_ready)
                 self._registry.record_ready(req.host, req.local_rank)
+                self._check_generation_ready()
             return resp
         raise ValueError(f"unexpected request {type(req).__name__}")
+
+    def _check_generation_ready(self) -> None:
+        """Log (once per generation) the assignment→all-READY latency:
+        ``recovery_s`` is the operational cost of a world change, the
+        quantity the persistent compile cache takes off restarts."""
+        from horovod_tpu.elastic.registration import READY, SUCCESS
+
+        # registry state is read OUTSIDE the driver lock: the registry's
+        # failure path calls driver.stop() while holding its own lock,
+        # so holding ours while taking its would invert the order
+        with self._lock:
+            if self._generation_ready_logged >= self._generation \
+                    or not self._assignments:
+                return
+            gen = self._generation
+            keys = list(self._assignments)
+            started = self._generation_started
+        if not all(self._registry.get_state(h, lr) in (READY, SUCCESS)
+                   for (h, lr) in keys):
+            return
+        with self._lock:
+            if gen != self._generation \
+                    or self._generation_ready_logged >= gen:
+                return      # a newer generation superseded this reading
+            self._generation_ready_logged = gen
+            self.last_recovery_s = time.monotonic() - started
+        hvd_logging.info(
+            "elastic: generation %d fully ready — %d worker(s) in "
+            "recovery_s=%.1f", gen, len(keys), self.last_recovery_s)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -299,6 +337,7 @@ class ElasticDriver:
         self._registry.purge_unassigned(set(self._assignments))
         self._coordinator_addr = self._new_coordinator_addr(assignments)
         self._generation += 1
+        self._generation_started = time.monotonic()
         self._regen_requests.clear()
         return self._assignments
 

@@ -61,6 +61,14 @@ def run_elastic(args) -> int:
             "HOROVOD_ELASTIC_NOTIFY_ADDR": "1",
             "HOROVOD_ELASTIC_GENERATION": str(generation),
         })
+        # pin the warm-start compile cache root for every generation's
+        # workers: a respawned worker then restores serialized
+        # executables from earlier generations instead of recompiling
+        # (runtime/compile_cache.py; HOROVOD_COMPILE_CACHE=0 opts out)
+        from horovod_tpu.runtime import compile_cache
+
+        env.setdefault("HOROVOD_COMPILE_CACHE_DIR",
+                       compile_cache.default_dir())
         cmd = build_worker_command(slot, args.command, args.ssh_port,
                                    getattr(args, "ssh_identity_file", None))
         stdout = stderr = None

@@ -154,9 +154,22 @@ class TpuState(ObjectState):
     ``params``/``opt_state`` (and any extra kwargs) are committed as numpy
     host copies — cheap, device-memory-free snapshots — and restored /
     rank-0-broadcast as pytrees.
+
+    ``checkpointer`` (a :class:`horovod_tpu.checkpoint.Checkpointer`)
+    additionally persists every Nth commit (``checkpoint_every``,
+    default 1) to durable storage through the async writer: the train
+    loop still stalls only for the host copy ``commit()`` makes anyway
+    — the numpy snapshot is handed to the background thread as-is — so
+    a process-loss restart (every previously-assigned host gone, the
+    case in-memory commits cannot survive) resumes from disk via
+    :meth:`restore_from_checkpoint` instead of losing the run.
     """
 
-    def __init__(self, params=None, opt_state=None, **kwargs):
+    def __init__(self, params=None, opt_state=None, checkpointer=None,
+                 checkpoint_every: int = 1, **kwargs):
+        self._checkpointer = checkpointer
+        self._checkpoint_every = max(int(checkpoint_every), 1)
+        self._commit_count = 0
         super().__init__(params=params, opt_state=opt_state, **kwargs)
 
     def save(self) -> None:
@@ -167,6 +180,33 @@ class TpuState(ObjectState):
                 lambda x: np.asarray(x) if hasattr(x, "shape") else
                 copy.deepcopy(x), val)
         self._saved_state = new_state
+        self._commit_count += 1
+        if self._checkpointer is not None and \
+                self._commit_count % self._checkpoint_every == 0:
+            # the leaves are already host numpy copies, so the
+            # checkpointer's D2H cut is a no-op and the only cost on
+            # the training clock is thread dispatch — serialization
+            # and fsync run behind the loop (checkpoint.py)
+            self._checkpointer.save(self._commit_count, self._saved_state)
+
+    def wait(self) -> None:
+        """Barrier on the async checkpoint writer (no-op without one)."""
+        if self._checkpointer is not None:
+            self._checkpointer.wait()
+
+    def restore_from_checkpoint(self, step=None) -> bool:
+        """Load the latest (or ``step``-th) durable commit into this
+        state's attributes — the cold-restart path when no surviving
+        worker holds an in-memory commit.  Returns False when the
+        checkpointer has nothing."""
+        if self._checkpointer is None:
+            return False
+        if step is None and self._checkpointer.latest_step() is None:
+            return False
+        saved = self._checkpointer.restore(self._saved_state, step=step)
+        self._saved_state = saved
+        self.restore()
+        return True
 
     def restore(self) -> None:
         for attr, value in self._saved_state.items():
@@ -284,4 +324,19 @@ def _reset() -> None:
         hvd_logging.warning("elastic: clear_backends failed: %s", e)
     eager._reset_mesh_cache()   # drops all mesh-capturing eager caches
     jax.clear_caches()   # compiled programs hold the old mesh's devices
-    rt_state.init()
+    st = rt_state.init()
+    # Warm start: clear_backends/clear_caches dropped every in-memory
+    # executable, but the persistent compile cache (runtime/compile_cache)
+    # survives on disk — init() re-asserted the XLA cache dir, and the
+    # rebuilt DistributedTrainStep's first compile consults the AOT
+    # store, so a generation whose (mesh, model, knobs) was ever
+    # compiled before restarts in seconds instead of re-paying the full
+    # XLA pipeline (docs/warmstart.md).
+    if st.compile_cache_dir:
+        from horovod_tpu.runtime import compile_cache
+
+        hvd_logging.info(
+            "elastic: warm-start cache ready at %s (%d AOT entries) — "
+            "recompiles for a previously-seen world are disk loads",
+            st.compile_cache_dir,
+            compile_cache.entry_count(st.compile_cache_dir))

@@ -211,12 +211,20 @@ def _checkpointer_for(store, run_id: str):
     from horovod_tpu import checkpoint as _checkpoint
 
     remote = store.get_checkpoint_path(run_id)
+    # async_save=False: the estimator's contract is per-epoch
+    # durability — the store mirror walks the directory right after
+    # save(), and fit() may return (worker process exit included)
+    # immediately after the last epoch, so the background-writer
+    # deferral the training-loop Checkpointer defaults to would race
+    # both.  The per-epoch save already sits between epochs, off the
+    # step hot path.
     if not getattr(store, "is_remote", False):
-        return _checkpoint.Checkpointer(remote), None
+        return _checkpoint.Checkpointer(remote, async_save=False), None
     staging = tempfile.mkdtemp(prefix="hvd_ckpt_stage_")
     atexit.register(shutil.rmtree, staging, ignore_errors=True)
     ckpt = _SyncingCheckpointer(
-        _checkpoint.Checkpointer(staging), store, staging, remote)
+        _checkpoint.Checkpointer(staging, async_save=False),
+        store, staging, remote)
     return ckpt, staging
 
 
@@ -545,7 +553,8 @@ class Estimator(HasParams):
         if self._store is not None:
             ckpt, ckpt_staging = _checkpointer_for(self._store, run_id)
         elif self._legacy_ckpt_dir:
-            ckpt = hvd.checkpoint.Checkpointer(self._legacy_ckpt_dir)
+            ckpt = hvd.checkpoint.Checkpointer(self._legacy_ckpt_dir,
+                                               async_save=False)
         else:
             ckpt = None
         loop = _Loop(params, opt_state)
@@ -762,7 +771,8 @@ class Estimator(HasParams):
         if run_id is not None:
             ckpt, ckpt_staging = _checkpointer_for(self._store, run_id)
         elif self._legacy_ckpt_dir:
-            ckpt = hvd.checkpoint.Checkpointer(self._legacy_ckpt_dir)
+            ckpt = hvd.checkpoint.Checkpointer(self._legacy_ckpt_dir,
+                                               async_save=False)
         else:
             ckpt = None
         loop = _Loop(params, opt_state)

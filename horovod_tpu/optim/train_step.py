@@ -332,6 +332,22 @@ class DistributedTrainStep:
         self._batch_sharding = batch_sharding
         self._replicated = repl
         self._compiled_cache: dict = {}      # insertion-ordered LRU
+        # cache_capacity bounds the in-memory executable LRU (the
+        # response-cache capacity knob made real); the same value bounds
+        # the on-disk AOT store (runtime/compile_cache.py)
+        if state.is_initialized():
+            self._compiled_cache_max = \
+                state.global_state().config.cache_capacity
+        else:
+            self._compiled_cache_max = self._COMPILED_CACHE_MAX
+        # warm-start AOT store root (None = disabled): first compiles of
+        # this step go through runtime/compile_cache.aot_compile so a
+        # restarted process deserializes instead of recompiling
+        from horovod_tpu.runtime import compile_cache as _cc
+
+        self._compile_cache = _cc
+        self._persistent_root = _cc.resolve_dir()
+        self._last_cache_hit: Optional[bool] = None
 
     _COMPILED_CACHE_MAX = 16
 
@@ -341,6 +357,29 @@ class DistributedTrainStep:
         ``"flat"`` once resolved against the mesh (sharded exchange),
         the raw knob (``"auto"``) when no sharded exchange is active."""
         return self._hierarchy
+
+    @property
+    def compile_cache_hit(self) -> Optional[bool]:
+        """Whether this step's most recent XLA compile was served from
+        the persistent AOT store (``True``), compiled fresh and
+        serialized for the next start (``False``), or has not happened
+        / bypassed the store (``None``).  ``bench.py`` emits this as
+        the ``cache_hit`` BENCH field."""
+        return self._last_cache_hit
+
+    def _aot_extras(self) -> dict:
+        """Explicit AOT key fields (docs/warmstart.md): the knobs the
+        warm-start contract names, recorded in the entry for audit even
+        though each already shapes the lowered module."""
+        return {
+            "mesh_shape": tuple(sorted(self._mesh.shape.items())),
+            "mode": self._mode,
+            "hierarchy": self._hierarchy,
+            "shard_optimizer_states": self._shard_opt,
+            "data_axes": self._data_axes,
+            "fsdp_axis": self._fsdp_axis,
+            "steps_per_call": self._steps_per_call,
+        }
 
     def init(self, params):
         """Place params on the mesh replicated and build optimizer state.
@@ -450,13 +489,15 @@ class DistributedTrainStep:
             compiler_options=self._compiler_options).as_text()
 
     def __call__(self, params, opt_state, batch):
-        if self._compiler_options is None:
+        if self._compiler_options is None and self._persistent_root is None:
             return self._step(params, opt_state, batch)
-        # per-compile XLA options need the AOT path: lower once per
-        # argument signature, compile with the options, reuse.  The key
-        # covers shardings too — an executable compiled for one input
-        # layout must not be fed same-shape differently-sharded arrays —
-        # and the cache is LRU-bounded so varying batch signatures don't
+        # AOT path, for two reasons that share the machinery: per-compile
+        # XLA options need lower-once-compile-with-options, and the
+        # warm-start store needs the explicit compile to intercept.  The
+        # in-memory key covers shardings too — an executable compiled
+        # for one input layout must not be fed same-shape
+        # differently-sharded arrays — and the cache is LRU-bounded
+        # (Config.cache_capacity) so varying batch signatures don't
         # accumulate executables for the process lifetime.
         leaves, treedef = jax.tree_util.tree_flatten(
             (params, opt_state, batch))
@@ -465,12 +506,23 @@ class DistributedTrainStep:
                                                type(l).__name__)),
                       repr(getattr(l, "sharding", None)))
                      for l in leaves))
+        st = state.global_state() if state.is_initialized() else None
         compiled = self._compiled_cache.pop(key, None)
         if compiled is None:
-            compiled = self._step.lower(params, opt_state, batch).compile(
-                compiler_options=self._compiler_options)
+            if st is not None:
+                st.cache_stats["misses"] += 1
+            compiled, hit = self._compile_cache.aot_compile(
+                self._step, (params, opt_state, batch),
+                extras=self._aot_extras(),
+                compiler_options=self._compiler_options,
+                directory=self._persistent_root,
+                capacity=self._compiled_cache_max)
+            self._last_cache_hit = \
+                hit if self._persistent_root is not None else None
+        elif st is not None:
+            st.cache_stats["hits"] += 1
         self._compiled_cache[key] = compiled     # reinsert = most recent
-        while len(self._compiled_cache) > self._COMPILED_CACHE_MAX:
+        while len(self._compiled_cache) > self._compiled_cache_max:
             self._compiled_cache.pop(next(iter(self._compiled_cache)))
         return compiled(params, opt_state, batch)
 

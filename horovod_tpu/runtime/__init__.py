@@ -6,6 +6,7 @@ the negotiation plane disappears under SPMD; what remains is the process
 singleton, the env-var contract and the device mesh.
 """
 
+from horovod_tpu.runtime import compile_cache
 from horovod_tpu.runtime.config import Config
 from horovod_tpu.runtime.state import (
     GlobalState,
@@ -19,6 +20,7 @@ from horovod_tpu.runtime.topology import AXIS_DCN, AXIS_ICI, GLOBAL_AXES, build_
 
 __all__ = [
     "Config",
+    "compile_cache",
     "GlobalState",
     "NotInitializedError",
     "global_state",

@@ -76,7 +76,17 @@ class Config:
     # -- fusion / bucketing (reference: 64 MiB default, operations.cc:432)
     fusion_threshold_bytes: int = 64 * 1024 * 1024
     cycle_time_ms: float = 5.0   # advisory: eager bucket flush interval
-    cache_capacity: int = 1024   # advisory: compiled-collective cache entries
+    # bounds the compiled-executable caches (reference response-cache
+    # capacity, response_cache.h): the in-memory AOT LRU held by each
+    # DistributedTrainStep and the on-disk AOT store's entry count
+    # (runtime/compile_cache.py) both evict past this many entries
+    cache_capacity: int = 1024
+
+    # -- warm-start compile cache (runtime/compile_cache.py):
+    # persistent XLA cache + serialized AOT executables, shared across
+    # process restarts and elastic generations
+    compile_cache_enabled: bool = True
+    compile_cache_dir: Optional[str] = None   # None → ~/.cache/horovod_tpu
 
     # -- hierarchical collectives (ici/dcn mesh split)
     hierarchical_allreduce: bool = False
@@ -163,6 +173,8 @@ class Config:
                 "HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024),
             cycle_time_ms=_env_float("HOROVOD_CYCLE_TIME", 5.0),
             cache_capacity=_env_int("HOROVOD_CACHE_CAPACITY", 1024),
+            compile_cache_enabled=_env_bool("HOROVOD_COMPILE_CACHE", True),
+            compile_cache_dir=os.environ.get("HOROVOD_COMPILE_CACHE_DIR"),
             hierarchical_allreduce=_env_bool(
                 "HOROVOD_HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=_env_bool(

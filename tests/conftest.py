@@ -8,6 +8,7 @@ collective, sharding and shard_map path runs exactly as it would on an
 """
 
 import os
+import tempfile
 
 # must run before jax initializes its backends
 _flags = os.environ.get("XLA_FLAGS", "")
@@ -15,6 +16,12 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("HOROVOD_TPU_MESH_SHAPE", "2,4")
+# hermetic warm-start cache: the persistent compile cache
+# (runtime/compile_cache.py) is exercised by every DistributedTrainStep,
+# but a suite run must neither inherit a stale ~/.cache nor leave one —
+# a fresh per-session root keeps the tests deterministic
+os.environ.setdefault("HOROVOD_COMPILE_CACHE_DIR",
+                      tempfile.mkdtemp(prefix="hvd_tpu_test_cache_"))
 
 import jax  # noqa: E402
 

@@ -30,10 +30,12 @@ FAST, SLOW = 0.01, 0.12
 
 
 def run(durations, iters=4):
-    return bench.median_rate(make_step(durations), (0.5,),
-                             warmup_batches=1, iters=iters,
-                             batches_per_iter=1, units_per_batch=1.0,
-                             label="test")
+    rate, _warmup_s, _state = bench.median_rate(
+        make_step(durations), (0.5,),
+        warmup_batches=1, iters=iters,
+        batches_per_iter=1, units_per_batch=1.0,
+        label="test")
+    return rate
 
 
 class TestTrailingCollapse:
@@ -80,3 +82,55 @@ class TestTrailingCollapse:
         # <3 samples can't distinguish an outlier from a trend
         run([0.0, FAST, SLOW], iters=2)
         assert "re-measure" not in capsys.readouterr().err
+
+
+class TestWarmupAndState:
+    def test_warmup_time_and_final_state_returned(self):
+        rate, warmup_s, state = bench.median_rate(
+            make_step([SLOW, FAST, FAST]), (0.5,),
+            warmup_batches=1, iters=2, batches_per_iter=1,
+            units_per_batch=1.0, label="test")
+        assert warmup_s >= SLOW          # warmup window was timed
+        assert state == (0.5,)           # live post-loop state comes back
+
+    def test_no_warmup_reports_zero(self):
+        _rate, warmup_s, _state = bench.median_rate(
+            make_step([FAST, FAST]), (0.5,),
+            warmup_batches=0, iters=2, batches_per_iter=1,
+            units_per_batch=1.0, label="test")
+        assert warmup_s == 0.0
+
+
+class TestWarmstartFields:
+    class FakeStep:
+        def __init__(self, hit):
+            self.compile_cache_hit = hit
+
+    def test_cold_run(self):
+        f = bench.warmstart_fields(self.FakeStep(False), 42.1, "resnet_")
+        assert f == {"resnet_warmup_s": 42.1, "resnet_cache_hit": False,
+                     "resnet_warmup_cached_s": None}
+
+    def test_warm_run_reports_cached_warmup(self):
+        f = bench.warmstart_fields(self.FakeStep(True), 3.2)
+        assert f == {"warmup_s": 3.2, "cache_hit": True,
+                     "warmup_cached_s": 3.2}
+
+
+class TestJsonOut:
+    def test_emit_writes_artifact(self, tmp_path, capsys):
+        import json
+
+        path = tmp_path / "BENCH.json"
+        bench.emit({"metric": "x", "value": 1.5}, str(path))
+        assert json.loads(path.read_text()) == {"metric": "x",
+                                                "value": 1.5}
+        # stdout contract unchanged: the JSON line still prints
+        assert json.loads(capsys.readouterr().out.strip()) == \
+            {"metric": "x", "value": 1.5}
+        # no tmp droppings next to the artifact
+        assert list(tmp_path.iterdir()) == [path]
+
+    def test_emit_without_path_only_prints(self, capsys):
+        bench.emit({"a": 1})
+        assert "\"a\": 1" in capsys.readouterr().out

@@ -1,10 +1,19 @@
-"""Checkpointer: save/restore round-trip, retention, latest-step."""
+"""Checkpointer: save/restore round-trip, retention, latest-step, the
+async writer contract, and sharded (ZeRO) save/restore across world
+sizes (docs/warmstart.md)."""
 
+import os
+import threading
+import time
+
+import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 import pytest
 
 import horovod_tpu as hvd
+from horovod_tpu.ops import collectives as C
 
 
 def make_state(v=1.0):
@@ -46,3 +55,311 @@ class TestCheckpointer:
                                            use_orbax=False)
         with pytest.raises(FileNotFoundError):
             ckpt.restore(make_state())
+
+
+class TestAsyncSave:
+    def test_roundtrip_through_background_writer(self, tmp_path):
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                           use_orbax=False,
+                                           async_save=True)
+        assert ckpt.save(0, make_state(9.0))
+        ckpt.wait()
+        assert ckpt.last_stall_s is not None   # the D2H cut was timed
+        assert ckpt.last_write_s is not None   # the background write too
+        restored = ckpt.restore(make_state(0.0))
+        np.testing.assert_allclose(np.asarray(restored["params"]["w"]), 9.0)
+
+    def test_reads_see_pending_write(self, tmp_path):
+        # read-your-writes: restore()/all_steps() barrier on the writer,
+        # so a save followed immediately by a read never misses
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                           use_orbax=False)
+        ckpt.save(3, make_state(3.0))
+        assert ckpt.latest_step() == 3
+        restored = ckpt.restore(make_state(0.0))
+        assert restored["step"] == 3
+
+    def test_save_stalls_only_for_the_copy(self, tmp_path, monkeypatch):
+        # slow the background serialization down; save() must still
+        # return fast (it blocks only for the host copy), and wait()
+        # must block until the write finished
+        import horovod_tpu.checkpoint as ckpt_mod
+
+        real = ckpt_mod._atomic_write
+        started = threading.Event()
+
+        def slow_write(path, payload):
+            started.set()
+            time.sleep(0.3)
+            real(path, payload)
+
+        monkeypatch.setattr(ckpt_mod, "_atomic_write", slow_write)
+        ckpt = ckpt_mod.Checkpointer(str(tmp_path / "ck"),
+                                     use_orbax=False)
+        t0 = time.perf_counter()
+        ckpt.save(0, make_state(1.0))
+        stall = time.perf_counter() - t0
+        assert started.wait(5.0)
+        assert stall < 0.25            # the 0.3 s write is off the clock
+        t0 = time.perf_counter()
+        ckpt.wait()
+        assert time.perf_counter() - t0 > 0.05   # wait() really blocked
+        assert ckpt.last_write_s >= 0.3
+
+    def test_writer_error_surfaces_at_wait(self, tmp_path):
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                           use_orbax=False)
+        # lambdas survive the host copy but cannot pickle
+        ckpt.save(0, {"fn": lambda: None})
+        with pytest.raises(Exception):
+            ckpt.wait()
+        # the error is consumed: the next save/wait cycle is clean
+        ckpt.save(1, make_state(2.0))
+        ckpt.wait()
+        assert ckpt.latest_step() == 1
+
+    def test_no_tmp_droppings_and_atomic_layout(self, tmp_path):
+        root = tmp_path / "ck"
+        ckpt = hvd.checkpoint.Checkpointer(str(root), use_orbax=False)
+        ckpt.save(0, make_state(1.0))
+        ckpt.wait()
+        files = os.listdir(root / "step_0")
+        assert files == ["state.pkl"]
+
+    def test_crashed_partial_write_is_invisible(self, tmp_path):
+        root = tmp_path / "ck"
+        ckpt = hvd.checkpoint.Checkpointer(str(root), use_orbax=False)
+        ckpt.save(0, make_state(1.0))
+        ckpt.wait()
+        # simulate a crash mid-write of step 1: tmp file exists, no rename
+        os.makedirs(root / "step_1", exist_ok=True)
+        with open(root / "step_1" / ".tmp.state.pkl.999", "wb") as f:
+            f.write(b"partial")
+        assert ckpt.all_steps() == [0]   # the torso never surfaces
+        restored = ckpt.restore(make_state(0.0))
+        np.testing.assert_allclose(np.asarray(restored["params"]["w"]), 1.0)
+
+    def test_bfloat16_leaves_roundtrip(self, tmp_path):
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                           use_orbax=False)
+        state = {"w": jnp.full((4, 2), 1.5, jnp.bfloat16),
+                 "nu": jnp.arange(6, dtype=jnp.bfloat16)}
+        ckpt.save(0, state)
+        restored = ckpt.restore(state)
+        assert restored["w"].dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(restored["w"], np.float32), 1.5)
+        np.testing.assert_allclose(
+            np.asarray(restored["nu"], np.float32), np.arange(6))
+
+    def test_sync_mode_is_durable_on_return(self, tmp_path):
+        root = tmp_path / "ck"
+        ckpt = hvd.checkpoint.Checkpointer(str(root), use_orbax=False,
+                                           async_save=False)
+        ckpt.save(0, make_state(4.0))
+        # no wait(): the file is already there
+        assert os.path.exists(root / "step_0" / "state.pkl")
+
+
+def _shard_trees(leaves, world):
+    """Per-rank ZeRO state trees for ``leaves``: the fusion spec's flat
+    buffer (concat + zero-pad to a world multiple), sliced per rank —
+    exactly the shape ``sharded_distributed_update`` keeps per rank."""
+    spec = C.make_fusion_spec(leaves, world)
+    flats = {}
+    for g in spec.groups:
+        flat = np.concatenate(
+            [np.ravel(np.asarray(leaves[i])) for i in g.indices])
+        flats[g.key] = np.concatenate(
+            [flat, np.zeros(g.padded - flat.size, flat.dtype)])
+    trees = []
+    for r in range(world):
+        trees.append({k: {"m": v[r * (v.size // world):
+                                 (r + 1) * (v.size // world)],
+                          "count": np.int32(7)}
+                      for k, v in flats.items()})
+    return spec, flats, trees
+
+
+class TestShardedCheckpoint:
+    LEAVES = [np.arange(10, dtype=np.float32),
+              np.arange(6, dtype=np.float32).reshape(2, 3) + 100.0]
+
+    def _save_all(self, tmp_path, world):
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                           use_orbax=False)
+        spec, flats, trees = _shard_trees(self.LEAVES, world)
+        for r, tree in enumerate(trees):
+            ckpt.save_sharded(0, tree, r, world)
+            ckpt.wait()
+        return ckpt, spec, flats, trees
+
+    def test_same_world_roundtrip(self, tmp_path):
+        world = 4
+        ckpt, spec, flats, trees = self._save_all(tmp_path, world)
+        for r in range(world):
+            target = {k: {"m": np.zeros_like(v["m"]),
+                          "count": np.int32(0)}
+                      for k, v in trees[r].items()}
+            out = ckpt.restore_sharded(target, r, world)
+            for k in trees[r]:
+                np.testing.assert_array_equal(out[k]["m"],
+                                              trees[r][k]["m"])
+                assert out[k]["count"] == 7
+
+    @pytest.mark.parametrize("new_world", [2, 8, 3])
+    def test_resharded_restore(self, tmp_path, new_world):
+        # save at world 4, restore at 2 / 8 / 3 (the non-dividing case
+        # exercises pad-trim): every new shard must equal the slice of
+        # the re-padded full flat buffer
+        ckpt, spec, flats, _ = self._save_all(tmp_path, world=4)
+        new_spec = C.make_fusion_spec(self.LEAVES, new_world)
+        for g in new_spec.groups:
+            full = flats[g.key]          # old padded buffer
+            if g.padded >= full.size:
+                full = np.concatenate(
+                    [full, np.zeros(g.padded - full.size, full.dtype)])
+            else:
+                full = full[:g.padded]
+            for r in range(new_world):
+                target = {k2.key: {"m": np.zeros((k2.shard,), np.float32),
+                                   "count": np.int32(0)}
+                          for k2 in new_spec.groups}
+                out = ckpt.restore_sharded(target, r, new_world)
+                np.testing.assert_array_equal(
+                    out[g.key]["m"],
+                    full[r * g.shard:(r + 1) * g.shard])
+                assert out[g.key]["count"] == 7   # scalar: rank 0 wins
+
+    def test_trimming_nonzero_state_raises(self, tmp_path):
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                           use_orbax=False)
+        # 12-long buffer, all non-zero — restoring into 2 shards of 5
+        # (10 < 12) would silently drop real state
+        for r in range(4):
+            ckpt.save_sharded(0, {"m": np.ones(3, np.float32)}, r, 4)
+            ckpt.wait()
+        with pytest.raises(ValueError, match="non-zero state"):
+            ckpt.restore_sharded({"m": np.zeros(5, np.float32)}, 0, 2)
+
+    def test_incomplete_shard_set_raises(self, tmp_path):
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                           use_orbax=False)
+        ckpt.save_sharded(0, {"m": np.ones(3, np.float32)}, 0, 4)
+        ckpt.wait()
+        ckpt.save_sharded(0, {"m": np.ones(3, np.float32)}, 2, 4)
+        ckpt.wait()
+        with pytest.raises(FileNotFoundError, match=r"missing shard"):
+            ckpt.restore_sharded({"m": np.zeros(3, np.float32)}, 0, 4)
+
+    def test_mixed_world_overwrite_raises(self, tmp_path):
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                           use_orbax=False)
+        for r in range(2):
+            ckpt.save_sharded(0, {"m": np.ones(4, np.float32)}, r, 2)
+            ckpt.wait()
+        ckpt.save_sharded(0, {"m": np.ones(2, np.float32)}, 3, 4)
+        ckpt.wait()
+        with pytest.raises(ValueError, match="mixed shard_count"):
+            ckpt.restore_sharded({"m": np.zeros(4, np.float32)}, 0, 2)
+
+    def test_real_sharded_optimizer_state_reshards(self, tmp_path):
+        """End-to-end: the per-rank state of sharded_distributed_update
+        (optax.adam over fusion-template shards) saved at world 4 and
+        restored at world 8 slices identically to re-running the spec
+        math at world 8."""
+        from horovod_tpu.optim.optimizer import sharded_distributed_update
+
+        params = {"w": jnp.arange(24, dtype=jnp.float32).reshape(4, 6),
+                  "b": jnp.arange(5, dtype=jnp.float32)}
+        leaves = jax.tree_util.tree_leaves(params)
+        opt4 = sharded_distributed_update(optax.adam(1e-2), world=4)
+        state4 = opt4.init(params)
+        # populate each rank's mu with its slice of a known full buffer
+        spec4 = C.make_fusion_spec(leaves, 4)
+        full = {g.key: np.arange(g.padded, dtype=np.float32) + 1.0
+                for g in spec4.groups}
+        # zero the fusion padding: the re-shard contract's tail invariant
+        total = {g.key: sum(g.sizes) for g in spec4.groups}
+        for k in full:
+            full[k][total[k]:] = 0.0
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                           use_orbax=False)
+        for r in range(4):
+            rank_state = jax.tree_util.tree_map(np.asarray, state4)
+            mu = {g.key: full[g.key][r * g.shard:(r + 1) * g.shard]
+                  for g in spec4.groups}
+            rank_state = (rank_state.inner[0]._replace(
+                mu=mu, nu=jax.tree_util.tree_map(np.zeros_like, mu)),
+                rank_state.inner[1])
+            ckpt.save_sharded(0, rank_state, r, 4)
+            ckpt.wait()
+        opt8 = sharded_distributed_update(optax.adam(1e-2), world=8)
+        spec8 = C.make_fusion_spec(leaves, 8)
+        template = jax.tree_util.tree_map(np.asarray, opt8.init(params))
+        template = (template.inner[0], template.inner[1])
+        for r in (0, 5, 7):
+            out = ckpt.restore_sharded(template, r, 8)
+            for g in spec8.groups:
+                want = full[g.key]
+                if g.padded > want.size:
+                    want = np.concatenate(
+                        [want, np.zeros(g.padded - want.size,
+                                        want.dtype)])
+                else:
+                    want = want[:g.padded]
+                np.testing.assert_array_equal(
+                    out[0].mu[g.key],
+                    want[r * g.shard:(r + 1) * g.shard])
+
+
+class TestElasticStateThroughAsyncCheckpoint:
+    def test_commit_persists_and_cold_restores(self, tmp_path):
+        hvd.init()
+        try:
+            ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                               use_orbax=False)
+            state = hvd.elastic.TpuState(
+                params={"w": jnp.ones((2, 2))},
+                opt_state={"mu": jnp.zeros((2, 2))},
+                epoch=0, checkpointer=ckpt)
+            state.params = {"w": jnp.full((2, 2), 5.0)}
+            state.epoch = 3
+            state.commit()
+            state.wait()
+            # a brand-new process (no in-memory commit): restore from disk
+            cold = hvd.elastic.TpuState(
+                params={"w": jnp.zeros((2, 2))},
+                opt_state={"mu": jnp.zeros((2, 2))},
+                epoch=0, checkpointer=ckpt)
+            assert cold.restore_from_checkpoint() is True
+            np.testing.assert_allclose(np.asarray(cold.params["w"]), 5.0)
+            assert cold.epoch == 3
+        finally:
+            hvd.shutdown()
+
+    def test_checkpoint_every_skips_intermediate_commits(self, tmp_path):
+        hvd.init()
+        try:
+            ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                               use_orbax=False,
+                                               max_to_keep=10)
+            state = hvd.elastic.TpuState(
+                params={"w": jnp.ones(2)}, epoch=0,
+                checkpointer=ckpt, checkpoint_every=2)
+            for _ in range(4):
+                state.commit()
+            state.wait()
+            assert ckpt.all_steps() == [2, 4]
+        finally:
+            hvd.shutdown()
+
+    def test_no_checkpointer_is_memory_only(self):
+        hvd.init()
+        try:
+            state = hvd.elastic.TpuState(params={"w": jnp.ones(2)})
+            state.commit()
+            state.wait()                 # no-op barrier
+            assert state.restore_from_checkpoint() is False
+        finally:
+            hvd.shutdown()

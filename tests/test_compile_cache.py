@@ -1,0 +1,220 @@
+"""Warm-start compile cache: key contract, AOT round-trip, LRU bounds,
+and the transparent DistributedTrainStep integration (docs/warmstart.md).
+"""
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.runtime import compile_cache, state as rt_state
+
+
+@pytest.fixture
+def cache_dir(tmp_path, monkeypatch):
+    """Isolated cache root, active for both env- and config-resolution,
+    with a freshly-initialized runtime."""
+    d = str(tmp_path / "cc")
+    monkeypatch.setenv("HOROVOD_COMPILE_CACHE_DIR", d)
+    hvd.shutdown()
+    hvd.init()
+    yield d
+    hvd.shutdown()
+
+
+class TestKey:
+    def test_deterministic(self):
+        k1 = compile_cache.executable_key("module @m {}", {"a": 1})
+        k2 = compile_cache.executable_key("module @m {}", {"a": 1})
+        assert k1 == k2
+
+    def test_sensitive_to_module_extras_and_options(self):
+        base = compile_cache.executable_key("module @m {}", {"a": 1})
+        assert compile_cache.executable_key("module @n {}", {"a": 1}) != base
+        assert compile_cache.executable_key("module @m {}", {"a": 2}) != base
+        assert compile_cache.executable_key(
+            "module @m {}", {"a": 1},
+            compiler_options={"xla_flag": "true"}) != base
+
+
+class TestResolveDir:
+    def test_env_disable(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_COMPILE_CACHE", "0")
+        hvd.shutdown()   # fall back to raw-env resolution
+        assert compile_cache.resolve_dir() is None
+
+    def test_config_disable(self, cache_dir):
+        cfg = rt_state.global_state().config
+        import dataclasses
+
+        off = dataclasses.replace(cfg, compile_cache_enabled=False)
+        assert compile_cache.resolve_dir(off) is None
+
+    def test_env_dir_wins(self, cache_dir):
+        assert compile_cache.resolve_dir() == cache_dir
+
+    def test_persistent_xla_cache_wired_at_init(self, cache_dir):
+        assert rt_state.global_state().compile_cache_dir == cache_dir
+        assert jax.config.jax_compilation_cache_dir == \
+            os.path.join(cache_dir, "xla")
+
+
+class TestAotRoundTrip:
+    def test_miss_store_hit(self, cache_dir):
+        f = jax.jit(lambda x: x * 2 + 1)
+        args = (jnp.arange(8, dtype=jnp.float32),)
+        c1, hit1 = compile_cache.aot_compile(f, args, extras={"t": 1},
+                                             directory=cache_dir)
+        assert hit1 is False
+        assert compile_cache.entry_count(cache_dir) == 1
+        c2, hit2 = compile_cache.aot_compile(f, args, extras={"t": 1},
+                                             directory=cache_dir)
+        assert hit2 is True
+        np.testing.assert_allclose(np.asarray(c1(*args)),
+                                   np.asarray(c2(*args)))
+
+    def test_disabled_compiles_plain(self, cache_dir):
+        f = jax.jit(lambda x: x + 1)
+        args = (jnp.ones(4),)
+        compiled, hit = compile_cache.aot_compile(f, args, directory=None)
+        assert hit is False
+        assert compile_cache.entry_count(cache_dir) == 0
+        np.testing.assert_allclose(np.asarray(compiled(*args)), 2.0)
+
+    def test_stats_counters_flow_to_runtime(self, cache_dir):
+        f = jax.jit(lambda x: x - 3)
+        args = (jnp.ones(4),)
+        before = hvd.cache_stats()
+        compile_cache.aot_compile(f, args, directory=cache_dir)
+        compile_cache.aot_compile(f, args, directory=cache_dir)
+        after = hvd.cache_stats()
+        assert after["aot_disk_misses"] == before["aot_disk_misses"] + 1
+        assert after["aot_disk_hits"] == before["aot_disk_hits"] + 1
+
+    def test_corrupt_entry_recovers(self, cache_dir):
+        f = jax.jit(lambda x: x * 5)
+        args = (jnp.ones(4),)
+        compile_cache.aot_compile(f, args, directory=cache_dir)
+        aot = os.path.join(cache_dir, "aot")
+        (entry,) = os.listdir(aot)
+        with open(os.path.join(aot, entry), "wb") as fh:
+            fh.write(b"not a pickle")
+        compiled, hit = compile_cache.aot_compile(f, args,
+                                                  directory=cache_dir)
+        assert hit is False            # corrupted entry fell back
+        np.testing.assert_allclose(np.asarray(compiled(*args)), 5.0)
+
+    def test_incompatible_payload_is_evicted_then_rewritten(
+            self, cache_dir):
+        f = jax.jit(lambda x: x * 7)
+        args = (jnp.ones(4),)
+        compile_cache.aot_compile(f, args, directory=cache_dir)
+        aot = os.path.join(cache_dir, "aot")
+        (entry,) = os.listdir(aot)
+        # well-formed pickle, wrong schema — the deserialize raises
+        with open(os.path.join(aot, entry), "wb") as fh:
+            pickle.dump({"serialized": b"xx", "in_tree": None,
+                         "out_tree": None}, fh)
+        _, hit = compile_cache.aot_compile(f, args, directory=cache_dir)
+        assert hit is False
+        _, hit = compile_cache.aot_compile(f, args, directory=cache_dir)
+        assert hit is True             # rewritten entry loads again
+
+
+class TestLruEviction:
+    def test_prune_keeps_most_recent(self, cache_dir):
+        fns = [jax.jit(lambda x, k=k: x + k) for k in range(4)]
+        args = (jnp.ones(4),)
+        for f in fns:
+            compile_cache.aot_compile(f, args, directory=cache_dir,
+                                      capacity=2)
+        assert compile_cache.entry_count(cache_dir) == 2
+        # the survivors are the two most recently stored
+        _, hit = compile_cache.aot_compile(fns[-1], args,
+                                           directory=cache_dir, capacity=2)
+        assert hit is True
+        _, hit = compile_cache.aot_compile(fns[0], args,
+                                           directory=cache_dir, capacity=2)
+        assert hit is False
+
+
+def _loss(params, batch):
+    pred = batch["x"] @ params["w"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def _make_step(**kw):
+    return hvd.DistributedTrainStep(_loss, optax.adamw(1e-3), **kw)
+
+
+class TestTrainStepIntegration:
+    def _run_once(self, step):
+        p, o = step.init({"w": jnp.ones((8, 4))})
+        batch = step.shard_batch({"x": jnp.ones((16, 8)),
+                                  "y": jnp.zeros((16, 4))})
+        return step(p, o, batch)
+
+    def test_cold_then_warm_across_step_objects(self, cache_dir):
+        step = _make_step()
+        p1, _, l1 = self._run_once(step)
+        assert step.compile_cache_hit is False
+        assert compile_cache.entry_count(cache_dir) == 1
+
+        step2 = _make_step()
+        p2, _, l2 = self._run_once(step2)
+        assert step2.compile_cache_hit is True
+        assert float(l1) == pytest.approx(float(l2))
+        np.testing.assert_allclose(np.asarray(p1["w"]),
+                                   np.asarray(p2["w"]))
+
+    def test_sharded_exchange_step_round_trips(self, cache_dir):
+        kw = dict(mode="shard_map", shard_optimizer_states=True,
+                  exchange_bucket_bytes=1 << 20)
+        p1, _, _ = self._run_once(_make_step(**kw))
+        step2 = _make_step(**kw)
+        p2, _, _ = self._run_once(step2)
+        assert step2.compile_cache_hit is True
+        np.testing.assert_allclose(np.asarray(p1["w"]),
+                                   np.asarray(p2["w"]))
+
+    def test_in_memory_lru_bounded_by_cache_capacity(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HOROVOD_COMPILE_CACHE_DIR",
+                           str(tmp_path / "cc2"))
+        monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "1")
+        hvd.shutdown()
+        hvd.init()
+        try:
+            step = _make_step()
+            assert step._compiled_cache_max == 1
+            p, o = step.init({"w": jnp.ones((8, 4))})
+            mk = lambda n: step.shard_batch(    # noqa: E731
+                {"x": jnp.ones((n, 8)), "y": jnp.zeros((n, 4))})
+            before = hvd.cache_stats()
+            p, o, _ = step(p, o, mk(16))
+            p, o, _ = step(p, o, mk(24))   # new signature evicts the first
+            assert len(step._compiled_cache) == 1
+            p, o, _ = step(p, o, mk(24))   # in-memory hit
+            after = hvd.cache_stats()
+            assert after["misses"] == before["misses"] + 2
+            assert after["hits"] == before["hits"] + 1
+        finally:
+            hvd.shutdown()
+
+    def test_cache_disabled_keeps_plain_jit_path(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HOROVOD_COMPILE_CACHE", "0")
+        hvd.shutdown()
+        hvd.init()
+        try:
+            step = _make_step()
+            assert step._persistent_root is None
+            self._run_once(step)
+            assert step.compile_cache_hit is None
+        finally:
+            hvd.shutdown()
