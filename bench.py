@@ -211,6 +211,12 @@ def run_checkpoint_probe(args, state, label, prefix=""):
     root = tempfile.mkdtemp(prefix="bench_ckpt_")
     try:
         payload = {"params": state[0], "opt_state": state[1]}
+        # untimed warm-up save: prime the OS page cache and allocator so
+        # neither timed variant gets a cold-start penalty — without it
+        # the second (async) run measures warm against the sync run's
+        # cold, biasing the stall/sync ratio the acceptance bar judges
+        warm = Checkpointer(os.path.join(root, "warm"), async_save=False)
+        warm.save(0, payload)
         sync = Checkpointer(os.path.join(root, "sync"), async_save=False)
         t0 = time.perf_counter()
         sync.save(0, payload)

@@ -62,11 +62,24 @@ def _is_root() -> bool:
 
 
 def _host_copy(state: Any) -> Any:
-    """The consistent cut: synchronous device→host copy of every array
-    leaf.  After this returns, the snapshot is immune to donation —
-    the train loop may overwrite the device buffers in place."""
-    return jax.tree_util.tree_map(
-        lambda x: np.asarray(x) if hasattr(x, "shape") else x, state)
+    """The consistent cut: synchronous copy of every array leaf into
+    host memory the snapshot OWNS.  After this returns, the snapshot is
+    immune — the caller may overwrite its device buffers *and* its host
+    arrays in place while the background writer pickles."""
+
+    def _leaf(x):
+        if isinstance(x, np.ndarray):
+            # np.asarray would be a zero-copy alias here, breaking the
+            # immune-after-return contract for host-resident state
+            return x.copy()
+        if hasattr(x, "shape"):
+            a = np.asarray(x)
+            # __array__ can be zero-copy too (CPU-backed jax arrays):
+            # keep only memory we own
+            return a if a.base is None and a.flags.owndata else a.copy()
+        return x
+
+    return jax.tree_util.tree_map(_leaf, state)
 
 
 def _atomic_write(path: str, payload: Any) -> None:
@@ -327,10 +340,21 @@ class Checkpointer:
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self._dir}")
         # layout-agnostic: read whichever format holds this step
-        pkl = os.path.join(self._dir, f"step_{step}", "state.pkl")
+        step_dir = os.path.join(self._dir, f"step_{step}")
+        pkl = os.path.join(step_dir, "state.pkl")
         if os.path.exists(pkl):
             with open(pkl, "rb") as f:
                 return pickle.load(f)
+        if os.path.isdir(step_dir) and any(
+                n.startswith("shard_") and n.endswith(".pkl")
+                for n in os.listdir(step_dir)):
+            # don't fall through to orbax: the step exists but holds
+            # per-rank shard files, which only restore_sharded can read
+            raise ValueError(
+                f"step {step} in {self._dir} was written by "
+                f"save_sharded() (per-rank shard files, no replicated "
+                f"state.pkl) — use restore_sharded(target, shard_rank, "
+                f"shard_count) to read it")
         if step not in self.all_steps():
             raise FileNotFoundError(
                 f"no checkpoint for step {step} in {self._dir} "

@@ -190,10 +190,13 @@ class ElasticDriver:
                     or self._generation_ready_logged >= gen:
                 return      # a newer generation superseded this reading
             self._generation_ready_logged = gen
-            self.last_recovery_s = time.monotonic() - started
+            # log the local, not the attribute: a ready-check for a newer
+            # generation may overwrite last_recovery_s before the log runs
+            recovery_s = time.monotonic() - started
+            self.last_recovery_s = recovery_s
         hvd_logging.info(
             "elastic: generation %d fully ready — %d worker(s) in "
-            "recovery_s=%.1f", gen, len(keys), self.last_recovery_s)
+            "recovery_s=%.1f", gen, len(keys), recovery_s)
 
     # -- lifecycle ----------------------------------------------------------
 

@@ -183,10 +183,10 @@ class TpuState(ObjectState):
         self._commit_count += 1
         if self._checkpointer is not None and \
                 self._commit_count % self._checkpoint_every == 0:
-            # the leaves are already host numpy copies, so the
-            # checkpointer's D2H cut is a no-op and the only cost on
-            # the training clock is thread dispatch — serialization
-            # and fsync run behind the loop (checkpoint.py)
+            # the leaves are already host numpy arrays, so the
+            # checkpointer's cut costs only a host memcpy (it copies
+            # numpy leaves to own its snapshot) plus thread dispatch —
+            # serialization and fsync run behind the loop (checkpoint.py)
             self._checkpointer.save(self._commit_count, self._saved_state)
 
     def wait(self) -> None:
@@ -201,10 +201,22 @@ class TpuState(ObjectState):
         checkpointer has nothing."""
         if self._checkpointer is None:
             return False
-        if step is None and self._checkpointer.latest_step() is None:
-            return False
+        if step is None:
+            # resolve once (collective when multi-process) so the step is
+            # known here, not just inside restore(): the commit counter
+            # must continue from it
+            step = self._checkpointer._resolve_step()
+            if step is None:
+                return False
         saved = self._checkpointer.restore(self._saved_state, step=step)
         self._saved_state = saved
+        # Continue the step sequence from the restored commit: leaving
+        # _commit_count at 0 would make post-restore saves re-use step
+        # numbers 1, 2, ... — the checkpointer's keep-highest retention
+        # would then GC the fresh low-numbered steps while latest_step()
+        # kept answering the stale pre-crash one, so a second crash would
+        # lose everything since the first restart.
+        self._commit_count = int(step)
         self.restore()
         return True
 

@@ -118,6 +118,30 @@ class TestAsyncSave:
         ckpt.wait()
         assert ckpt.latest_step() == 1
 
+    def test_snapshot_owns_host_arrays(self, tmp_path, monkeypatch):
+        # the immune-after-return contract must hold for numpy leaves
+        # too: mutating the caller's host arrays after save() returns
+        # must not tear the background pickle
+        import horovod_tpu.checkpoint as ckpt_mod
+
+        real = ckpt_mod._atomic_write
+        gate = threading.Event()
+
+        def gated_write(path, payload):
+            gate.wait(5.0)
+            real(path, payload)
+
+        monkeypatch.setattr(ckpt_mod, "_atomic_write", gated_write)
+        ckpt = ckpt_mod.Checkpointer(str(tmp_path / "ck"),
+                                     use_orbax=False)
+        state = {"w": np.full((4,), 1.0, np.float32)}
+        ckpt.save(0, state)
+        state["w"][:] = -99.0          # caller reuses its buffer
+        gate.set()
+        ckpt.wait()
+        restored = ckpt.restore({"w": np.zeros((4,), np.float32)})
+        np.testing.assert_allclose(restored["w"], 1.0)
+
     def test_no_tmp_droppings_and_atomic_layout(self, tmp_path):
         root = tmp_path / "ck"
         ckpt = hvd.checkpoint.Checkpointer(str(root), use_orbax=False)
@@ -230,6 +254,14 @@ class TestShardedCheckpoint:
                     out[g.key]["m"],
                     full[r * g.shard:(r + 1) * g.shard])
                 assert out[g.key]["count"] == 7   # scalar: rank 0 wins
+
+    def test_plain_restore_of_sharded_step_raises_clear_error(
+            self, tmp_path):
+        # restore() must not fall through to the orbax branch (confusing
+        # path error / ImportError) when the step holds only shard files
+        ckpt, _, _, trees = self._save_all(tmp_path, world=4)
+        with pytest.raises(ValueError, match="restore_sharded"):
+            ckpt.restore(trees[0])
 
     def test_trimming_nonzero_state_raises(self, tmp_path):
         ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
@@ -351,6 +383,42 @@ class TestElasticStateThroughAsyncCheckpoint:
                 state.commit()
             state.wait()
             assert ckpt.all_steps() == [2, 4]
+        finally:
+            hvd.shutdown()
+
+    def test_commit_counter_resumes_from_restored_step(self, tmp_path):
+        # Regression: after a cold restore from durable step N, further
+        # commits must continue at N+1, N+2, ... — restarting from 1
+        # would make keep-highest retention GC the fresh steps while
+        # latest_step() kept answering the stale pre-crash one, so a
+        # second crash would lose all post-restart progress.
+        hvd.init()
+        try:
+            ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                               use_orbax=False,
+                                               max_to_keep=2)
+            state = hvd.elastic.TpuState(params={"w": jnp.ones(2)},
+                                         epoch=0, checkpointer=ckpt)
+            for e in range(5):
+                state.epoch = e
+                state.commit()
+            state.wait()
+            assert ckpt.latest_step() == 5
+
+            cold = hvd.elastic.TpuState(params={"w": jnp.zeros(2)},
+                                        epoch=0, checkpointer=ckpt)
+            assert cold.restore_from_checkpoint() is True
+            assert cold.epoch == 4
+            cold.epoch = 9
+            cold.commit()                # must persist as step 6, not 1
+            cold.wait()
+            assert ckpt.latest_step() == 6
+            assert ckpt.all_steps() == [5, 6]   # retention kept the new one
+
+            second = hvd.elastic.TpuState(params={"w": jnp.zeros(2)},
+                                          epoch=0, checkpointer=ckpt)
+            assert second.restore_from_checkpoint() is True
+            assert second.epoch == 9     # post-restart progress survived
         finally:
             hvd.shutdown()
 
