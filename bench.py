@@ -65,12 +65,16 @@ def hw_peak_flops():
 
 
 def median_rate(step_fn, state, warmup_batches, iters, batches_per_iter,
-                units_per_batch, label):
+                units_per_batch, label, on_warmup_end=None):
     """Warm up (compile), then median units/sec across ``iters`` timed
     iterations.  Returns ``(median, warmup_s, state)`` — the warmup
     time (compile + first fenced steps) is the cold-start cost the
     persistent compile cache collapses on a hit, and ``state`` is the
     live post-loop train state (the checkpoint probe snapshots it).
+    ``on_warmup_end`` fires once between the fenced warmup and the
+    first timed iteration — the hook the input-pipeline path uses to
+    snapshot its stall counters so cold-start assembly never pollutes
+    the steady-state ``input_stall_s``.
 
     Fences on a host fetch of the loss, not ``jax.block_until_ready``:
     through remote-device tunnels block_until_ready can return before
@@ -91,6 +95,8 @@ def median_rate(step_fn, state, warmup_batches, iters, batches_per_iter,
         warmup_s = time.perf_counter() - t0
         log(f"bench[{label}]: warmup (incl. compile) "
             f"{warmup_s:.1f}s, loss={float(state[-1]):.3f}")
+    if on_warmup_end is not None:
+        on_warmup_end()
 
     def timed_iter(state):
         t0 = time.perf_counter()
@@ -177,6 +183,110 @@ def run_overlap_probe(args, loss_fn, params, batch, prefix, label):
         f"-> overlap {rep.overlap_fraction:.2f} "
         f"({rep.payload_bytes / 1e6:.1f} MB payload, world {rep.world})")
     return rep.as_bench_fields(prefix)
+
+
+def _rand_images(rng, n, hw):
+    """(n, hw, hw, 3) float32 uniform images, generated in chunks so
+    the float64 intermediate never materializes the whole dataset."""
+    out = np.empty((n, hw, hw, 3), np.float32)
+    for i in range(0, n, 64):
+        out[i:i + 64] = rng.rand(min(64, n - i), hw, hw, 3)
+    return out
+
+
+def run_pipeline_fed(args, step, host_data, init_state, global_bs,
+                     units_per_batch, label, prefix):
+    """``--input-mode host``: the pipeline-fed bench path.
+
+    The timed loop consumes host batches through ``ShardedDataset`` →
+    ``PrefetchIterator`` (assembly + H2D on background threads, double
+    buffered onto the step's sharding), exactly the production feed —
+    so the headline rate includes whatever input cost is left exposed.
+    Emits the input-plane contract fields: ``input_stall_s`` (per-step
+    time the loop blocked waiting for a batch, steady-state only),
+    ``input_stall_sync_s`` (a synchronous-feed control: same assembly
+    + placement run inline on the critical path), ``prefetch_depth``,
+    and the ``h2d_overlap_fraction`` timing probe verifying the
+    transfer really hides under an in-flight step
+    (utils/input_probe.py).  Returns ``(rate, warmup_s, state,
+    fields)``."""
+    from horovod_tpu.data import (
+        ArraySource,
+        PrefetchIterator,
+        ShardedDataset,
+    )
+    from horovod_tpu.utils.input_probe import (
+        fence_batch,
+        measure_h2d_overlap,
+    )
+
+    # the driver process feeds the whole mesh: one rank, global batches
+    ds = ShardedDataset(ArraySource(host_data), batch_size=global_bs,
+                        rank=0, world=1, seed=0)
+    feed = PrefetchIterator(ds.iter_epochs(), place=step.shard_batch,
+                            depth=args.prefetch_depth, name=label)
+    snap = {"n": 0}
+
+    def on_warm():
+        snap["n"] = len(feed.stall_samples)
+
+    rate, warmup_s, state = median_rate(
+        lambda s: step(s[0], s[1], next(feed)), init_state,
+        args.num_warmup_batches, args.num_iters,
+        args.num_batches_per_iter, units_per_batch, label,
+        on_warmup_end=on_warm)
+    # median per-step stall over the steady-state (timed) window only —
+    # robust to one-off queue-wakeup spikes, same discipline as the
+    # headline median-of-iters
+    timed = feed.stall_samples[snap["n"]:]
+    stall = float(np.median(timed)) if timed else 0.0
+    depth = feed.depth
+    feed.close()
+
+    # synchronous-feed control at the same steady state: identical
+    # assembly + placement, inline on the critical path, fenced — the
+    # cost the pipeline exists to hide
+    gen = ds.iter_epochs()
+    sync = []
+    for i in range(args.num_batches_per_iter + 1):
+        t0 = time.perf_counter()
+        b = step.shard_batch(next(gen))
+        fence_batch(b)
+        dt = time.perf_counter() - t0
+        state = step(state[0], state[1], b)
+        if i:                    # first call absorbs generator warm-up
+            sync.append(dt)
+    float(state[-1])
+    sync_stall = float(np.median(sync))
+
+    holder = [state]
+
+    def probe_step(batch):
+        p, o, loss = step(holder[0][0], holder[0][1], batch)
+        holder[0] = (p, o, loss)
+        return loss
+
+    gen2 = ds.iter_epochs()
+    probe = measure_h2d_overlap(probe_step, lambda: next(gen2),
+                                step.shard_batch)
+    state = holder[0]
+    log(f"bench[{label}]: input feed [pipeline] stall "
+        f"{stall * 1e3:.2f}ms/step vs {sync_stall * 1e3:.2f}ms "
+        f"synchronous ({sync_stall / stall:.1f}x hidden, depth {depth}, "
+        f"h2d overlap {probe.overlap_fraction:.2f})"
+        if stall > 0 else
+        f"bench[{label}]: input feed [pipeline] stall 0ms/step vs "
+        f"{sync_stall * 1e3:.2f}ms synchronous (depth {depth})")
+    fields = {
+        prefix + "input_mode": "host",
+        prefix + "input_stall_s": round(stall, 6),
+        prefix + "input_stall_sync_s": round(sync_stall, 6),
+        prefix + "input_stall_speedup":
+            round(sync_stall / stall, 1) if stall > 0 else None,
+        prefix + "prefetch_depth": depth,
+        **probe.as_bench_fields(prefix),
+    }
+    return rate, warmup_s, state, fields
 
 
 def warmstart_fields(step, warmup_s, prefix=""):
@@ -294,6 +404,9 @@ def run_resnet(args, hvd):
         loss_fn, optax.sgd(0.01 * n_chips, momentum=0.9),
         steps_per_call=spc,
         compiler_options=tpu_compiler_options(args),
+        # pipeline-fed batches are fresh per call, so the input slot
+        # may be donated (host mode only; synthetic reuses one batch)
+        donate_batch=args.input_mode == "host",
         **exchange_step_kwargs(args))
     x0 = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
     params, opt_state = step.init(jax.jit(
@@ -312,11 +425,25 @@ def run_resnet(args, hvd):
     overlap = run_overlap_probe(args, loss_fn, params, batch,
                                 "resnet_", "resnet")
 
-    rate, warmup_s, _state = median_rate(
-        lambda s: step(s[0], s[1], batch), (params, opt_state, None),
-        args.num_warmup_batches, args.num_iters,
-        args.num_batches_per_iter,
-        global_bs * spc, "resnet")
+    input_fields = {}
+    if args.input_mode == "host":
+        # pipeline-fed path: host-resident dataset streamed through
+        # ShardedDataset -> PrefetchIterator (assembly + H2D off the
+        # critical path); 4 epochs' worth of distinct samples, epochs
+        # reshuffle
+        host = {
+            "x": _rand_images(rng, global_bs * 4, image_size),
+            "y": rng.randint(0, 1000, (global_bs * 4,)).astype(np.int32),
+        }
+        rate, warmup_s, _state, input_fields = run_pipeline_fed(
+            args, step, host, (params, opt_state, None), global_bs,
+            global_bs * spc, "resnet", "resnet_")
+    else:
+        rate, warmup_s, _state = median_rate(
+            lambda s: step(s[0], s[1], batch), (params, opt_state, None),
+            args.num_warmup_batches, args.num_iters,
+            args.num_batches_per_iter,
+            global_bs * spc, "resnet")
     per_chip = rate / n_chips
 
     # MFU: fwd+bwd ≈ 3 × 4.1 GFLOP/img at 224px (scaled for other sizes).
@@ -334,6 +461,7 @@ def run_resnet(args, hvd):
         **warmstart_fields(step, warmup_s, "resnet_"),
         **exchange_report_fields(args, step),
         **overlap,
+        **input_fields,
     }
 
 
@@ -376,6 +504,7 @@ def run_transformer(args, hvd):
         loss_fn, optax.adamw(3e-4),
         steps_per_call=spc,
         compiler_options=tpu_compiler_options(args),
+        donate_batch=args.input_mode == "host",
         **exchange_step_kwargs(args))
     tokens0 = jnp.zeros((1, seq), jnp.int32)
     # jit the init: eager flax init dispatches hundreds of per-op calls,
@@ -397,11 +526,22 @@ def run_transformer(args, hvd):
     # the timed loop — the step donates params on its first call)
     overlap = run_overlap_probe(args, loss_fn, params, batch_data,
                                 "", "transformer")
-    rate, warmup_s, final_state = median_rate(
-        lambda s: step(s[0], s[1], batch_data), (params, opt_state, None),
-        args.num_warmup_batches, args.num_iters,
-        args.num_batches_per_iter,
-        global_bs * seq * spc, "transformer")
+    input_fields = {}
+    if args.input_mode == "host":
+        raw_host = rng.randint(0, cfg.vocab_size,
+                               (global_bs * 8, seq + 1))
+        host = {"inputs": raw_host[:, :-1].astype(np.int32),
+                "labels": raw_host[:, 1:].astype(np.int32)}
+        rate, warmup_s, final_state, input_fields = run_pipeline_fed(
+            args, step, host, (params, opt_state, None), global_bs,
+            global_bs * seq * spc, "transformer", "")
+    else:
+        rate, warmup_s, final_state = median_rate(
+            lambda s: step(s[0], s[1], batch_data),
+            (params, opt_state, None),
+            args.num_warmup_batches, args.num_iters,
+            args.num_batches_per_iter,
+            global_bs * seq * spc, "transformer")
     tokens_per_chip_sec = rate / n_chips
     # checkpoint probe on the live 870.9M-param train state: the
     # acceptance quantity is the async save's train-loop stall vs the
@@ -425,6 +565,7 @@ def run_transformer(args, hvd):
         **ckpt,
         **exchange_report_fields(args, step),
         **overlap,
+        **input_fields,
     }
 
 
@@ -567,30 +708,50 @@ def run_moe(args, hvd):
 
     # auditability of the active-FLOP MFU: dropped tokens do zero
     # expert work but still count full active FLOPs, so the headline
-    # is optimistic by the drop rate — measure and report it
+    # is optimistic by the drop rate — measure and report it, along
+    # with the per-expert routing shares behind it
     @jax.jit
-    def _probe_drops(params, tokens):
+    def _probe_routing(params, tokens):
         _, state0 = model.apply({"params": params}, tokens,
                                 mutable=["intermediates"])
-        # sow tuples flatten away: leaves are the scalar values
-        leaves = [v for path, v in
-                  jax.tree_util.tree_flatten_with_path(
-                      state0["intermediates"])[0]
-                  if any(getattr(p, "key", "") == "moe_drop_fraction"
-                         for p in path)]
-        return jnp.mean(jnp.stack(leaves)) if leaves else jnp.zeros(())
+        # sow tuples flatten away: leaves are the sowed values
+        flat = jax.tree_util.tree_flatten_with_path(
+            state0["intermediates"])[0]
 
-    drop_fraction = float(_probe_drops(
-        variables["params"], jnp.asarray(raw[:batch, :-1], jnp.int32)))
+        def sowed(key):
+            return [v for path, v in flat
+                    if any(getattr(p, "key", "") == key for p in path)]
+
+        drops = sowed("moe_drop_fraction")
+        fracs = sowed("moe_expert_fraction")
+        drop = jnp.mean(jnp.stack(drops)) if drops else jnp.zeros(())
+        util = jnp.mean(jnp.stack(fracs), axis=0) if fracs \
+            else jnp.zeros((experts,))
+        return drop, util
+
+    probe_tokens = jnp.asarray(raw[:batch, :-1], jnp.int32)
+    drop_init, _ = _probe_routing(variables["params"], probe_tokens)
+    drop_init = float(drop_init)
     log(f"bench[moe]: {nparams / 1e6:.1f}M params "
-        f"({active / 1e6:.1f}M active/token), drop fraction "
-        f"{drop_fraction:.3f} at cf {cfg.capacity_factor}")
-    rate, _warmup_s, _state = median_rate(
+        f"({active / 1e6:.1f}M active/token), init drop fraction "
+        f"{drop_init:.3f} at cf {cfg.capacity_factor}")
+    rate, _warmup_s, final_state = median_rate(
         lambda s: step(s[0], s[1], batch_data), (params, opt_state, None),
         args.num_warmup_batches, args.num_iters,
         args.num_batches_per_iter,
         global_bs * seq * spc, "moe")
     tokens_per_chip_sec = rate / n_chips
+    # the honesty fields are measured AFTER the run's warmup+timed
+    # steps trained the router (aux loss pushes toward balance): the
+    # init-state routing the old probe reported (41% of tokens doing
+    # no expert work in BENCH_r05) never describes the steady state
+    # the headline rate was measured in
+    drop_fraction, util = _probe_routing(final_state[0], probe_tokens)
+    drop_fraction = float(drop_fraction)
+    util = [round(float(u), 4) for u in np.asarray(util)]
+    log(f"bench[moe]: warmed routing — drop fraction "
+        f"{drop_fraction:.3f} (init {drop_init:.3f}), per-expert "
+        f"shares {util} (uniform = {1.0 / experts:.3f})")
 
     flops_per_token = 6 * active + 6 * layers * seq * d_model
     peak = hw_peak_flops()
@@ -602,6 +763,9 @@ def run_moe(args, hvd):
         "moe_params_m": round(nparams / 1e6, 1),
         "moe_active_params_m": round(active / 1e6, 1),
         "moe_drop_fraction": round(drop_fraction, 4),
+        "moe_drop_fraction_init": round(drop_init, 4),
+        "moe_expert_utilization": util,
+        "moe_expert_util_min": min(util) if util else None,
     }
 
 
@@ -697,6 +861,21 @@ def main():
                         "per-call launch overhead.  40 = the offline "
                         "autotuner's cold-start pick, confirmed by "
                         "full-length A/B on both models (round 5)")
+    p.add_argument("--input-mode", default="synthetic",
+                   choices=["synthetic", "host"],
+                   help="synthetic: one resident device batch reused "
+                        "every step (pure compute envelope).  host: "
+                        "the pipeline-fed path — host batches stream "
+                        "through ShardedDataset -> PrefetchIterator "
+                        "(background assembly, double-buffered H2D "
+                        "onto the step's sharding, donated input "
+                        "slot) and the BENCH JSON gains "
+                        "input_stall_s / input_stall_sync_s / "
+                        "prefetch_depth / h2d_overlap_fraction "
+                        "(docs/data.md)")
+    p.add_argument("--prefetch-depth", type=int, default=None,
+                   help="input-pipeline queue bound for --input-mode "
+                        "host (default: HOROVOD_PREFETCH_DEPTH, else 2)")
     p.add_argument("--no-compiler-options", action="store_true",
                    help="disable the default TPU XLA compile options")
     p.add_argument("--no-overlap-probe", action="store_true",

@@ -279,6 +279,7 @@ from horovod_tpu.optim import (  # noqa: E402
 )
 from horovod_tpu import callbacks  # noqa: E402,F401
 from horovod_tpu import checkpoint  # noqa: E402,F401
+from horovod_tpu import data  # noqa: E402,F401
 from horovod_tpu import elastic  # noqa: E402,F401
 
 __all__ = [
@@ -306,6 +307,6 @@ __all__ = [
     "DistributedOptimizer", "DistributedAdasumOptimizer",
     "DistributedGradientTape", "DistributedTrainStep",
     "SyncBatchNorm",
-    # callbacks + checkpoint + elastic
-    "callbacks", "checkpoint", "elastic",
+    # callbacks + checkpoint + data pipeline + elastic
+    "callbacks", "checkpoint", "data", "elastic",
 ]

@@ -1,0 +1,44 @@
+"""Streaming, shard-aware input pipeline (docs/data.md).
+
+The layer between a dataset and :class:`DistributedTrainStep`:
+
+* :class:`ShardedDataset` — each rank reads a disjoint 1/N of a
+  deterministic per-epoch order (no full-copy-per-worker), position is
+  world-size independent for elastic resume;
+* :class:`PrefetchIterator` — host batch assembly + eager device
+  placement on background threads with a bounded queue, so batch
+  ``k+1``'s H2D transfer overlaps batch ``k``'s compute;
+* :class:`ArraySource` / :class:`ParquetSource` — random-access
+  sources over in-memory pytrees and store parquet (row-group pruned
+  range reads);
+* :func:`broadcast_seed` — one shuffle seed for all processes;
+* :func:`close_all_pipelines` — elastic ``_reset``'s teardown hook.
+
+Knobs: ``HOROVOD_PREFETCH_DEPTH`` (queue bound, default 2) and
+``HOROVOD_INPUT_THREADS`` (assembly pool, default 2) — see
+docs/running.md.
+"""
+
+from horovod_tpu.data.prefetch import (
+    PrefetchIterator,
+    close_all as close_all_pipelines,
+    default_input_threads,
+    default_prefetch_depth,
+)
+from horovod_tpu.data.sharded import (
+    ArraySource,
+    ParquetSource,
+    ShardedDataset,
+    broadcast_seed,
+)
+
+__all__ = [
+    "ArraySource",
+    "ParquetSource",
+    "PrefetchIterator",
+    "ShardedDataset",
+    "broadcast_seed",
+    "close_all_pipelines",
+    "default_input_threads",
+    "default_prefetch_depth",
+]

@@ -304,6 +304,19 @@ def _reset() -> None:
     from horovod_tpu.ops import eager
     from horovod_tpu.runtime import state as rt_state
 
+    # input pipelines first: their queues hold device batches pinning
+    # buffers (and threads issuing device_puts) against the OLD world's
+    # backend — they must drain before the client is torn down.  The
+    # training fn rebuilds its feed after reset, re-seeded at the
+    # restored (epoch, sample position): ShardedDataset positions are
+    # world-size independent, so the resharded dataset replays nothing
+    # (docs/data.md "Elastic resume").
+    from horovod_tpu import data as hvd_data
+
+    n_closed = hvd_data.close_all_pipelines()
+    if n_closed:
+        hvd_logging.info(
+            "elastic: closed %d input pipeline(s) for reset", n_closed)
     rt_state.shutdown()
     # under an elastic launcher: pull the new rank/size/coordinator from
     # the driver's rendezvous before re-initializing

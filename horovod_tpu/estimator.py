@@ -561,6 +561,8 @@ class Estimator(HasParams):
         cbs = CallbackList(self._callbacks)
         cbs.on_train_begin(loop)
 
+        from horovod_tpu.data import PrefetchIterator
+
         global_bs = self.batch_size * hvd.size()
         nbatches = max(len(y) // global_bs, 1)
         rng = np.random.RandomState(self.seed)
@@ -568,21 +570,34 @@ class Estimator(HasParams):
         for epoch in range(self.epochs):
             cbs.on_epoch_begin(epoch, loop, logs)
             perm = rng.permutation(len(y))
-            for b in range(nbatches):
-                cbs.on_batch_begin(b, loop, logs)
-                idx = perm[b * global_bs:(b + 1) * global_bs]
-                if len(idx) < global_bs:   # pad the ragged tail batch
-                    # np.resize cycles perm, so even len(x) < global_bs/2
-                    # still yields a full, device-divisible batch
-                    idx = np.concatenate(
-                        [idx, np.resize(perm, global_bs - len(idx))])
-                # host arrays go straight in: shard_batch feeds each
-                # process's addressable shards from the numpy buffers
-                batch = step.shard_batch({"x": take(x, idx),
-                                          "y": y[idx]})
-                loop.params, loop.opt_state, train_loss = step(
-                    loop.params, loop.opt_state, batch)
-                cbs.on_batch_end(b, loop, logs)
+
+            def host_batches(perm=perm):
+                for b in range(nbatches):
+                    idx = perm[b * global_bs:(b + 1) * global_bs]
+                    if len(idx) < global_bs:   # pad the ragged tail
+                        # np.resize cycles perm, so even
+                        # len(x) < global_bs/2 still yields a full,
+                        # device-divisible batch
+                        idx = np.concatenate(
+                            [idx, np.resize(perm, global_bs - len(idx))])
+                    yield {"x": take(x, idx), "y": y[idx]}
+
+            # gather + device placement run ahead on the prefetcher's
+            # threads (shard_batch feeds each process's addressable
+            # shards straight from the numpy buffers), so batch k+1's
+            # assembly and H2D overlap batch k's compute instead of
+            # sitting between steps
+            feed = PrefetchIterator(host_batches(),
+                                    place=step.shard_batch,
+                                    name="estimator")
+            try:
+                for b, batch in enumerate(feed):
+                    cbs.on_batch_begin(b, loop, logs)
+                    loop.params, loop.opt_state, train_loss = step(
+                        loop.params, loop.opt_state, batch)
+                    cbs.on_batch_end(b, loop, logs)
+            finally:
+                feed.close()
             logs["loss"] = float(train_loss)
             if n_val:
                 logs["val_loss"] = float(loss_fn(
@@ -783,17 +798,29 @@ class Estimator(HasParams):
         # once, not per epoch
         val_reader = RowGroupReader(val_path) if val_path else None
         rng = np.random.RandomState(self.seed + rank * 10007)
+        from horovod_tpu.data import PrefetchIterator
+
         logs: dict = {}
         for epoch in range(self.epochs):
             cbs.on_epoch_begin(epoch, loop, logs)
-            for b, (bx, by) in enumerate(self._shard_batches(
+            # row-group reads, feature assembly and the per-process
+            # device placement all run ahead on the prefetcher (one
+            # feeder thread owns the reader+rng, so batch order is the
+            # synchronous order); the step only ever waits when the
+            # host can't keep up, not once per batch by construction
+            feed = PrefetchIterator(
+                ({"x": bx, "y": by} for bx, by in self._shard_batches(
                     reader, my_groups, feature_specs, label_spec,
-                    local_bs, nbatches, rng)):
-                cbs.on_batch_begin(b, loop, logs)
-                batch = step.shard_local_batch({"x": bx, "y": by})
-                loop.params, loop.opt_state, train_loss = step(
-                    loop.params, loop.opt_state, batch)
-                cbs.on_batch_end(b, loop, logs)
+                    local_bs, nbatches, rng)),
+                place=step.shard_local_batch, name="estimator-stream")
+            try:
+                for b, batch in enumerate(feed):
+                    cbs.on_batch_begin(b, loop, logs)
+                    loop.params, loop.opt_state, train_loss = step(
+                        loop.params, loop.opt_state, batch)
+                    cbs.on_batch_end(b, loop, logs)
+            finally:
+                feed.close()
             logs["loss"] = float(train_loss)
             if val_reader is not None:
                 logs["val_loss"] = self._streamed_val_loss(

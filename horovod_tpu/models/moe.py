@@ -73,8 +73,10 @@ class SwitchFFN(nn.Module):
     """Top-1-routed expert FFN (gelu MLP experts).
 
     ``(B, T, D) -> (B, T, D)``; sows ``moe_aux_loss`` (Switch aux:
-    ``E * sum_e fraction_e * prob_e``, minimized at uniform routing)
-    and ``moe_drop_fraction`` under ``intermediates``.
+    ``E * sum_e fraction_e * prob_e``, minimized at uniform routing),
+    ``moe_expert_fraction`` (per-expert routed-token share, the
+    utilization vector) and ``moe_drop_fraction`` under
+    ``intermediates``.
     """
 
     cfg: MoEConfig
@@ -100,6 +102,12 @@ class SwitchFFN(nn.Module):
                                 dtype=jnp.float32)
         aux = e * jnp.sum(chosen.mean(0) * probs.mean(0))
         self.sow("intermediates", "moe_aux_loss", aux)
+        # per-expert routing share (router view, both modes): the
+        # fraction of tokens argmax-assigned to each expert BEFORE
+        # capacity drops — uniform is 1/E; the bench emits this so an
+        # imbalanced router (and the drops it causes) is visible in
+        # the artifact instead of silently inflating active-FLOP MFU
+        self.sow("intermediates", "moe_expert_fraction", chosen.mean(0))
 
         w1c = w1.astype(cfg.dtype)
         w2c = w2.astype(cfg.dtype)

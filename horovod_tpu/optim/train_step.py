@@ -71,6 +71,7 @@ class DistributedTrainStep:
                  remat: bool = False,
                  data_axes: AxisSpec = GLOBAL_AXES,
                  donate: bool = True,
+                 donate_batch: bool = False,
                  steps_per_call: int = 1,
                  compiler_options: Optional[dict] = None,
                  sparse_params: Optional[dict] = None,
@@ -106,6 +107,17 @@ class DistributedTrainStep:
         with backward.  ``exchange_bucket_bytes`` splits the exchange
         into reverse-layer-order buckets for earlier overlap (measured
         by ``utils/overlap_probe.py``).
+
+        ``donate_batch=True`` adds the batch to the donated argument
+        set — the input slot of a *pipeline-fed* step
+        (:mod:`horovod_tpu.data`): every call receives a fresh batch
+        whose device buffers nothing else references, so the caller may
+        hand over ownership and XLA is free to alias the buffers into
+        outputs instead of holding live input and results side by side
+        (when no output matches, it logs the unused donation and runs
+        normally).  Leave it off when a batch is reused across calls
+        (the synthetic-bench pattern) — donation invalidates the
+        caller's arrays after the call.
 
         ``hierarchy`` picks the sharded exchange's topology:
         ``"auto"`` (default) resolves against the data-axes
@@ -179,6 +191,11 @@ class DistributedTrainStep:
         self._steps_per_call = int(steps_per_call)
         self._compiler_options = dict(compiler_options) \
             if compiler_options is not None else None
+        self._donate_batch = bool(donate_batch)
+        # the donated argument set: (params, opt_state) in-place in HBM,
+        # plus the batch slot when the feed guarantees fresh buffers
+        donated = ((0, 1) if donate else ()) + \
+            ((2,) if donate_batch else ())
 
         repl = NamedSharding(self._mesh, P())
         batch_sharding = NamedSharding(self._mesh, P(self._data_axes))
@@ -245,13 +262,13 @@ class DistributedTrainStep:
                 self._step = jax.jit(
                     multi(step),
                     in_shardings=(None, None, batch_sharding),
-                    donate_argnums=(0, 1) if donate else ())
+                    donate_argnums=donated)
             else:
                 self._step = jax.jit(
                     multi(step),
                     in_shardings=(repl, repl, batch_sharding),
                     out_shardings=(repl, repl, repl),
-                    donate_argnums=(0, 1) if donate else ())
+                    donate_argnums=donated)
         elif mode == "shard_map":
             shard_map = jax.shard_map
 
@@ -325,7 +342,7 @@ class DistributedTrainStep:
                 out_specs=(P(), P(), P()),
                 check_vma=False)
             self._step = jax.jit(
-                multi(smapped), donate_argnums=(0, 1) if donate else ())
+                multi(smapped), donate_argnums=donated)
         else:
             raise ValueError(f"unknown mode {mode!r}")
 
@@ -350,6 +367,19 @@ class DistributedTrainStep:
         self._last_cache_hit: Optional[bool] = None
 
     _COMPILED_CACHE_MAX = 16
+
+    @property
+    def batch_sharding(self):
+        """The ``NamedSharding`` this step expects its batch in — what
+        an input pipeline's ``place`` callable targets when it issues
+        ``jax.device_put`` ahead of the step (docs/data.md)."""
+        return self._batch_sharding
+
+    @property
+    def donates_batch(self) -> bool:
+        """Whether the batch argument is donated (the pipeline-fed
+        input slot; each call must then receive fresh buffers)."""
+        return self._donate_batch
 
     @property
     def exchange_hierarchy(self):
@@ -379,6 +409,7 @@ class DistributedTrainStep:
             "data_axes": self._data_axes,
             "fsdp_axis": self._fsdp_axis,
             "steps_per_call": self._steps_per_call,
+            "donate_batch": self._donate_batch,
         }
 
     def init(self, params):

@@ -88,6 +88,11 @@ class Config:
     compile_cache_enabled: bool = True
     compile_cache_dir: Optional[str] = None   # None → ~/.cache/horovod_tpu
 
+    # -- input pipeline (horovod_tpu/data): prefetch queue bound and
+    # host-side batch-assembly thread count (docs/data.md tuning notes)
+    prefetch_depth: int = 2
+    input_threads: int = 2
+
     # -- hierarchical collectives (ici/dcn mesh split)
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
@@ -175,6 +180,8 @@ class Config:
             cache_capacity=_env_int("HOROVOD_CACHE_CAPACITY", 1024),
             compile_cache_enabled=_env_bool("HOROVOD_COMPILE_CACHE", True),
             compile_cache_dir=os.environ.get("HOROVOD_COMPILE_CACHE_DIR"),
+            prefetch_depth=_env_int("HOROVOD_PREFETCH_DEPTH", 2),
+            input_threads=_env_int("HOROVOD_INPUT_THREADS", 2),
             hierarchical_allreduce=_env_bool(
                 "HOROVOD_HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=_env_bool(

@@ -238,9 +238,23 @@ class FilesystemStore(Store):
         not be a shared mount (the driver cannot tell), so it keeps the
         driver-side write; call :meth:`prepare_data_distributed`
         explicitly when the path is cluster-visible.
+
+        Validation-split semantics (the two paths differ, by
+        construction): the driver-side path holds out the GLOBAL tail
+        ``validation_fraction`` of the DataFrame's rows — one split
+        point over the whole ordered dataset — while the executor-side
+        path holds out each PARTITION's tail (the driver never sees the
+        rows, so a global split point does not exist there); membership
+        and row order of the two splits therefore differ for the same
+        call.  To keep ``prepare_data`` deterministic in what it means,
+        a pyspark frame with ``validation_fraction > 0`` stays on the
+        driver-side (global-tail) path even when the store is
+        executor-reachable; per-partition-tail splitting is an explicit
+        opt-in via :meth:`prepare_data_distributed`.
         """
         if type(df).__module__.split(".", 1)[0] == "pyspark" and \
-                hasattr(df, "rdd") and self._executor_reachable():
+                hasattr(df, "rdd") and self._executor_reachable() and \
+                not validation_fraction:
             return self._prepare_from_rdd(
                 df.rdd, feature_cols, label_col, validation_fraction,
                 rows_per_group, idx)
@@ -298,6 +312,12 @@ class FilesystemStore(Store):
         ``df.rdd.map(to_petastorm).toDF()`` distributed parquet write);
         the driver never holds more than one partition's *metadata*, so
         dataset size is bounded by executor memory, not driver memory.
+
+        ``validation_fraction`` here splits each PARTITION's tail — not
+        the global tail :meth:`prepare_data` takes — because no single
+        process ever orders the full dataset.  Same fraction of rows
+        held out overall (up to per-partition rounding), different
+        membership; see the semantics note on :meth:`prepare_data`.
 
         ``sc`` is any executor context exposing the ``run()`` RDD slice
         (pyspark ``SparkContext`` or
@@ -529,17 +549,47 @@ class FilesystemStore(Store):
                            row_group_size=rows_per_group or len(df) or 1)
         return shapes
 
-    def read_dataframe(self, path: str):
+    def read_dataframe(self, path: str, row_range=None):
+        """Materialize a store data dir as pandas.  ``row_range=(start,
+        stop)`` reads ONLY the parquet row groups overlapping that
+        global row interval (footer-pruned through the store's IO
+        primitives, so remote stores transfer just those pages too) and
+        slices to the exact rows — the shard/range read a worker uses
+        to fetch its 1/N instead of the full dataset
+        (:class:`RowGroupReader` is the richer local-file API)."""
         import pandas as pd
         import pyarrow.parquet as pq
 
-        frames = []
-        for part in sorted(p for p in self._listdir(path)
-                           if str(p).endswith(".parquet")):
-            with self._open(part, "rb") as f:
-                frames.append(pq.read_table(f).to_pandas())
-        if not frames:
+        parts = sorted(p for p in self._listdir(path)
+                       if str(p).endswith(".parquet"))
+        if not parts:
             raise FileNotFoundError(f"no parquet files under {path}")
+        frames = []
+        if row_range is None:
+            for part in parts:
+                with self._open(part, "rb") as f:
+                    frames.append(pq.read_table(f).to_pandas())
+        else:
+            start, stop = (int(row_range[0]), int(row_range[1]))
+            if start < 0 or stop < start:
+                raise ValueError(f"bad row_range {row_range!r}")
+            pos = 0
+            for part in parts:
+                with self._open(part, "rb") as f:
+                    pf = pq.ParquetFile(f)
+                    for g in range(pf.metadata.num_row_groups):
+                        n = pf.metadata.row_group(g).num_rows
+                        glo, ghi = pos, pos + n
+                        pos = ghi
+                        if ghi <= start or glo >= stop:
+                            continue
+                        gdf = pf.read_row_group(g).to_pandas()
+                        frames.append(gdf.iloc[max(start - glo, 0):
+                                               min(stop, ghi) - glo])
+            if not frames:
+                raise ValueError(
+                    f"row_range {row_range!r} selects no rows of the "
+                    f"{pos}-row dataset at {path!r}")
         df = pd.concat(frames, ignore_index=True)
         meta_path = path.rstrip("/") + "/_meta.json"
         if df is not None and self.exists(meta_path):
@@ -557,9 +607,13 @@ class RowGroupReader:
     trains from per-worker parquet shard streams; schema machinery in
     ``spark/common/util.py:697``): parquet row groups are the unit of
     sharding and of IO, so a worker touches only its own groups and holds
-    at most one group in memory at a time.  ``groups_read`` records every
-    group index actually materialized — the read-accounting hook the
-    sharding tests assert on.
+    at most one group in memory at a time.  ``groups_read`` /
+    ``rows_materialized`` record what was actually read off disk — the
+    accounting hooks the sharding tests assert on.  Beyond the classic
+    round-robin :meth:`shard_groups`, the range API —
+    :meth:`shard_range` / :meth:`read_rows` / :meth:`take` — serves
+    index-range shards and shuffled gathers with group-pruned IO (what
+    :class:`horovod_tpu.data.ShardedDataset` drives).
     """
 
     def __init__(self, path: str):
@@ -584,10 +638,22 @@ class RowGroupReader:
                 self._groups.append(
                     (pf, g, pf.metadata.row_group(g).num_rows))
         self.groups_read: List[int] = []
+        # rows actually materialized off disk — the no-full-copy
+        # accounting (a 1/N shard reader must stay near num_rows/N)
+        self.rows_materialized = 0
+        # cumulative row offsets: group g spans [offsets[g], offsets[g+1])
+        self._offsets = np.concatenate(
+            [[0], np.cumsum([n for _, _, n in self._groups])]).astype(
+            np.int64)
 
     @property
     def num_row_groups(self) -> int:
         return len(self._groups)
+
+    @property
+    def num_rows(self) -> int:
+        """Total rows across every part/group (footer metadata only)."""
+        return int(self._offsets[-1])
 
     @property
     def group_rows(self) -> List[int]:
@@ -600,16 +666,76 @@ class RowGroupReader:
         ``p, p+n, p+2n, …`` (petastorm ``cur_shard``/``shard_count``)."""
         return list(range(shard, self.num_row_groups, num_shards))
 
+    def shard_range(self, shard: int, num_shards: int):
+        """Contiguous row-range assignment ``[lo, hi)``: shard ``p`` of
+        ``n`` owns rows ``[p*⌊N/n⌋, (p+1)*⌊N/n⌋)`` — equal-size shards,
+        remainder dropped (the input plane's zero-tail invariant: every
+        shard identical in size, no ragged tail).  The unit a
+        :class:`~horovod_tpu.data.ShardedDataset` maps onto range
+        reads."""
+        per = self.num_rows // num_shards
+        return shard * per, (shard + 1) * per
+
     def read_group(self, index: int):
         """Materialize one row group as a pandas DataFrame (tensor cells
         reshaped from ``_meta.json``)."""
-        pf, local, _ = self._groups[index]
+        pf, local, nrows = self._groups[index]
         self.groups_read.append(index)
+        self.rows_materialized += nrows
         df = pf.read_row_group(local).to_pandas()
         for c, shape in self._shapes.items():
             if c in df.columns:
                 df[c] = [np.asarray(v).reshape(shape) for v in df[c]]
         return df
+
+    def read_rows(self, start: int, stop: int):
+        """Rows ``[start, stop)`` as one DataFrame, touching only the
+        row groups overlapping the range (range read: IO cost scales
+        with the slice, not the dataset)."""
+        import pandas as pd
+
+        if not 0 <= start <= stop <= self.num_rows:
+            raise ValueError(
+                f"row range [{start}, {stop}) outside the "
+                f"{self.num_rows}-row dataset")
+        if start == stop:
+            raise ValueError("empty row range")
+        g_lo = int(np.searchsorted(self._offsets, start, side="right")) - 1
+        g_hi = int(np.searchsorted(self._offsets, stop, side="left"))
+        frames = []
+        for g in range(g_lo, g_hi):
+            df = self.read_group(g)
+            lo = max(start - int(self._offsets[g]), 0)
+            hi = min(stop, int(self._offsets[g + 1])) - int(
+                self._offsets[g])
+            frames.append(df.iloc[lo:hi])
+        return pd.concat(frames, ignore_index=True) if len(frames) > 1 \
+            else frames[0].reset_index(drop=True)
+
+    def take(self, indices):
+        """Arbitrary global rows, in the requested order, each needed
+        group read once (the shuffled-shard gather: a rank fetching its
+        permuted 1/N touches ~1/N of the groups, never the rest)."""
+        import pandas as pd
+
+        idx = np.asarray(indices, dtype=np.int64)
+        if idx.size == 0:
+            raise ValueError("take() of no indices")
+        if idx.min() < 0 or idx.max() >= self.num_rows:
+            raise IndexError(
+                f"row indices outside [0, {self.num_rows})")
+        gids = np.searchsorted(self._offsets, idx, side="right") - 1
+        frames, base, off = {}, {}, 0
+        for g in np.unique(gids):
+            frames[int(g)] = self.read_group(int(g))
+            base[int(g)] = off
+            off += len(frames[int(g)])
+        cat = pd.concat([frames[g] for g in sorted(frames)],
+                        ignore_index=True) if len(frames) > 1 \
+            else next(iter(frames.values()))
+        pos = np.asarray([base[int(g)] + int(i) - int(self._offsets[g])
+                          for g, i in zip(gids, idx)])
+        return cat.iloc[pos].reset_index(drop=True)
 
 
 class LocalStore(FilesystemStore):

