@@ -769,6 +769,137 @@ def run_moe(args, hvd):
     }
 
 
+def run_chaos(args, hvd):
+    """``--chaos``: the seeded fault-injection probe (docs/faults.md).
+
+    Exercises the detect→decide→recover loop with real components and
+    deterministic faults, and emits the robustness contract numbers
+    into BENCH JSON:
+
+    * ``detect_s`` — a worker heartbeats, then hangs (beats stop, the
+      process never exits); a real ``HealthMonitor`` on a fake clock
+      declares it dead.  Detection latency is the silence span at
+      declaration — deterministic by construction.
+    * ``recovery_s`` / ``steps_lost`` — a seeded ``FaultPlan`` crashes
+      a real ``TpuState`` + async-``Checkpointer`` training loop at
+      step k; a cold state restores from the last durable checkpoint
+      and finishes the run.  ``steps_lost`` is the commits between the
+      last durable step and the crash — bounded by
+      ``--chaos-checkpoint-every`` by construction.
+    * ``chaos_deterministic`` — the whole scenario runs twice from
+      scratch; crash point, restored step and the full loss trajectory
+      must match exactly.
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from horovod_tpu import faults
+    from horovod_tpu.elastic.health import HealthMonitor
+
+    seed = args.chaos_seed
+    k = args.chaos_crash_step
+    every = args.chaos_checkpoint_every
+    steps = args.chaos_steps
+    if not 1 <= k <= steps:
+        raise SystemExit(f"--chaos-crash-step must be in [1, "
+                         f"--chaos-steps], got {k} vs {steps}")
+
+    # -- hang detection: heartbeats stop, the "process" stays alive ------
+    declared = []
+    now = [0.0]
+    mon = HealthMonitor(
+        lambda h, lr, d, r: declared.append((h, lr, d, r)),
+        interval_s=1.0, suspect_misses=2, dead_s=5.0,
+        clock=lambda: now[0], start_thread=False)
+    for t in range(4):               # healthy beats at t = 0..3
+        now[0] = float(t)
+        mon.record_heartbeat("chaos-worker", 0, step=t)
+    while not declared:              # silence from t = 3 on
+        now[0] += 1.0
+        mon.check()
+    detect_s = declared[0][2]
+    log(f"bench[chaos]: hang declared dead after detect_s={detect_s:.1f} "
+        f"(reason: {declared[0][3]}; worker process never exited)")
+
+    # -- seeded crash at step k + cold recovery --------------------------
+    def lr_step(params, batch):
+        return {"w": params["w"] - 0.1 * (params["w"] - batch)}
+
+    def trajectory(root):
+        rng = np.random.RandomState(seed)
+        data = rng.rand(steps, 4).astype(np.float32)
+        plan = faults.FaultPlan(seed=seed, sim=True).add(
+            "worker.commit", "crash", at=k)
+        faults.set_plan(plan)
+        ckpt = hvd.checkpoint.Checkpointer(root, use_orbax=False)
+        state = hvd.elastic.TpuState(
+            params={"w": np.full((4,), 2.0, np.float32)},
+            checkpointer=ckpt, checkpoint_every=every)
+        losses = []
+        crashed_at = None
+        try:
+            while state._commit_count < steps:
+                state.params = lr_step(state.params,
+                                       data[state._commit_count])
+                state.commit()
+                losses.append(round(float(np.sum(state.params["w"])), 6))
+        except faults.WorkerCrash:
+            crashed_at = state._commit_count + 1   # commit k never landed
+        finally:
+            faults.clear_plan()
+        state.wait()
+        completed = state._commit_count
+        t0 = time.perf_counter()
+        cold = hvd.elastic.TpuState(
+            params={"w": np.zeros((4,), np.float32)},
+            checkpointer=ckpt, checkpoint_every=every)
+        restored = cold.restore_from_checkpoint()
+        recovery_s = time.perf_counter() - t0
+        if not restored:
+            raise RuntimeError("chaos probe: no durable checkpoint to "
+                               "recover from")
+        resumed_step = cold._commit_count
+        steps_lost = completed - resumed_step
+        while cold._commit_count < steps:
+            cold.params = lr_step(cold.params, data[cold._commit_count])
+            cold.commit()
+            losses.append(round(float(np.sum(cold.params["w"])), 6))
+        cold.wait()
+        return {"crashed_at": crashed_at, "resumed_step": resumed_step,
+                "steps_lost": steps_lost, "recovery_s": recovery_s,
+                "losses": losses}
+
+    root = tempfile.mkdtemp(prefix="bench_chaos_")
+    try:
+        r1 = trajectory(os.path.join(root, "run1"))
+        r2 = trajectory(os.path.join(root, "run2"))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    deterministic = (
+        r1["crashed_at"] == r2["crashed_at"]
+        and r1["resumed_step"] == r2["resumed_step"]
+        and r1["losses"] == r2["losses"])
+    log(f"bench[chaos]: crash at step {r1['crashed_at']}, resumed from "
+        f"durable step {r1['resumed_step']} in "
+        f"recovery_s={r1['recovery_s']:.3f} "
+        f"(steps_lost={r1['steps_lost']} <= checkpoint_every={every}); "
+        f"two-run determinism: {deterministic}")
+    return {
+        "metric": "chaos_probe",
+        "chaos_seed": seed,
+        "chaos_steps": steps,
+        "chaos_crash_step": k,
+        "chaos_checkpoint_every": every,
+        "detect_s": round(detect_s, 3),
+        "recovery_s": round(r1["recovery_s"], 4),
+        "steps_lost": r1["steps_lost"],
+        "chaos_resumed_step": r1["resumed_step"],
+        "chaos_deterministic": deterministic,
+    }
+
+
 def run_autotune(args, hvd):
     """``--autotune``: tune the jit-path knobs that set the BENCH
     numbers (steps_per_call, flash block) against the measured rate —
@@ -949,6 +1080,22 @@ def main():
     p.add_argument("--tf-flash-block", type=int, default=512,
                    help="flash-attention q/k block size (512 = round-4 "
                         "measured winner)")
+    p.add_argument("--chaos", action="store_true",
+                   help="run the seeded fault-injection probe instead "
+                        "of the throughput bench: heartbeat hang "
+                        "detection (detect_s), crash-at-step-k recovery "
+                        "from the last durable checkpoint (recovery_s, "
+                        "steps_lost) and a two-run determinism check "
+                        "(docs/faults.md)")
+    p.add_argument("--chaos-steps", type=int, default=12,
+                   help="total training commits in the chaos scenario")
+    p.add_argument("--chaos-crash-step", type=int, default=7,
+                   help="commit at which the injected crash fires")
+    p.add_argument("--chaos-checkpoint-every", type=int, default=2,
+                   help="durable-checkpoint cadence; steps_lost is "
+                        "bounded by this")
+    p.add_argument("--chaos-seed", type=int, default=42,
+                   help="FaultPlan / data seed for the chaos scenario")
     p.add_argument("--autotune", action="store_true",
                    help="tune the jit-path throughput knobs "
                         "(steps_per_call; flash block for the "
@@ -978,6 +1125,9 @@ def main():
     import horovod_tpu as hvd
 
     hvd.init()
+    if args.chaos:
+        emit(run_chaos(args, hvd), args.json_out)
+        return
     if args.autotune:
         emit(run_autotune(args, hvd), args.json_out)
         return

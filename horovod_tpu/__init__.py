@@ -281,6 +281,7 @@ from horovod_tpu import callbacks  # noqa: E402,F401
 from horovod_tpu import checkpoint  # noqa: E402,F401
 from horovod_tpu import data  # noqa: E402,F401
 from horovod_tpu import elastic  # noqa: E402,F401
+from horovod_tpu import faults  # noqa: E402,F401
 
 __all__ = [
     # basics

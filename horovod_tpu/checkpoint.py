@@ -53,6 +53,7 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
+from horovod_tpu import faults
 from horovod_tpu import functions as F
 from horovod_tpu.utils import logging as hvd_logging
 
@@ -80,6 +81,16 @@ def _host_copy(state: Any) -> Any:
         return x
 
     return jax.tree_util.tree_map(_leaf, state)
+
+
+def _io_retry():
+    """Writer-thread retry policy for transient storage errors (NFS
+    hiccups, momentary ENOSPC): short exponential backoff under the
+    unified ``HOROVOD_RETRY_*`` knobs, OSError only — a pickling error
+    is a bug and must surface on the first attempt."""
+    from horovod_tpu.runtime.retry import RetryPolicy
+
+    return RetryPolicy(retry_on=(OSError,), name="checkpoint-io")
 
 
 def _atomic_write(path: str, payload: Any) -> None:
@@ -166,14 +177,34 @@ class Checkpointer:
         """Barrier: block until the pending background write (if any)
         is durable; re-raise any error it hit.  ``save()`` runs this
         first, so callers that never touch ``wait()`` still get the
-        one-outstanding-write guarantee."""
+        one-outstanding-write guarantee.
+
+        A writer error is STICKY: every subsequent ``save()``/
+        ``wait()``/``close()`` re-raises it until :meth:`clear_error`
+        acknowledges it — a lost checkpoint must not be discoverable
+        only by the one caller that happened to hit the barrier first
+        (and silently absorbed by everyone after)."""
         w = self._writer
         if w is not None:
             w.join()
             self._writer = None
         if self._writer_error is not None:
-            err, self._writer_error = self._writer_error, None
-            raise err
+            raise self._writer_error
+
+    def clear_error(self) -> Optional[BaseException]:
+        """Acknowledge (and return) the sticky writer error, unblocking
+        further saves — the caller has decided how to proceed (retry
+        the save, fail over to another directory, abort)."""
+        err, self._writer_error = self._writer_error, None
+        return err
+
+    def close(self) -> None:
+        """Final barrier: join any pending write and surface its error.
+        A process that saves last and exits without ``wait()`` would
+        otherwise swallow a failed final checkpoint (the non-daemon
+        writer thread completes at interpreter shutdown, but nobody
+        reads its error)."""
+        self.wait()
 
     def _dispatch(self, fn) -> None:
         """Run ``fn`` on the writer thread (async) or inline (sync)."""
@@ -181,6 +212,7 @@ class Checkpointer:
         def run():
             t0 = time.perf_counter()
             try:
+                faults.inject("checkpoint.write")   # chaos hook
                 fn()
             except BaseException as e:  # noqa: BLE001 — surfaced at wait()
                 self._writer_error = e
@@ -190,6 +222,8 @@ class Checkpointer:
         if not self._async:
             run()
             if self._writer_error is not None:
+                # synchronous surfacing: the caller sees the error right
+                # here, so it is consumed rather than left sticky
                 err, self._writer_error = self._writer_error, None
                 raise err
             return
@@ -227,7 +261,9 @@ class Checkpointer:
             def write():
                 path = os.path.join(self._dir, f"step_{step}")
                 os.makedirs(path, exist_ok=True)
-                _atomic_write(os.path.join(path, "state.pkl"), host_state)
+                _io_retry().call(_atomic_write,
+                                 os.path.join(path, "state.pkl"),
+                                 host_state)
                 self._gc()
                 hvd_logging.info("checkpoint: saved step %d to %s",
                                  step, self._dir)
@@ -257,7 +293,8 @@ class Checkpointer:
         def write():
             path = os.path.join(self._dir, f"step_{step}")
             os.makedirs(path, exist_ok=True)
-            _atomic_write(
+            _io_retry().call(
+                _atomic_write,
                 os.path.join(path, _shard_name(shard_rank, shard_count)),
                 {"shard_rank": shard_rank, "shard_count": shard_count,
                  "state": host_state})

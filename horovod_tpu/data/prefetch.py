@@ -47,6 +47,7 @@ import weakref
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterable, Optional
 
+from horovod_tpu import faults
 from horovod_tpu.runtime.config import _env_int
 
 _LIVE: "weakref.WeakSet[PrefetchIterator]" = weakref.WeakSet()
@@ -151,6 +152,10 @@ class PrefetchIterator:
     def _feed(self) -> None:
         try:
             while not self._stop.is_set():
+                # chaos hook: a raise here surfaces at next() via the
+                # _End sentinel (the documented worker-exception path);
+                # a delay models a slow source
+                faults.inject("data.feed")
                 try:
                     item = next(self._source)
                 except StopIteration:

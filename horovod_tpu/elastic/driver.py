@@ -17,12 +17,15 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from horovod_tpu import faults
 from horovod_tpu.elastic.discovery import HostManager, HostUpdateResult
+from horovod_tpu.elastic.health import HealthMonitor
 from horovod_tpu.elastic.registration import WorkerStateRegistry
 from horovod_tpu.runner.hosts import HostInfo, SlotInfo, get_host_assignments
 from horovod_tpu.runner.network import (
     AckResponse,
     BasicService,
+    HeartbeatRequest,
     RegisterWorkerRequest,
     WorkerReadyRequest,
     notify_hosts_updated,
@@ -83,6 +86,15 @@ class ElasticDriver:
         self._generation_started: float = time.monotonic()
         self._generation_ready_logged = -1
         self.last_recovery_s: Optional[float] = None
+        # heartbeat health plane: workers beat over the driver RPC
+        # channel; the monitor declares a silent worker dead (and a
+        # beating-but-stuck one hung) BEFORE its process exit is
+        # observed, so regeneration starts detect_s after the failure
+        # instead of whenever the launcher thread notices the exit
+        self._health = HealthMonitor.from_env(self._on_worker_dead)
+        self.last_detect_s: Optional[float] = None
+        self.last_detect_reason: Optional[str] = None
+        self._worker_fn_takes_abort = True
         self._coordinator_addr = ""
         # Driver-hosted per-generation coordination services.  Old
         # generations are retired, NOT shut down, until job completion: a
@@ -125,10 +137,18 @@ class ElasticDriver:
         with self._lock:
             return self._generation
 
+    @property
+    def health_monitor(self) -> HealthMonitor:
+        return self._health
+
     def _handle(self, req):
         if isinstance(req, RegisterWorkerRequest):
             with self._lock:
                 self._worker_notify_addrs[req.rank] = tuple(req.address)
+            return AckResponse()
+        if isinstance(req, HeartbeatRequest):
+            self._health.record_heartbeat(req.host, req.local_rank,
+                                          getattr(req, "step", -1))
             return AckResponse()
         if isinstance(req, WorkerReadyRequest):
             self._registry.record_ready(req.host, req.local_rank)
@@ -194,9 +214,28 @@ class ElasticDriver:
             # generation may overwrite last_recovery_s before the log runs
             recovery_s = time.monotonic() - started
             self.last_recovery_s = recovery_s
+            detect_s = self.last_detect_s
+            self.last_detect_s = None        # consumed by this generation
+        detect = "" if detect_s is None else f" detect_s={detect_s:.1f}"
         hvd_logging.info(
             "elastic: generation %d fully ready — %d worker(s) in "
-            "recovery_s=%.1f", gen, len(keys), recovery_s)
+            "recovery_s=%.1f%s", gen, len(keys), recovery_s, detect)
+
+    def _on_worker_dead(self, host: str, local_rank: int,
+                        detect_s: float, reason: str) -> None:
+        """Health-monitor verdict: treat as a failure exit NOW — the
+        regeneration starts before the worker process is ever observed
+        to exit (it may never exit: a hang holds its chips until the
+        abort event kills the tree)."""
+        if self._shutdown.is_set():
+            return    # completed/stopped job: silence is expected
+        self.last_detect_s = detect_s
+        self.last_detect_reason = reason
+        hvd_logging.warning(
+            "elastic: worker %s:%d declared dead (%s) — detect_s=%.2f; "
+            "regenerating without waiting for process exit",
+            host, local_rank, reason, detect_s)
+        self.record_worker_exit(host, local_rank, 1)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -216,6 +255,7 @@ class ElasticDriver:
         self._worker_fn_takes_abort = nparams >= 4
         self._service.start()
         self._discovery_thread.start()
+        self._health.start()
         # wait for the REQUESTED world, not the minimum (reference
         # ``driver.start`` → ``wait_for_available_slots(np)``): with racy
         # discovery (e.g. executor-pool registration) waiting only for
@@ -236,6 +276,7 @@ class ElasticDriver:
             self._exit_code = exit_code
             self._finished.set()
         self._shutdown.set()
+        self._health.stop()
         with self._lock:
             keys = list(self._abort_events)
         self._abort_workers(keys)
@@ -245,6 +286,7 @@ class ElasticDriver:
 
     def wait_for_completion(self) -> int:
         self._finished.wait()
+        self._health.stop()
         self._service.shutdown()
         with self._lock:
             services, self._coord_services = self._coord_services, []
@@ -287,6 +329,7 @@ class ElasticDriver:
     def _discovery_loop(self) -> None:
         while not self._shutdown.is_set():
             try:
+                faults.inject("driver.discovery")
                 res = self._host_manager.update_available_hosts()
             except Exception as e:
                 hvd_logging.warning("elastic: discovery failed: %s", e)
@@ -338,6 +381,7 @@ class ElasticDriver:
         self._assignments = {(s.hostname, s.local_rank): s
                              for s in assignments}
         self._registry.purge_unassigned(set(self._assignments))
+        self._health.purge(set(self._assignments))
         self._coordinator_addr = self._new_coordinator_addr(assignments)
         self._generation += 1
         self._generation_started = time.monotonic()

@@ -1,0 +1,201 @@
+"""Heartbeat health monitoring for the elastic driver (docs/faults.md).
+
+The driver previously learned of a dead worker only when its *process
+exit* was observed by the launcher thread — a worker wedged in a
+collective, or whose host dropped off the network, looked healthy
+forever (until the coordination-service heartbeat killed the whole
+generation from C++).  :class:`HealthMonitor` closes that gap at the
+control plane: workers send periodic heartbeats over the existing
+driver RPC channel (``HeartbeatRequest``, piggybacking the training
+step counter), and the monitor applies two detectors:
+
+* **liveness**: a worker is *suspect* after ``suspect_misses`` missed
+  beats (logged once), and *dead* once no beat has arrived for
+  ``dead_s`` — at which point ``on_dead(host, local_rank, detect_s,
+  reason)`` fires and the driver starts regeneration *before* the
+  process exit is ever observed;
+* **progress**: a worker whose beats keep arriving but whose step
+  counter has not advanced for ``progress_timeout_s`` is declared hung
+  (:class:`~horovod_tpu.utils.stall.ProgressWatchdog` per worker) —
+  the hung-but-alive case liveness alone cannot see.
+
+Workers appear here only after their first heartbeat: never-started
+workers are the startup watchdog's job (``driver._check_started``).
+``clock`` and ``start_thread`` are injectable so chaos tests drive the
+monitor deterministically on a fake clock.
+
+Knobs: ``HOROVOD_ELASTIC_HEARTBEAT_INTERVAL`` (seconds between worker
+beats, 0 disables the subsystem), ``HOROVOD_ELASTIC_HEARTBEAT_SUSPECT_
+MISSES``, ``HOROVOD_ELASTIC_HEARTBEAT_DEAD_S``, and
+``HOROVOD_ELASTIC_PROGRESS_TIMEOUT_S`` (0 disables the progress
+detector).  See docs/running.md.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from horovod_tpu.utils import logging as hvd_logging
+from horovod_tpu.utils.stall import ProgressWatchdog
+
+DEFAULT_INTERVAL_S = 2.0
+DEFAULT_SUSPECT_MISSES = 3
+DEFAULT_DEAD_MULTIPLE = 10     # dead_s default = interval * this
+
+
+def heartbeat_interval_s() -> float:
+    return float(os.environ.get("HOROVOD_ELASTIC_HEARTBEAT_INTERVAL",
+                                DEFAULT_INTERVAL_S))
+
+
+class _WorkerHealth:
+    __slots__ = ("last_beat", "suspect", "progress")
+
+    def __init__(self, now: float, clock):
+        self.last_beat = now
+        self.suspect = False
+        self.progress = ProgressWatchdog(clock=clock)
+
+
+class HealthMonitor:
+    def __init__(self, on_dead: Callable[[str, int, float, str], None],
+                 interval_s: float = DEFAULT_INTERVAL_S,
+                 suspect_misses: int = DEFAULT_SUSPECT_MISSES,
+                 dead_s: Optional[float] = None,
+                 progress_timeout_s: float = 0.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 start_thread: bool = True):
+        self._on_dead = on_dead
+        self.interval_s = float(interval_s)
+        self.suspect_misses = max(int(suspect_misses), 1)
+        self.dead_s = float(dead_s) if dead_s is not None \
+            else self.interval_s * DEFAULT_DEAD_MULTIPLE
+        self.progress_timeout_s = float(progress_timeout_s)
+        self._clock = clock
+        self._start_thread = start_thread
+        self._lock = threading.Lock()
+        self._workers: Dict[Tuple[str, int], _WorkerHealth] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def from_env(cls, on_dead) -> "HealthMonitor":
+        interval = heartbeat_interval_s()
+        dead_env = os.environ.get("HOROVOD_ELASTIC_HEARTBEAT_DEAD_S")
+        return cls(
+            on_dead,
+            interval_s=interval,
+            suspect_misses=int(os.environ.get(
+                "HOROVOD_ELASTIC_HEARTBEAT_SUSPECT_MISSES",
+                DEFAULT_SUSPECT_MISSES)),
+            dead_s=float(dead_env) if dead_env else None,
+            progress_timeout_s=float(os.environ.get(
+                "HOROVOD_ELASTIC_PROGRESS_TIMEOUT_S", 0.0)))
+
+    @property
+    def enabled(self) -> bool:
+        return self.interval_s > 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if not self.enabled or not self._start_thread \
+                or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._watch, daemon=True,
+            name="hvd_tpu_elastic_health")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def _watch(self) -> None:
+        poll = max(self.interval_s / 2.0, 0.05)
+        while not self._stop.wait(poll):
+            self.check()
+
+    # -- recording ----------------------------------------------------------
+
+    def record_heartbeat(self, host: str, local_rank: int,
+                         step: int = -1) -> None:
+        now = self._clock()
+        with self._lock:
+            w = self._workers.get((host, local_rank))
+            if w is None:
+                w = _WorkerHealth(now, self._clock)
+                self._workers[(host, local_rank)] = w
+            else:
+                if w.suspect:
+                    hvd_logging.info(
+                        "elastic: worker %s:%d resumed heartbeating",
+                        host, local_rank)
+                w.last_beat = now
+                w.suspect = False
+            if step >= 0:
+                w.progress.update(step, now=now)
+
+    def forget(self, host: str, local_rank: int) -> None:
+        with self._lock:
+            self._workers.pop((host, local_rank), None)
+
+    def purge(self, assigned: set) -> None:
+        """Drop entries for workers no longer assigned (driver calls this
+        on every reassignment — a removed worker must not be declared
+        dead later, and a re-added one must start with a fresh clock)."""
+        with self._lock:
+            self._workers = {k: w for k, w in self._workers.items()
+                             if k in assigned}
+
+    def max_step(self) -> int:
+        """Highest training step any monitored worker ever reported —
+        the pre-failure peak the chaos probe diffs against the restored
+        step to compute ``steps_lost``."""
+        with self._lock:
+            vals = [w.progress.value for w in self._workers.values()
+                    if w.progress.value is not None]
+        return max(vals) if vals else -1
+
+    # -- detection ----------------------------------------------------------
+
+    def check(self, now: Optional[float] = None) -> list:
+        """One detection pass; returns the ``(host, local_rank)`` keys
+        declared dead/hung (their ``on_dead`` callbacks have run)."""
+        if not self.enabled:
+            return []
+        if now is None:
+            now = self._clock()
+        dead = []
+        with self._lock:
+            for key, w in list(self._workers.items()):
+                age = now - w.last_beat
+                if age >= self.dead_s:
+                    # detect_s: silence span from the last sign of life
+                    # to this declaration
+                    dead.append((key, age, "missed heartbeats"))
+                    del self._workers[key]
+                    continue
+                if self.progress_timeout_s > 0:
+                    stalled = w.progress.stalled_for(now=now)
+                    if stalled >= self.progress_timeout_s:
+                        dead.append((key, stalled,
+                                     "no step progress (hung)"))
+                        del self._workers[key]
+                        continue
+                if not w.suspect and \
+                        age >= self.interval_s * self.suspect_misses:
+                    w.suspect = True
+                    hvd_logging.warning(
+                        "elastic: worker %s:%d is suspect — %.0f missed "
+                        "heartbeat(s) (%.1fs silent; declared dead at "
+                        "%.1fs)", key[0], key[1],
+                        age / self.interval_s, age, self.dead_s)
+        for (host, local_rank), detect_s, reason in dead:
+            self._on_dead(host, local_rank, detect_s, reason)
+        return [k for k, _, _ in dead]

@@ -55,10 +55,13 @@ class WorkerStateRegistry:
             self._states[(host, local_rank)] = SUCCESS
 
     def record_failure(self, host: str, local_rank: int) -> bool:
-        """A worker exited non-zero: blacklist its host once failures
-        exceed its slot count is NOT the reference rule — the reference
-        blacklists immediately on failure exit (``driver.py:291-307``) and
-        resumes with the survivors.
+        """A worker exited non-zero: exclude its host immediately and
+        resume with the survivors (the reference's immediate-blacklist
+        rule, ``driver.py:291-307``) — but through the decaying
+        quarantine (``discovery.HostQuarantine``), so a flapping host's
+        cooldown grows exponentially while a recovered host is
+        readmitted on probation without operator action.  Permanent
+        exclusion remains available via ``HostManager.blacklist``.
 
         Returns False (and does nothing) when the worker is already in
         FAILURE — the check-and-set is atomic under the registry lock so
@@ -70,7 +73,7 @@ class WorkerStateRegistry:
                 return False
             self._states[(host, local_rank)] = FAILURE
             self._failure_count += 1
-        self._host_manager.blacklist(host)
+        self._host_manager.quarantine(host)
         self._maybe_resume()
         return True
 

@@ -49,6 +49,9 @@ class State:
         self._host_messages.put((timestamp, update_res))
 
     def commit(self) -> None:
+        from horovod_tpu import faults
+
+        faults.inject("worker.commit")   # chaos hook: crash/hang at step k
         self.save()
         self.check_host_updates()
 
@@ -181,6 +184,11 @@ class TpuState(ObjectState):
                 copy.deepcopy(x), val)
         self._saved_state = new_state
         self._commit_count += 1
+        # progress export: the commit count rides the worker's heartbeats
+        # so the driver's hung-rank watchdog sees training advance
+        from horovod_tpu.elastic import worker as elastic_worker
+
+        elastic_worker.report_step(self._commit_count)
         if self._checkpointer is not None and \
                 self._commit_count % self._checkpoint_every == 0:
             # the leaves are already host numpy arrays, so the
@@ -217,6 +225,9 @@ class TpuState(ObjectState):
         # kept answering the stale pre-crash one, so a second crash would
         # lose everything since the first restart.
         self._commit_count = int(step)
+        from horovod_tpu.elastic import worker as elastic_worker
+
+        elastic_worker.report_step(self._commit_count)
         self.restore()
         return True
 

@@ -5,6 +5,15 @@ service the driver pings with ``HostsUpdatedRequest``; the notification
 manager fans the timestamp out to registered ``State`` listeners, which
 turn it into ``HostsUpdatedInterrupt`` at the next ``commit()``/
 ``check_host_updates()``.
+
+Health plane (docs/faults.md): alongside the notification service each
+elastic worker runs a :class:`HeartbeatSender` — a daemon thread beating
+to the driver every ``HOROVOD_ELASTIC_HEARTBEAT_INTERVAL`` seconds and
+piggybacking the training step counter (:func:`report_step`, bumped by
+``TpuState.save()`` on every commit).  The driver's ``HealthMonitor``
+turns missing beats into death detection and a stagnant step counter
+into hang detection — both *before* the worker process exit is ever
+observed.
 """
 
 from __future__ import annotations
@@ -13,7 +22,67 @@ import os
 import threading
 from typing import List, Optional
 
+from horovod_tpu import faults
 from horovod_tpu.utils import logging as hvd_logging
+
+# training progress, exported to the driver through heartbeats — written
+# by TpuState.save() (one bump per commit), read by the sender thread
+_step_lock = threading.Lock()
+_current_step = -1
+
+
+def report_step(step: int) -> None:
+    """Record this worker's training progress counter (monotonic; the
+    elastic commit count).  Cheap enough to call every step."""
+    global _current_step
+    with _step_lock:
+        if step > _current_step:
+            _current_step = step
+
+
+def current_step() -> int:
+    with _step_lock:
+        return _current_step
+
+
+class HeartbeatSender:
+    """Daemon thread beating ``(host, local_rank, step)`` to the elastic
+    driver.  Send failures are logged at debug and dropped — the next
+    beat IS the retry, and a worker must never die because the control
+    plane hiccupped."""
+
+    def __init__(self, driver_addr: str, secret_key: Optional[str],
+                 host: str, local_rank: int, interval_s: float):
+        self._driver_addr = driver_addr
+        self._key = secret_key
+        self._host = host
+        self._local_rank = local_rank
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"hvd_tpu_heartbeat_{host}_{local_rank}")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        from horovod_tpu.runner.network import notify_heartbeat
+
+        while not self._stop.wait(self.interval_s):
+            try:
+                # chaos hook: a hang fault here silences the beats while
+                # the process stays alive — exactly the failure mode the
+                # driver-side HealthMonitor exists to catch
+                faults.inject("worker.heartbeat")
+                notify_heartbeat(self._driver_addr, self._key,
+                                 self._host, self._local_rank,
+                                 current_step())
+            except OSError as e:
+                hvd_logging.debug("elastic: heartbeat send failed: %s", e)
 
 
 class WorkerNotificationManager:
@@ -21,6 +90,7 @@ class WorkerNotificationManager:
         self._lock = threading.Lock()
         self._listeners: List = []
         self._service: Optional["WorkerNotificationService"] = None
+        self._heartbeat: Optional[HeartbeatSender] = None
 
     def init(self) -> None:
         if self._service is not None:
@@ -41,13 +111,30 @@ class WorkerNotificationManager:
                     notify_worker_ready,
                     notify_worker_registered,
                 )
+                from horovod_tpu.runtime.retry import RetryPolicy
 
-                notify_worker_registered(driver_addr, self._service.address,
-                                         secret_key)
-                notify_worker_ready(
-                    driver_addr, secret_key,
-                    os.environ.get("HOROVOD_HOSTNAME", socket.gethostname()),
-                    int(os.environ.get("HOROVOD_LOCAL_RANK", "0")))
+                host = os.environ.get("HOROVOD_HOSTNAME",
+                                      socket.gethostname())
+                local_rank = int(os.environ.get("HOROVOD_LOCAL_RANK", "0"))
+                # the driver may still be binding its service when a
+                # fast worker comes up — transient connect failures are
+                # retried under the unified policy instead of failing
+                # the worker's whole startup
+                policy = RetryPolicy(name="worker-register",
+                                     retry_on=(OSError,))
+                faults.inject("worker.register")
+                policy.call(notify_worker_registered, driver_addr,
+                            self._service.address, secret_key)
+                policy.call(notify_worker_ready, driver_addr, secret_key,
+                            host, local_rank)
+                from horovod_tpu.elastic.health import heartbeat_interval_s
+
+                interval = heartbeat_interval_s()
+                if interval > 0:
+                    self._heartbeat = HeartbeatSender(
+                        driver_addr, secret_key, host, local_rank,
+                        interval)
+                    self._heartbeat.start()
 
     def register_listener(self, listener) -> None:
         with self._lock:
@@ -99,6 +186,10 @@ def refresh_assignment_from_driver(timeout_s: float = 60.0) -> bool:
     form again.  A worker whose (host, local_rank) has no slot in the new
     generation was scaled away — it exits 0 (the reference driver stops
     removed workers via the host event; here the worker retires itself).
+
+    Transport failures (a driver mid-restart, a dropped connection) are
+    retried with backoff+jitter under the unified policy instead of
+    killing the worker — giving up only at ``timeout_s``.
     """
     import socket
     import sys
@@ -109,6 +200,7 @@ def refresh_assignment_from_driver(timeout_s: float = 60.0) -> bool:
         return False
     from horovod_tpu.elastic.driver import GetRankAndSizeRequest
     from horovod_tpu.runner.network import BasicClient
+    from horovod_tpu.runtime.retry import RetryPolicy
 
     key = os.environ.get("HOROVOD_SECRET_KEY")
     hostname = os.environ.get("HOROVOD_HOSTNAME", socket.gethostname())
@@ -116,9 +208,13 @@ def refresh_assignment_from_driver(timeout_s: float = 60.0) -> bool:
     known_gen = int(os.environ.get("HOROVOD_ELASTIC_GENERATION", "-1"))
     host, port = driver_addr.rsplit(":", 1)
     client = BasicClient((host, int(port)), key)
+    policy = RetryPolicy(name="rendezvous", retry_on=(OSError,),
+                         deadline_s=timeout_s)
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
-        resp = client.request(
+        faults.inject("worker.rendezvous")
+        resp = policy.call(
+            client.request,
             GetRankAndSizeRequest(hostname, local_rank, known_gen))
         if resp.generation > known_gen:
             if resp.slot is None:

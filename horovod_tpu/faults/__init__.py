@@ -1,0 +1,61 @@
+"""Deterministic fault injection for the elastic runtime (docs/faults.md).
+
+The elastic plane's failure handling — heartbeat death detection, host
+quarantine, checkpoint recovery, retry/backoff — is only trustworthy if
+it can be *demonstrated*, repeatedly, without waiting for real hardware
+to fail.  This package provides the chaos half of that contract: a
+seeded :class:`FaultPlan` schedules named faults (crash at step k, hang,
+transient ``OSError``, slow host, discovery flap) that fire through
+explicit :func:`inject` hooks placed at the runtime's failure-relevant
+sites — the elastic worker loop, the driver discovery loop, the
+checkpoint writer thread, the data prefetch feeder, worker registration
+and the coordinator connect path.
+
+With no plan active every hook is a near-zero-cost no-op (one global
+``None`` check), so production code paths carry no chaos overhead.
+
+Sites currently instrumented (grep ``faults.inject`` for ground truth):
+
+==========================  =================================================
+``worker.commit``           elastic ``State.commit()`` — once per train step
+``worker.register``         worker → driver registration/READY report
+``worker.heartbeat``        each heartbeat send in the worker sender thread
+``worker.rendezvous``       ``refresh_assignment_from_driver`` RPC
+``coordinator.connect``     elastic coordination-service client connect
+``driver.discovery``        each driver discovery-loop pass
+``discovery.script``        each discovery-script execution
+``checkpoint.write``        the checkpoint writer (thread) before the write
+``data.feed``               prefetch feeder, once per source batch
+==========================  =================================================
+
+Typical use::
+
+    plan = FaultPlan(seed=42, sim=True).add("worker.commit", "crash", at=7)
+    faults.set_plan(plan)
+
+or, for a launched job::
+
+    HOROVOD_FAULT_PLAN="seed=42;worker.commit@7:crash;data.feed@3:delay(0.5)"
+"""
+
+from horovod_tpu.faults.plan import (
+    FaultPlan,
+    FaultSpec,
+    WorkerCrash,
+    active_plan,
+    clear_plan,
+    inject,
+    load_env_plan,
+    set_plan,
+)
+
+__all__ = [
+    "FaultPlan",
+    "FaultSpec",
+    "WorkerCrash",
+    "active_plan",
+    "clear_plan",
+    "inject",
+    "load_env_plan",
+    "set_plan",
+]

@@ -174,19 +174,29 @@ def _connect_driver(driver_addrs: str, secret_key: Optional[str]
     until one answers a ping — the driver advertises every local
     interface because its hostname may not resolve from worker hosts
     (the reference hands tasks the full driver address list,
-    ``driver_service.py:49-84``)."""
-    last_err: Optional[Exception] = None
-    for addr in driver_addrs.split(","):
-        host, port = addr.rsplit(":", 1)
-        client = BasicClient((host, int(port)), secret_key, timeout_s=5.0)
-        try:
-            if client.ping():
-                return client
-        except OSError as e:
-            last_err = e
-    raise ConnectionError(
-        f"probe task could not reach the driver at any of "
-        f"[{driver_addrs}]: {last_err}")
+    ``driver_service.py:49-84``).  The scan is retried with
+    backoff+jitter under the unified policy: a probe task often races
+    the driver's own bind, and one refused connect must not fail the
+    whole NIC discovery."""
+    from horovod_tpu.runtime.retry import RetryPolicy
+
+    def scan() -> BasicClient:
+        last_err: Optional[Exception] = None
+        for addr in driver_addrs.split(","):
+            host, port = addr.rsplit(":", 1)
+            client = BasicClient((host, int(port)), secret_key,
+                                 timeout_s=5.0)
+            try:
+                if client.ping():
+                    return client
+            except OSError as e:
+                last_err = e
+        raise ConnectionError(
+            f"probe task could not reach the driver at any of "
+            f"[{driver_addrs}]: {last_err}")
+
+    return RetryPolicy(name="driver-probe", retry_on=(OSError,),
+                       deadline_s=30.0).call(scan)
 
 
 def run_probe_task(driver_addrs: str, index: int,

@@ -111,6 +111,17 @@ class WorkerReadyRequest:
         self.local_rank = local_rank
 
 
+class HeartbeatRequest:
+    """Worker → driver: periodic liveness beat, piggybacking the
+    training step counter so the driver's progress watchdog can tell a
+    hung-but-alive rank from a healthy one (``elastic/health.py``)."""
+
+    def __init__(self, host: str, local_rank: int, step: int = -1):
+        self.host = host
+        self.local_rank = local_rank
+        self.step = step
+
+
 class BasicService:
     """Threaded TCP server dispatching pickled requests to a handler
     (reference ``BasicService``, ``network.py:268``)."""
@@ -236,3 +247,12 @@ def notify_worker_ready(driver_addr: str, key: Optional[str],
     dhost, port = driver_addr.rsplit(":", 1)
     BasicClient((dhost, int(port)), key).request(
         WorkerReadyRequest(host, local_rank))
+
+
+def notify_heartbeat(driver_addr: str, key: Optional[str],
+                     host: str, local_rank: int, step: int = -1) -> None:
+    """Worker-side: one liveness beat to the elastic driver (short
+    timeout — a slow beat must not back the sender thread up)."""
+    dhost, port = driver_addr.rsplit(":", 1)
+    BasicClient((dhost, int(port)), key, timeout_s=5.0).request(
+        HeartbeatRequest(host, local_rank, step))

@@ -126,6 +126,10 @@ class Config:
     # -- elastic
     elastic_enabled: bool = False
 
+    # -- chaos (horovod_tpu/faults): the seeded fault plan, parsed and
+    # installed at init() — docs/faults.md for the grammar
+    fault_plan: Optional[str] = None
+
     # -- mesh overrides: "8" or "2,4" → (dcn, ici) axis sizes
     mesh_shape: Optional[str] = None
 
@@ -207,6 +211,7 @@ class Config:
                 "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0),
             adasum_num_chunks=_env_int("HOROVOD_ADASUM_NUM_CHUNKS", 1),
             elastic_enabled=_env_bool("HOROVOD_ELASTIC", False),
+            fault_plan=os.environ.get("HOROVOD_FAULT_PLAN"),
             mesh_shape=os.environ.get("HOROVOD_TPU_MESH_SHAPE"),
             fixed_knobs=frozenset(fixed),
         )

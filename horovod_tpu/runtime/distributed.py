@@ -109,16 +109,30 @@ def connect_elastic_client(coordinator_addr: str, num_processes: int,
                 "next collective will raise and trigger recovery",
                 coordinator_reported_failure, status)
 
-    client = dist._jax.get_distributed_runtime_client(
-        coordinator_addr, process_id,
-        init_timeout=init_timeout,
-        heartbeat_timeout=heartbeat_timeout,
-        shutdown_timeout=5,
-        use_compression=True,
-        recoverable=True,
-        missed_heartbeat_callback=on_missed_heartbeat,
-        shutdown_on_destruction=False)
-    client.connect()
+    def _connect():
+        # chaos hook + retry: a refused/reset connect (driver mid-bind,
+        # generation race) is retried with backoff+jitter on a FRESH
+        # client — a half-connected client must not be reused
+        from horovod_tpu import faults
+
+        faults.inject("coordinator.connect")
+        c = dist._jax.get_distributed_runtime_client(
+            coordinator_addr, process_id,
+            init_timeout=init_timeout,
+            heartbeat_timeout=heartbeat_timeout,
+            shutdown_timeout=5,
+            use_compression=True,
+            recoverable=True,
+            missed_heartbeat_callback=on_missed_heartbeat,
+            shutdown_on_destruction=False)
+        c.connect()
+        return c
+
+    from horovod_tpu.runtime.retry import RetryPolicy
+
+    client = RetryPolicy(name="coordinator-connect",
+                         retry_on=(OSError, TimeoutError),
+                         deadline_s=float(init_timeout)).call(_connect)
 
     state = dist.global_state
     state.client = client

@@ -1,0 +1,111 @@
+"""Unified retry policy: exponential backoff + full jitter + deadline.
+
+Before this module every transient-failure path hand-rolled its own
+sleep loop (worker rendezvous polling, coordinator probing, discovery
+script execution, checkpoint I/O) with different — and mostly absent —
+backoff behavior.  :class:`RetryPolicy` is the one implementation they
+all share: capped exponential backoff with *full jitter* (each sleep is
+uniform in ``[0, min(max_s, base_s * 2**attempt)]`` — the AWS
+architecture-blog result that full jitter minimizes contention when a
+fleet retries the same endpoint at once) under both an attempt budget
+and a wall-clock deadline.
+
+Env knobs (the process-wide defaults; every call site may override):
+
+=================================  ========  ===============================
+``HOROVOD_RETRY_MAX_ATTEMPTS``     5         total tries (1 = no retry)
+``HOROVOD_RETRY_BASE_S``           0.1       first backoff cap, seconds
+``HOROVOD_RETRY_MAX_S``            5.0       per-sleep cap, seconds
+``HOROVOD_RETRY_DEADLINE_S``       60.0      total elapsed budget (0 = none)
+``HOROVOD_RETRY_JITTER``           1         0 = deterministic full backoff
+=================================  ========  ===============================
+
+Only exceptions in ``retry_on`` are retried — everything else
+propagates immediately (a programming error must never be masked by
+backoff).  ``seed``/``clock``/``sleep`` are injectable for
+deterministic tests.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Callable, Optional, Tuple, Type
+
+from horovod_tpu.runtime.config import _env_bool, _env_float, _env_int
+from horovod_tpu.utils import logging as hvd_logging
+
+DEFAULT_RETRY_ON: Tuple[Type[BaseException], ...] = (OSError, TimeoutError)
+
+
+class RetryPolicy:
+    def __init__(self,
+                 max_attempts: Optional[int] = None,
+                 base_s: Optional[float] = None,
+                 max_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None,
+                 jitter: Optional[bool] = None,
+                 retry_on: Tuple[Type[BaseException], ...] = DEFAULT_RETRY_ON,
+                 name: str = "retry",
+                 seed: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.max_attempts = max(
+            max_attempts if max_attempts is not None
+            else _env_int("HOROVOD_RETRY_MAX_ATTEMPTS", 5), 1)
+        self.base_s = base_s if base_s is not None \
+            else _env_float("HOROVOD_RETRY_BASE_S", 0.1)
+        self.max_s = max_s if max_s is not None \
+            else _env_float("HOROVOD_RETRY_MAX_S", 5.0)
+        self.deadline_s = deadline_s if deadline_s is not None \
+            else _env_float("HOROVOD_RETRY_DEADLINE_S", 60.0)
+        self.jitter = jitter if jitter is not None \
+            else _env_bool("HOROVOD_RETRY_JITTER", True)
+        self.retry_on = retry_on
+        self.name = name
+        self._rng = random.Random(seed)
+        self._clock = clock
+        self._sleep = sleep
+
+    def backoff_s(self, attempt: int) -> float:
+        """Sleep before retry number ``attempt+1`` (attempt is 0-based)."""
+        cap = min(self.max_s, self.base_s * (2.0 ** attempt))
+        return self._rng.uniform(0.0, cap) if self.jitter else cap
+
+    def call(self, fn: Callable, *args, **kwargs) -> Any:
+        """Run ``fn`` under this policy; re-raises the last retryable
+        error once the attempt budget or the deadline is exhausted."""
+        start = self._clock()
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_attempts):
+            try:
+                return fn(*args, **kwargs)
+            except self.retry_on as e:  # noqa: PERF203 — the point
+                last = e
+                if attempt + 1 >= self.max_attempts:
+                    break
+                delay = self.backoff_s(attempt)
+                elapsed = self._clock() - start
+                if self.deadline_s > 0 and \
+                        elapsed + delay > self.deadline_s:
+                    hvd_logging.warning(
+                        "%s: deadline %.1fs exhausted after %d attempt(s): "
+                        "%s", self.name, self.deadline_s, attempt + 1, e)
+                    raise
+                hvd_logging.warning(
+                    "%s: attempt %d/%d failed (%s: %s) — retrying in "
+                    "%.2fs", self.name, attempt + 1, self.max_attempts,
+                    type(e).__name__, e, delay)
+                self._sleep(delay)
+        assert last is not None
+        raise last
+
+
+def retry_call(fn: Callable, *args,
+               name: str = "retry",
+               retry_on: Tuple[Type[BaseException], ...] = DEFAULT_RETRY_ON,
+               **kwargs) -> Any:
+    """One-shot convenience: ``fn(*args, **kwargs)`` under the env-default
+    :class:`RetryPolicy`."""
+    return RetryPolicy(retry_on=retry_on, name=name).call(
+        fn, *args, **kwargs)

@@ -82,6 +82,15 @@ class GlobalState:
     def initialize(self, ranks: Optional[list] = None) -> None:
         cfg = self.config
 
+        # chaos layer first: if a fault plan is configured it must be
+        # live before any instrumented subsystem starts (the plan's own
+        # loader logs loudly — an active plan in production is an
+        # operator mistake worth shouting about)
+        if cfg.fault_plan:
+            from horovod_tpu import faults
+
+            faults.load_env_plan()
+
         # HOROVOD_THREAD_AFFINITY: confine this worker to its core set
         # (reference parse_and_set_affinity, common.cc).  Must run BEFORE
         # any jax.distributed setup — sched_setaffinity is inherited only

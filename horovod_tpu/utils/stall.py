@@ -37,6 +37,47 @@ from horovod_tpu.utils import logging as hvd_logging
 _STATUS_DIR = "hvdstall/status"
 
 
+class ProgressWatchdog:
+    """Tracks a monotonically-advancing progress counter and reports how
+    long it has been stagnant — the primitive behind hung-but-alive
+    detection (a rank whose heartbeats keep arriving while its step
+    counter stopped moving is wedged, not dead).
+
+    Pure bookkeeping, no thread: the owner decides when to call
+    :meth:`stalled_for` and what stagnation threshold means trouble.
+    ``clock`` is injectable for deterministic tests."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._value: Optional[int] = None
+        self._since: Optional[float] = None
+
+    def update(self, value: int, now: Optional[float] = None) -> None:
+        """Record the counter's current value; only an *advance*
+        restarts the stagnation clock (a repeated or regressed value —
+        a worker re-reporting after restore — does not look like
+        progress)."""
+        if now is None:
+            now = self._clock()
+        if self._value is None or value > self._value:
+            self._value = value
+            self._since = now
+
+    @property
+    def value(self) -> Optional[int]:
+        return self._value
+
+    def stalled_for(self, now: Optional[float] = None) -> float:
+        """Seconds since the counter last advanced (0.0 before the
+        first update — never-reported is the startup watchdog's job,
+        not this one's)."""
+        if self._since is None:
+            return 0.0
+        if now is None:
+            now = self._clock()
+        return max(now - self._since, 0.0)
+
+
 class StallInspector:
     def __init__(self, warning_time_s: float = 60.0,
                  shutdown_time_s: float = 0.0, poll_interval_s: float = 5.0):

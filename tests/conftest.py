@@ -7,8 +7,21 @@ collective, sharding and shard_map path runs exactly as it would on an
 8-chip slice — no TPU hardware needed for the core suite.
 """
 
+import faulthandler
 import os
 import tempfile
+
+# Hang diagnosability: tier-1 runs under an outer `timeout -k` that
+# SIGKILLs the run with no stacks.  Dump every thread's traceback to
+# stderr shortly before that budget expires (and on SIGSEGV & friends
+# via enable()), so a future hang names its wedged thread in the tier-1
+# log instead of dying silently.  The margin is configurable for local
+# runs with tighter budgets; exit=False — the dump is diagnostic, the
+# outer timeout stays in charge of killing.
+faulthandler.enable()
+faulthandler.dump_traceback_later(
+    int(os.environ.get("HVD_TEST_DUMP_TRACEBACK_AFTER_S", "800")),
+    exit=False)
 
 # must run before jax initializes its backends
 _flags = os.environ.get("XLA_FLAGS", "")

@@ -1,0 +1,215 @@
+"""Seeded chaos scenarios (pytest -m chaos): deterministic fault
+injection through the real components — TpuState + async Checkpointer,
+PrefetchIterator, HostDiscoveryScript — proving the detect→decide→
+recover loop end to end without real process churn (the multi-process
+versions live in the slow-marked elastic e2e suites)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu import faults
+from horovod_tpu.faults import FaultPlan, WorkerCrash
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+
+
+class TestCrashRecovery:
+    """The headline acceptance scenario: a seeded worker crash at step k
+    is deterministic across two runs and recovery resumes from the last
+    durable checkpoint with steps_lost <= checkpoint_every."""
+
+    STEPS, CRASH_AT, EVERY, SEED = 11, 7, 2, 42
+
+    def run_scenario(self, hvd, root):
+        rng = np.random.RandomState(self.SEED)
+        data = rng.rand(self.STEPS, 4).astype(np.float32)
+
+        def train_step(params, batch):
+            return {"w": params["w"] - 0.1 * (params["w"] - batch)}
+
+        plan = FaultPlan(seed=self.SEED, sim=True).add(
+            "worker.commit", "crash", at=self.CRASH_AT)
+        faults.set_plan(plan)
+        ckpt = hvd.checkpoint.Checkpointer(root, use_orbax=False)
+        state = hvd.elastic.TpuState(
+            params={"w": np.full((4,), 2.0, np.float32)},
+            checkpointer=ckpt, checkpoint_every=self.EVERY)
+        losses = []
+        crashed_at = None
+        try:
+            while state._commit_count < self.STEPS:
+                state.params = train_step(state.params,
+                                          data[state._commit_count])
+                state.commit()
+                losses.append(round(float(np.sum(state.params["w"])), 6))
+        except WorkerCrash as e:
+            crashed_at = state._commit_count + 1
+            assert e.site == "worker.commit"
+        finally:
+            faults.clear_plan()
+        state.wait()
+        completed = state._commit_count
+
+        # "restart": a cold state with no in-memory commit, restored
+        # from the last durable checkpoint
+        cold = hvd.elastic.TpuState(
+            params={"w": np.zeros((4,), np.float32)},
+            checkpointer=ckpt, checkpoint_every=self.EVERY)
+        assert cold.restore_from_checkpoint() is True
+        resumed_step = cold._commit_count
+        steps_lost = completed - resumed_step
+        while cold._commit_count < self.STEPS:
+            cold.params = train_step(cold.params,
+                                     data[cold._commit_count])
+            cold.commit()
+            losses.append(round(float(np.sum(cold.params["w"])), 6))
+        cold.wait()
+        return {"crashed_at": crashed_at, "completed": completed,
+                "resumed_step": resumed_step, "steps_lost": steps_lost,
+                "losses": losses,
+                "final": np.asarray(cold.params["w"]).copy()}
+
+    def test_crash_at_step_k_recovers_within_budget(self, hvd_runtime,
+                                                    tmp_path):
+        r = self.run_scenario(hvd_runtime, str(tmp_path / "ck"))
+        assert r["crashed_at"] == self.CRASH_AT
+        assert r["completed"] == self.CRASH_AT - 1
+        # last durable commit is the nearest checkpoint_every multiple
+        assert r["resumed_step"] == \
+            ((self.CRASH_AT - 1) // self.EVERY) * self.EVERY
+        assert 0 <= r["steps_lost"] <= self.EVERY
+        # training genuinely resumed and reached the target step count
+        assert len(r["losses"]) == r["completed"] + \
+            (self.STEPS - r["resumed_step"])
+
+    def test_two_runs_identical(self, hvd_runtime, tmp_path):
+        r1 = self.run_scenario(hvd_runtime, str(tmp_path / "a"))
+        r2 = self.run_scenario(hvd_runtime, str(tmp_path / "b"))
+        assert r1["crashed_at"] == r2["crashed_at"]
+        assert r1["resumed_step"] == r2["resumed_step"]
+        assert r1["losses"] == r2["losses"]
+        np.testing.assert_array_equal(r1["final"], r2["final"])
+
+    def test_recovered_trajectory_matches_fault_free_run(self,
+                                                         hvd_runtime,
+                                                         tmp_path):
+        """Recovery must replay the lost steps exactly: the post-crash
+        final params equal a run that never crashed."""
+        rng = np.random.RandomState(self.SEED)
+        data = rng.rand(self.STEPS, 4).astype(np.float32)
+        w = np.full((4,), 2.0, np.float32)
+        for i in range(self.STEPS):
+            w = w - 0.1 * (w - data[i])
+        r = self.run_scenario(hvd_runtime, str(tmp_path / "ck"))
+        np.testing.assert_allclose(r["final"], w, rtol=1e-6)
+
+
+class TestCheckpointWriteFault:
+    def test_injected_oserror_surfaces_and_no_half_step(self, tmp_path):
+        """A checkpoint-write OSError fires in the writer thread: the
+        error is sticky across wait()/save() until acknowledged, and no
+        half-written step is ever visible to readers."""
+        import horovod_tpu as hvd
+
+        faults.set_plan(FaultPlan(sim=True).add(
+            "checkpoint.write", "raise", "OSError", at=1))
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                           use_orbax=False)
+        ckpt.save(0, {"w": np.ones(4)})
+        with pytest.raises(OSError):
+            ckpt.wait()
+        with pytest.raises(OSError):      # sticky: every path surfaces it
+            ckpt.wait()
+        with pytest.raises(OSError):
+            ckpt.save(1, {"w": np.ones(4)})
+        assert isinstance(ckpt.clear_error(), OSError)
+        assert ckpt.all_steps() == []     # nothing half-written surfaced
+        ckpt.save(2, {"w": np.full(4, 7.0)})   # hit 2: no fault
+        ckpt.wait()
+        assert ckpt.all_steps() == [2]
+        got = ckpt.restore({"w": np.zeros(4)})
+        np.testing.assert_allclose(got["w"], 7.0)
+
+
+class TestDataFeedFault:
+    def test_feeder_fault_surfaces_at_exact_batch(self):
+        """A data.feed fault at source-pull k is deterministic: exactly
+        k-1 batches are delivered, then the injected error raises from
+        next() — at any prefetch depth."""
+        from horovod_tpu.data import PrefetchIterator
+
+        for depth in (1, 2, 4):
+            faults.set_plan(FaultPlan(sim=True).add(
+                "data.feed", "raise", "OSError", at=3))
+            it = PrefetchIterator(iter(range(100)), depth=depth)
+            got = [next(it), next(it)]
+            with pytest.raises(OSError):
+                while True:
+                    got.append(next(it))
+            assert got == [0, 1]
+            assert it.closed
+            faults.clear_plan()
+
+    def test_slow_source_fault_just_delays(self):
+        from horovod_tpu.data import PrefetchIterator
+
+        faults.set_plan(FaultPlan().add("data.feed", "delay", "0.05",
+                                        at=1, count=2))
+        with PrefetchIterator(iter(range(4)), depth=2) as it:
+            assert list(it) == [0, 1, 2, 3]
+
+
+class TestDiscoveryFaults:
+    def test_script_fault_retains_last_good(self, tmp_path):
+        """discovery-script faults (CalledProcessError x2) ride the
+        last-good fallback — the discovery plane never sees a crash."""
+        import subprocess
+
+        from horovod_tpu.elastic.discovery import HostDiscoveryScript
+        from horovod_tpu.runtime.retry import RetryPolicy
+
+        d = HostDiscoveryScript(
+            "echo h1:2",
+            retry=RetryPolicy(max_attempts=1, sleep=lambda s: None,
+                              retry_on=(subprocess.CalledProcessError,
+                                        OSError), name="t"))
+        assert d.find_available_hosts_and_slots() == {"h1": 2}
+        faults.set_plan(FaultPlan().add(
+            "discovery.script", "raise", "CalledProcessError",
+            at=1, count=2))
+        assert d.find_available_hosts_and_slots() == {"h1": 2}   # hit 1
+        assert d.consecutive_failures == 1
+        assert d.find_available_hosts_and_slots() == {"h1": 2}   # hit 2
+        assert d.find_available_hosts_and_slots() == {"h1": 2}   # healthy
+        assert d.consecutive_failures == 0
+
+    def test_driver_discovery_loop_survives_injected_fault(self,
+                                                           monkeypatch):
+        """The driver's discovery-loop hook: an injected error is
+        absorbed by the loop's catch-all (logged, no update) — the loop
+        thread never dies.  Driven by calling one loop body's worth of
+        work directly."""
+        from horovod_tpu.elastic.discovery import FixedHosts, HostManager
+
+        hm = HostManager(FixedHosts({"h1": 1}))
+        faults.set_plan(FaultPlan().add(
+            "driver.discovery", "raise", "OSError", at=1))
+        # replicate the loop body's try/except contract
+        try:
+            faults.inject("driver.discovery")
+            hm.update_available_hosts()
+        except Exception:
+            res = None
+        else:  # pragma: no cover - fault must fire
+            pytest.fail("fault did not fire")
+        assert hm.available_slots == 0            # update skipped, no crash
+        faults.inject("driver.discovery")         # hit 2: clean pass
+        hm.update_available_hosts()
+        assert hm.available_slots == 1

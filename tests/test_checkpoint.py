@@ -113,10 +113,80 @@ class TestAsyncSave:
         ckpt.save(0, {"fn": lambda: None})
         with pytest.raises(Exception):
             ckpt.wait()
-        # the error is consumed: the next save/wait cycle is clean
+        # STICKY: every subsequent save/wait/close path re-raises until
+        # the caller acknowledges — a lost checkpoint must not be
+        # discoverable only by whoever hit the barrier first
+        with pytest.raises(Exception):
+            ckpt.wait()
+        with pytest.raises(Exception):
+            ckpt.save(1, make_state(2.0))
+        with pytest.raises(Exception):
+            ckpt.close()
+        assert ckpt.clear_error() is not None
+        # acknowledged: the next save/wait cycle is clean
         ckpt.save(1, make_state(2.0))
         ckpt.wait()
         assert ckpt.latest_step() == 1
+
+    def test_failing_write_leaves_no_visible_half_step(self, tmp_path,
+                                                       monkeypatch):
+        # the write dies mid-stream (tmp written, never renamed): no
+        # reader may ever see the step, and the error must surface
+        import horovod_tpu.checkpoint as ckpt_mod
+
+        monkeypatch.setenv("HOROVOD_RETRY_MAX_ATTEMPTS", "1")
+
+        def dying_write(path, payload):
+            d = os.path.dirname(path)
+            with open(os.path.join(d, ".tmp.state.pkl.999"), "wb") as f:
+                f.write(b"torso")
+            raise OSError("disk pulled mid-write")
+
+        monkeypatch.setattr(ckpt_mod, "_atomic_write", dying_write)
+        root = tmp_path / "ck"
+        ckpt = ckpt_mod.Checkpointer(str(root), use_orbax=False)
+        ckpt.save(3, make_state(1.0))
+        with pytest.raises(OSError, match="disk pulled"):
+            ckpt.wait()
+        ckpt.clear_error()
+        assert ckpt.all_steps() == []          # half-step invisible
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore(make_state(0.0))
+        # the torso exists on disk but only as an ignored tmp dropping
+        assert os.listdir(root / "step_3") == [".tmp.state.pkl.999"]
+
+    def test_transient_write_error_is_retried(self, tmp_path,
+                                              monkeypatch):
+        # one ENOSPC-style hiccup, then success: the writer-thread retry
+        # absorbs it and the checkpoint lands durably with no error
+        import horovod_tpu.checkpoint as ckpt_mod
+
+        real = ckpt_mod._atomic_write
+        calls = []
+
+        def flaky_write(path, payload):
+            calls.append(path)
+            if len(calls) == 1:
+                raise OSError("transient")
+            real(path, payload)
+
+        monkeypatch.setenv("HOROVOD_RETRY_BASE_S", "0.01")
+        monkeypatch.setattr(ckpt_mod, "_atomic_write", flaky_write)
+        ckpt = ckpt_mod.Checkpointer(str(tmp_path / "ck"),
+                                     use_orbax=False)
+        ckpt.save(0, make_state(6.0))
+        ckpt.wait()                            # no raise: retry recovered
+        assert len(calls) == 2
+        restored = ckpt.restore(make_state(0.0))
+        np.testing.assert_allclose(
+            np.asarray(restored["params"]["w"]), 6.0)
+
+    def test_close_is_final_barrier(self, tmp_path):
+        root = tmp_path / "ck"
+        ckpt = hvd.checkpoint.Checkpointer(str(root), use_orbax=False)
+        ckpt.save(0, make_state(2.0))
+        ckpt.close()                           # joins + surfaces errors
+        assert os.path.exists(root / "step_0" / "state.pkl")
 
     def test_snapshot_owns_host_arrays(self, tmp_path, monkeypatch):
         # the immune-after-return contract must hold for numpy leaves

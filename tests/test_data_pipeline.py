@@ -308,6 +308,49 @@ class TestPrefetchIterator:
         assert time.perf_counter() - t0 < 2.0
         assert not self._input_threads()
 
+    def test_close_during_inflight_worker_exception(self):
+        """The documented contract (prefetch.py): a deferred worker
+        exception is raised only from iteration — close() on an
+        iterator whose feeder/pool already hit an error must return
+        cleanly AND leak-free, dropping the pending error."""
+        import queue as queue_mod
+
+        gate = threading.Event()
+
+        def src():
+            yield np.zeros(1)
+            gate.wait(5.0)               # let the consumer take batch 0
+            raise RuntimeError("in-flight source failure")
+
+        feed = PrefetchIterator(src(), depth=2, name="inflightclose")
+        next(feed)                        # batch 0 consumed
+        gate.set()
+        # wait until the failure is actually queued (in-flight, undelivered)
+        deadline = time.monotonic() + 5.0
+        while feed._queue.empty() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        feed.close()                      # must NOT raise the deferred error
+        assert feed.closed
+        assert not self._input_threads(), "threads survived close()"
+        with pytest.raises(queue_mod.Empty):
+            feed._queue.get_nowait()      # error sentinel was drained
+
+    def test_close_during_inflight_place_exception(self):
+        """Same contract for an assembly (place) failure pending in the
+        worker pool: close() swallows it, threads exit."""
+        def place(x):
+            if int(x[0]) >= 1:
+                raise ValueError("bad assembly in flight")
+            return x
+
+        feed = PrefetchIterator(_ints(10), place=place, depth=3,
+                                name="placeclose")
+        next(feed)                        # batch 0 was fine
+        time.sleep(0.1)                   # failing futures queue up
+        feed.close()                      # no raise
+        assert feed.closed
+        assert not self._input_threads()
+
     def test_exhaustion_closes(self):
         feed = PrefetchIterator(_ints(3), depth=4)
         assert [int(b[0]) for b in feed] == [0, 1, 2]

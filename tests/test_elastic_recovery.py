@@ -1,0 +1,399 @@
+"""Driver-side failure handling without started services: heartbeat
+death detection, quarantine-with-decay, discovery-script robustness and
+the watchdog/exit edge cases — everything on fake clocks or direct
+``_handle`` calls, so this file stays tier-1 (the full threaded-driver
+suites are ``slow``-marked in test_elastic_driver.py).
+"""
+
+import os
+import subprocess
+
+import pytest
+
+from horovod_tpu.elastic.discovery import (
+    FixedHosts,
+    HostDiscoveryScript,
+    HostManager,
+    HostQuarantine,
+    HostUpdateResult,
+)
+from horovod_tpu.elastic.driver import ElasticDriver
+from horovod_tpu.elastic.health import HealthMonitor
+from horovod_tpu.runner.network import HeartbeatRequest, WorkerReadyRequest
+from horovod_tpu.runtime.retry import RetryPolicy
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestHostQuarantine:
+    def make(self, clk, **kw):
+        kw.setdefault("base_s", 10.0)
+        kw.setdefault("max_s", 100.0)
+        kw.setdefault("probation_s", 30.0)
+        kw.setdefault("disabled", False)
+        return HostQuarantine(clock=clk, **kw)
+
+    def test_cooldown_grows_exponentially_and_caps(self):
+        clk = Clock()
+        q = self.make(clk)
+        assert q.record_failure("h") == 10.0
+        assert q.record_failure("h") == 20.0
+        assert q.record_failure("h") == 40.0
+        assert q.record_failure("h") == 80.0
+        assert q.record_failure("h") == 100.0     # capped at max_s
+
+    def test_excluded_during_cooldown_readmitted_after(self):
+        clk = Clock()
+        q = self.make(clk)
+        q.record_failure("h")
+        assert q.is_excluded("h")
+        clk.t = 9.9
+        assert q.is_excluded("h")
+        clk.t = 10.0
+        assert not q.is_excluded("h")             # probation readmission
+        assert q.status("h") == "probation"
+
+    def test_relapse_during_probation_doubles_cooldown(self):
+        clk = Clock()
+        q = self.make(clk)
+        q.record_failure("h")                     # cooldown 10
+        clk.t = 10.0
+        assert not q.is_excluded("h")             # on probation
+        clk.t = 15.0
+        assert q.record_failure("h") == 20.0      # relapse: doubled
+        assert q.is_excluded("h")
+        clk.t = 34.9
+        assert q.is_excluded("h")
+        clk.t = 35.0
+        assert not q.is_excluded("h")
+
+    def test_surviving_probation_clears_record(self):
+        clk = Clock()
+        q = self.make(clk)
+        q.record_failure("h")
+        clk.t = 10.0
+        assert not q.is_excluded("h")             # probation starts
+        clk.t = 40.0                              # 30 s survived
+        assert not q.is_excluded("h")
+        assert q.status("h") is None              # full standing again
+        # next failure starts the ladder over
+        assert q.record_failure("h") == 10.0
+
+    def test_disabled_means_permanent(self):
+        clk = Clock()
+        q = self.make(clk, disabled=True)
+        q.record_failure("h")
+        clk.t = 1e12
+        assert q.is_excluded("h")
+
+    def test_remaining_s(self):
+        clk = Clock()
+        q = self.make(clk)
+        q.record_failure("h")
+        clk.t = 4.0
+        assert q.remaining_s("h") == pytest.approx(6.0)
+        assert q.remaining_s("other") == 0.0
+
+    def test_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_QUARANTINE_BASE_S", "3")
+        monkeypatch.setenv("HOROVOD_QUARANTINE_MAX_S", "9")
+        monkeypatch.setenv("HOROVOD_QUARANTINE_PROBATION_S", "5")
+        q = HostQuarantine()
+        assert (q.base_s, q.max_s, q.probation_s) == (3.0, 9.0, 5.0)
+        monkeypatch.setenv("HOROVOD_QUARANTINE_DISABLE", "1")
+        assert HostQuarantine().disabled
+
+
+class TestHostManagerQuarantine:
+    def make(self, hosts, clk):
+        disc = FixedHosts(hosts)
+        hm = HostManager(disc, quarantine=HostQuarantine(
+            base_s=10.0, max_s=100.0, probation_s=30.0, disabled=False,
+            clock=clk))
+        hm.update_available_hosts()
+        return disc, hm
+
+    def test_flapping_host_excluded_then_readmitted(self):
+        """The acceptance scenario: a quarantined flapping host is out
+        of assignment during cooldown and readmitted after probation;
+        the permanent blacklist stays available alongside."""
+        clk = Clock()
+        disc, hm = self.make({"h1": 2, "h2": 2}, clk)
+        hm.quarantine("h2")
+        # immediately out of the pool (no discovery pass needed)
+        assert hm.current_hosts == {"h1": 2}
+        assert hm.available_slots == 2
+        assert hm.is_blacklisted("h2")            # "excluded now"
+        # discovery keeps reporting it; quarantine keeps filtering it
+        assert hm.update_available_hosts() == HostUpdateResult.no_update
+        assert hm.current_hosts == {"h1": 2}
+        # cooldown expires -> the next pass readmits it as an "added"
+        clk.t = 10.0
+        assert not hm.is_blacklisted("h2")
+        assert hm.update_available_hosts() == HostUpdateResult.added
+        assert hm.current_hosts == {"h1": 2, "h2": 2}
+        # probation survived -> record cleared entirely
+        clk.t = 50.0
+        hm.update_available_hosts()
+        assert hm.host_quarantine.status("h2") is None
+
+    def test_relapsing_host_cooldown_grows(self):
+        clk = Clock()
+        disc, hm = self.make({"h1": 1, "h2": 1}, clk)
+        assert hm.quarantine("h2") == 10.0
+        clk.t = 12.0                              # readmitted, probation
+        hm.update_available_hosts()
+        assert "h2" in hm.current_hosts
+        assert hm.quarantine("h2") == 20.0        # relapse: doubled
+        hm.update_available_hosts()
+        assert "h2" not in hm.current_hosts
+
+    def test_permanent_blacklist_never_readmits(self):
+        clk = Clock()
+        disc, hm = self.make({"h1": 1, "h2": 1}, clk)
+        hm.blacklist("h2")
+        clk.t = 1e12
+        hm.update_available_hosts()
+        assert "h2" not in hm.current_hosts
+        assert hm.is_blacklisted("h2")
+
+    def test_readmission_preserves_stable_order_append(self):
+        clk = Clock()
+        disc, hm = self.make({"h1": 1, "h2": 1, "h3": 1}, clk)
+        assert hm.assignment_order == ["h1", "h2", "h3"]
+        hm.quarantine("h1")
+        hm.update_available_hosts()
+        assert hm.assignment_order == ["h2", "h3"]
+        clk.t = 10.0
+        hm.update_available_hosts()
+        # rejoins at the END: surviving hosts keep their rank positions
+        assert hm.assignment_order == ["h2", "h3", "h1"]
+
+
+class TestDiscoveryScriptRobustness:
+    def fast_retry(self, attempts=1):
+        return RetryPolicy(max_attempts=attempts, base_s=0.01, max_s=0.01,
+                           deadline_s=5.0, sleep=lambda s: None,
+                           retry_on=(subprocess.CalledProcessError,
+                                     subprocess.TimeoutExpired, OSError),
+                           name="t")
+
+    def test_failure_retains_last_good_set(self, tmp_path):
+        flag = tmp_path / "fail"
+        script = (f"if [ -e {flag} ]; then exit 3; "
+                  f"else echo h1:2; echo h2:4; fi")
+        d = HostDiscoveryScript(script, retry=self.fast_retry())
+        assert d.find_available_hosts_and_slots() == {"h1": 2, "h2": 4}
+        flag.touch()                              # script starts failing
+        assert d.find_available_hosts_and_slots() == {"h1": 2, "h2": 4}
+        assert d.consecutive_failures == 1
+        assert d.find_available_hosts_and_slots() == {"h1": 2, "h2": 4}
+        assert d.consecutive_failures == 2
+        flag.unlink()                             # script recovers
+        assert d.find_available_hosts_and_slots() == {"h1": 2, "h2": 4}
+        assert d.consecutive_failures == 0
+
+    def test_failure_with_no_prior_result_reports_empty(self):
+        d = HostDiscoveryScript("exit 5", retry=self.fast_retry())
+        assert d.find_available_hosts_and_slots() == {}
+        assert d.consecutive_failures == 1
+
+    def test_unparsable_output_is_absorbed(self, tmp_path):
+        flag = tmp_path / "garbage"
+        script = (f"if [ -e {flag} ]; then echo h1:notanumber; "
+                  f"else echo h1:2; fi")
+        d = HostDiscoveryScript(script, retry=self.fast_retry())
+        assert d.find_available_hosts_and_slots() == {"h1": 2}
+        flag.touch()
+        assert d.find_available_hosts_and_slots() == {"h1": 2}
+
+    def test_in_pass_retry_recovers_transient_failure(self, tmp_path):
+        # fails on the first invocation, succeeds on the second — the
+        # in-pass retry hides it entirely (no last-good fallback needed)
+        marker = tmp_path / "ran_once"
+        script = (f"if [ -e {marker} ]; then echo h1:2; "
+                  f"else touch {marker}; exit 1; fi")
+        d = HostDiscoveryScript(script, retry=self.fast_retry(attempts=2))
+        assert d.find_available_hosts_and_slots() == {"h1": 2}
+        assert d.consecutive_failures == 0
+
+    def test_default_slots_for_bare_hostnames(self):
+        d = HostDiscoveryScript("echo just-a-host", default_slots=3,
+                                retry=self.fast_retry())
+        assert d.find_available_hosts_and_slots() == {"just-a-host": 3}
+
+
+def make_driver(hosts, min_np=1, monkeypatch=None, clk=None, **kw):
+    """An ElasticDriver with NO started threads/services: discovery is
+    driven by hand, the coordinator address is stubbed (no real
+    coordination service), and the health monitor runs on a fake
+    clock via explicit ``check()`` calls."""
+    driver = ElasticDriver(
+        FixedHosts(hosts), min_np, timeout=5.0,
+        **kw)
+    if monkeypatch is not None:
+        monkeypatch.setattr(driver, "_new_coordinator_addr",
+                            lambda assignments: "127.0.0.1:1")
+    if clk is not None:
+        driver._health = HealthMonitor(
+            driver._on_worker_dead, interval_s=1.0, suspect_misses=2,
+            dead_s=5.0, clock=clk, start_thread=False)
+    driver._create_worker_fn = lambda slot, coord, gen, abort=None: 0
+    driver.host_manager.update_available_hosts()
+    with driver._lock:
+        driver._update_host_assignments()
+    return driver
+
+
+class TestDriverHeartbeatDeath:
+    def test_hang_detected_and_regenerated_before_exit(self, monkeypatch):
+        """The heartbeat-beats-exit acceptance scenario: the worker
+        process NEVER exits (no record_worker_exit from a launcher
+        thread), yet the driver declares it dead from silence alone,
+        quarantines its host and regenerates — and both ``detect_s``
+        and ``recovery_s`` appear in the driver log."""
+        from horovod_tpu.elastic import driver as driver_mod
+
+        lines = []
+
+        def grab(msg, *a):
+            lines.append(msg % a if a else msg)
+
+        monkeypatch.setattr(driver_mod.hvd_logging, "warning", grab)
+        monkeypatch.setattr(driver_mod.hvd_logging, "info", grab)
+        clk = Clock()
+        driver = make_driver({"h1": 1, "h2": 1}, min_np=1,
+                             monkeypatch=monkeypatch, clk=clk)
+        gen0 = driver.generation
+        driver._handle(HeartbeatRequest("h1", 0, 3))
+        driver._handle(HeartbeatRequest("h2", 0, 3))
+        clk.t = 4.0
+        driver._handle(HeartbeatRequest("h1", 0, 4))   # h1 alive; h2 silent
+        assert driver._health.check() == []            # not dead yet
+        clk.t = 5.0
+        assert driver._health.check() == [("h2", 0)]
+        # regeneration happened synchronously off the health verdict
+        assert driver.generation == gen0 + 1
+        assert driver.host_manager.is_blacklisted("h2")   # quarantined
+        assert driver.get_slot_info("h2", 0) is None
+        slot = driver.get_slot_info("h1", 0)
+        assert slot.rank == 0 and slot.size == 1
+        assert driver.last_detect_s == pytest.approx(5.0)
+        assert any("detect_s" in ln and "declared dead" in ln
+                   for ln in lines)
+        # survivor reports ready in the new generation -> recovery_s
+        # (with the detection latency) lands in the driver log
+        driver._handle(WorkerReadyRequest("h1", 0))
+        ready = [ln for ln in lines if "recovery_s" in ln]
+        assert ready and "detect_s" in ready[-1]
+        driver.stop(0)
+
+    def test_step_progress_hang_detection(self, monkeypatch):
+        """A rank that keeps heartbeating but stops advancing its step
+        counter is declared hung through the progress watchdog."""
+        clk = Clock()
+        driver = make_driver({"h1": 1, "h2": 1}, min_np=1,
+                             monkeypatch=monkeypatch, clk=clk)
+        driver._health = HealthMonitor(
+            driver._on_worker_dead, interval_s=1.0, suspect_misses=2,
+            dead_s=1e9, progress_timeout_s=10.0, clock=clk,
+            start_thread=False)
+        gen0 = driver.generation
+        for t in range(22):
+            clk.t = float(t)
+            driver._handle(HeartbeatRequest("h1", 0, t))    # advancing
+            driver._handle(HeartbeatRequest("h2", 0, min(t, 5)))  # wedged
+            driver._health.check()
+            if driver.generation > gen0:
+                break
+        assert driver.generation == gen0 + 1
+        assert driver.get_slot_info("h2", 0) is None
+        assert driver.last_detect_reason == "no step progress (hung)"
+        driver.stop(0)
+
+
+class TestWorkerExitEdgeCases:
+    def test_exit_from_host_removed_by_discovery(self, monkeypatch):
+        """record_worker_exit for a worker whose host discovery already
+        removed: no KeyError, the host is NOT quarantined, and the
+        generation was bumped exactly once (by the removal)."""
+        driver = make_driver({"h1": 1, "h2": 1}, min_np=1,
+                             monkeypatch=monkeypatch)
+        gen0 = driver.generation
+        # discovery drops h2; the resume path recomputes assignments
+        driver._host_manager._discovery.set({"h1": 1})
+        driver.host_manager.update_available_hosts()
+        driver.resume()
+        assert driver.generation == gen0 + 1
+        assert driver.get_slot_info("h2", 0) is None
+        # the removed worker's (late) exit arrives afterwards
+        driver.record_worker_exit("h2", 0, 1)
+        assert not driver.host_manager.is_blacklisted("h2")
+        assert driver.generation == gen0 + 1      # no second bump
+        driver.stop(0)
+
+    def test_check_started_timeout_and_late_ready(self, monkeypatch):
+        """The startup watchdog fails a never-READY worker (quarantine +
+        regeneration); a READY/exit arriving late from that worker is
+        absorbed without resurrecting it."""
+        from horovod_tpu.elastic.registration import SPAWNED
+
+        driver = make_driver({"h1": 1, "h2": 1}, min_np=1,
+                             monkeypatch=monkeypatch)
+        gen0 = driver.generation
+        slot2 = driver.get_slot_info("h2", 0)
+        driver._registry.record_spawned("h1", 0)
+        driver._registry.record_spawned("h2", 0)
+        driver._registry.record_ready("h1", 0)
+        with driver._lock:
+            driver._spawn_tokens[("h2", 0)] = 1
+        assert driver.registry.get_state("h2", 0) == SPAWNED
+        driver._check_started(slot2, 1)           # watchdog fires
+        assert driver.host_manager.is_blacklisted("h2")
+        assert driver.generation == gen0 + 1
+        assert driver.get_slot_info("h2", 0) is None
+        # late READY from the failed worker: ignored, nothing regenerates
+        driver._handle(WorkerReadyRequest("h2", 0))
+        assert driver.get_slot_info("h2", 0) is None
+        assert driver.generation == gen0 + 1
+        # its real exit finally lands: ignored too (host excluded)
+        driver.record_worker_exit("h2", 0, 1)
+        assert driver.generation == gen0 + 1
+        driver.stop(0)
+
+    def test_check_started_noop_when_worker_became_ready(self,
+                                                         monkeypatch):
+        driver = make_driver({"h1": 1}, min_np=1, monkeypatch=monkeypatch)
+        slot = driver.get_slot_info("h1", 0)
+        driver._registry.record_spawned("h1", 0)
+        with driver._lock:
+            driver._spawn_tokens[("h1", 0)] = 1
+        driver._registry.record_ready("h1", 0)    # reported in time
+        driver._check_started(slot, 1)
+        assert not driver.host_manager.is_blacklisted("h1")
+        driver.stop(0)
+
+
+class TestHeartbeatWire:
+    def test_heartbeat_request_records_into_monitor(self, monkeypatch):
+        driver = make_driver({"h1": 1}, min_np=1, monkeypatch=monkeypatch,
+                             clk=Clock())
+        from horovod_tpu.runner.network import AckResponse
+
+        resp = driver._handle(HeartbeatRequest("h1", 0, 17))
+        assert isinstance(resp, AckResponse)
+        assert driver.health_monitor.max_step() == 17
+        driver.stop(0)
+
+    def test_worker_report_step_monotonic(self):
+        from horovod_tpu.elastic import worker
+
+        worker.report_step(5)
+        worker.report_step(3)                     # regression ignored
+        assert worker.current_step() >= 5

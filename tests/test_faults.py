@@ -1,0 +1,191 @@
+"""Fault-injection subsystem: plan grammar, determinism, actions and
+the zero-cost no-op contract (docs/faults.md)."""
+
+import subprocess
+import time
+
+import pytest
+
+from horovod_tpu import faults
+from horovod_tpu.faults import FaultPlan, WorkerCrash
+from horovod_tpu.faults.plan import _parse_clause
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+
+
+class TestGrammar:
+    def test_full_clause(self):
+        s = _parse_clause("worker.commit@5:raise(OSError)x3?0.25")
+        assert (s.site, s.at, s.action, s.arg, s.count, s.prob) == \
+            ("worker.commit", 5, "raise", "OSError", 3, 0.25)
+
+    def test_defaults(self):
+        s = _parse_clause("data.feed")
+        assert (s.site, s.at, s.action, s.arg, s.count, s.prob) == \
+            ("data.feed", 1, "raise", None, 1, 1.0)
+
+    def test_forever_count(self):
+        s = _parse_clause("a.b:delay(0.5)x*")
+        assert s.count == -1 and s.arg == "0.5"
+        assert s.covers(1) and s.covers(10 ** 6)
+
+    def test_plan_level_clauses(self):
+        p = FaultPlan.parse("seed=99; mode=sim; x.y@2:crash")
+        assert p.seed == 99 and p.sim is True
+        assert len(p.specs) == 1 and p.specs[0].at == 2
+
+    def test_bad_action_rejected(self):
+        with pytest.raises(ValueError):
+            FaultPlan.parse("x.y:frobnicate")
+
+    def test_bad_exception_name_rejected_at_fire(self):
+        p = FaultPlan.parse("x.y:raise(NoSuchError)")
+        with pytest.raises(ValueError, match="NoSuchError"):
+            p.inject("x.y")
+
+    def test_empty_clauses_ignored(self):
+        p = FaultPlan.parse(" ; x.y:crash ; ")
+        assert len(p.specs) == 1
+
+
+class TestFiring:
+    def test_fires_only_at_hit_window(self):
+        p = FaultPlan(sim=True).add("s", "raise", "OSError", at=3, count=2)
+        p.inject("s")
+        p.inject("s")
+        with pytest.raises(OSError):
+            p.inject("s")            # hit 3
+        with pytest.raises(OSError):
+            p.inject("s")            # hit 4
+        p.inject("s")                # hit 5: window closed
+        assert p.hits("s") == 5
+        assert [h for _, h, _ in p.fired] == [3, 4]
+
+    def test_sites_are_independent(self):
+        p = FaultPlan(sim=True).add("a", "raise", "OSError", at=1)
+        p.inject("b")
+        p.inject("b")
+        with pytest.raises(OSError):
+            p.inject("a")
+
+    def test_crash_sim_raises_worker_crash(self):
+        p = FaultPlan(sim=True).add("s", "crash", at=1)
+        with pytest.raises(WorkerCrash) as ei:
+            p.inject("s")
+        assert ei.value.code == 173 and ei.value.site == "s"
+        # BaseException: generic recovery handlers must not absorb it
+        assert not isinstance(ei.value, Exception)
+
+    def test_crash_process_mode_exits(self, tmp_path):
+        # real (non-sim) crash: os._exit with the configured code, in a
+        # subprocess so the suite survives
+        code = (
+            "from horovod_tpu.faults import FaultPlan\n"
+            "FaultPlan.parse('s:crash(7)').inject('s')\n")
+        import sys
+
+        r = subprocess.run([sys.executable, "-c", code],
+                           cwd="/root/repo", timeout=60)
+        assert r.returncode == 7
+
+    def test_delay_sleeps(self):
+        p = FaultPlan().add("s", "delay", "0.15", at=1)
+        t0 = time.perf_counter()
+        p.inject("s")
+        assert time.perf_counter() - t0 >= 0.14
+
+    def test_hang_is_cancellable(self):
+        p = FaultPlan().add("s", "hang", "30", at=1)
+        import threading
+
+        done = threading.Event()
+
+        def victim():
+            p.inject("s")
+            done.set()
+
+        t = threading.Thread(target=victim, daemon=True)
+        t.start()
+        assert not done.wait(0.2)     # genuinely blocked
+        p.cancel()
+        assert done.wait(5.0)
+
+    def test_value_action_returns_arg(self):
+        p = FaultPlan().add("s", "value", "flap", at=2)
+        assert p.inject("s") is None
+        assert p.inject("s") == "flap"
+
+    def test_subprocess_exceptions(self):
+        p = FaultPlan().add("a", "raise", "CalledProcessError") \
+                       .add("b", "raise", "TimeoutExpired")
+        with pytest.raises(subprocess.CalledProcessError):
+            p.inject("a")
+        with pytest.raises(subprocess.TimeoutExpired):
+            p.inject("b")
+
+
+class TestDeterminism:
+    def run_probabilistic(self, seed):
+        p = FaultPlan(seed=seed).add("s", "value", "hit", at=1, count=-1,
+                                     prob=0.5)
+        return [p.inject("s") is not None for _ in range(64)]
+
+    def test_same_seed_same_outcome(self):
+        assert self.run_probabilistic(7) == self.run_probabilistic(7)
+
+    def test_different_seed_different_outcome(self):
+        assert self.run_probabilistic(7) != self.run_probabilistic(8)
+
+    def test_draws_are_interleaving_independent(self):
+        # the (seed, site, hit) draw must not depend on what other
+        # sites did in between — thread interleavings cannot skew it
+        p1 = FaultPlan(seed=3).add("s", "value", "x", count=-1, prob=0.5)
+        r1 = [p1.inject("s") is not None for _ in range(16)]
+        p2 = FaultPlan(seed=3).add("s", "value", "x", count=-1, prob=0.5)
+        r2 = []
+        for _ in range(16):
+            p2.inject("other.site")       # extra traffic elsewhere
+            r2.append(p2.inject("s") is not None)
+        assert r1 == r2
+
+
+class TestProcessWidePlan:
+    def test_inject_is_noop_without_plan(self):
+        assert faults.inject("any.site") is None
+        assert faults.active_plan() is None
+
+    def test_set_and_clear(self):
+        p = FaultPlan(sim=True).add("s", "raise", "OSError")
+        faults.set_plan(p)
+        with pytest.raises(OSError):
+            faults.inject("s")
+        faults.clear_plan()
+        assert faults.inject("s") is None
+
+    def test_env_plan_loads(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FAULT_PLAN",
+                           "seed=5;mode=sim;x.y@2:raise(OSError)")
+        plan = faults.load_env_plan(force=True)
+        assert plan is not None and plan.seed == 5
+        assert faults.inject("x.y") is None
+        with pytest.raises(OSError):
+            faults.inject("x.y")
+
+    def test_explicit_plan_overrides_env(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FAULT_PLAN", "x.y:raise(OSError)")
+        faults.set_plan(None)             # explicit None wins over env
+        assert faults.inject("x.y") is None
+
+    def test_noop_inject_is_cheap(self):
+        # the no-plan hook sits on per-step/per-batch paths: it must be
+        # in the tens-of-nanoseconds class, not do parsing or locking
+        t0 = time.perf_counter()
+        for _ in range(100_000):
+            faults.inject("hot.site")
+        per_call = (time.perf_counter() - t0) / 100_000
+        assert per_call < 5e-6

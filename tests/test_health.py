@@ -1,0 +1,187 @@
+"""Heartbeat health monitoring (elastic/health.py) and the step-progress
+watchdog primitive (utils/stall.py ProgressWatchdog) — all on fake
+clocks, fully deterministic."""
+
+import pytest
+
+from horovod_tpu.elastic.health import HealthMonitor
+from horovod_tpu.utils.stall import ProgressWatchdog
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_monitor(clock, deaths, **kw):
+    kw.setdefault("interval_s", 1.0)
+    kw.setdefault("suspect_misses", 3)
+    kw.setdefault("dead_s", 10.0)
+    return HealthMonitor(
+        lambda h, lr, d, r: deaths.append((h, lr, d, r)),
+        clock=clock, start_thread=False, **kw)
+
+
+class TestProgressWatchdog:
+    def test_never_updated_is_not_stalled(self):
+        clk = Clock()
+        w = ProgressWatchdog(clock=clk)
+        clk.t = 100.0
+        assert w.stalled_for() == 0.0
+
+    def test_advance_resets_stall_clock(self):
+        clk = Clock()
+        w = ProgressWatchdog(clock=clk)
+        w.update(1)
+        clk.t = 5.0
+        assert w.stalled_for() == 5.0
+        w.update(2)
+        assert w.stalled_for() == 0.0
+
+    def test_repeated_or_regressed_value_is_not_progress(self):
+        clk = Clock()
+        w = ProgressWatchdog(clock=clk)
+        w.update(5)
+        clk.t = 7.0
+        w.update(5)          # same value: still stalled
+        assert w.stalled_for() == 7.0
+        w.update(3)          # regression: not progress either
+        assert w.stalled_for() == 7.0
+        assert w.value == 5
+
+
+class TestLiveness:
+    def test_healthy_worker_never_declared(self):
+        clk, deaths = Clock(), []
+        mon = make_monitor(clk, deaths)
+        for t in range(30):
+            clk.t = float(t)
+            mon.record_heartbeat("h1", 0, step=t)
+            mon.check()
+        assert deaths == []
+
+    def test_silent_worker_suspect_then_dead(self, monkeypatch):
+        # the hvd logger sets propagate=False, so caplog can't see it;
+        # intercept at the module seam instead
+        from horovod_tpu.elastic import health as health_mod
+
+        warnings = []
+        monkeypatch.setattr(
+            health_mod.hvd_logging, "warning",
+            lambda msg, *a: warnings.append(msg % a if a else msg))
+        clk, deaths = Clock(), []
+        mon = make_monitor(clk, deaths, interval_s=1.0,
+                           suspect_misses=3, dead_s=10.0)
+        mon.record_heartbeat("h1", 0, step=1)
+        clk.t = 2.0
+        assert mon.check() == []          # 2 missed: not yet suspect
+        clk.t = 3.5
+        assert mon.check() == []          # suspect now, still alive
+        assert any("suspect" in w for w in warnings)
+        clk.t = 9.9
+        assert mon.check() == []
+        clk.t = 10.0
+        assert mon.check() == [("h1", 0)]
+        assert deaths == [("h1", 0, 10.0, "missed heartbeats")]
+        # declared once: the entry is gone, no repeat verdicts
+        clk.t = 20.0
+        assert mon.check() == []
+
+    def test_resumed_heartbeat_clears_suspect(self):
+        clk, deaths = Clock(), []
+        mon = make_monitor(clk, deaths)
+        mon.record_heartbeat("h1", 0)
+        clk.t = 4.0
+        mon.check()                       # suspect
+        mon.record_heartbeat("h1", 0)     # worker came back
+        clk.t = 9.0                       # 5 s after the resumed beat
+        assert mon.check() == []
+        assert deaths == []
+
+    def test_detect_s_is_silence_span(self):
+        clk, deaths = Clock(), []
+        mon = make_monitor(clk, deaths, dead_s=6.0)
+        clk.t = 100.0
+        mon.record_heartbeat("h1", 0)
+        clk.t = 109.5
+        mon.check()
+        assert deaths[0][2] == pytest.approx(9.5)
+
+    def test_disabled_monitor_is_inert(self):
+        clk, deaths = Clock(), []
+        mon = make_monitor(clk, deaths, interval_s=0.0)
+        assert not mon.enabled
+        mon.record_heartbeat("h1", 0)
+        clk.t = 1e6
+        assert mon.check() == []
+
+
+class TestProgress:
+    def test_beating_but_stuck_worker_declared_hung(self):
+        clk, deaths = Clock(), []
+        mon = make_monitor(clk, deaths, dead_s=1e9,
+                           progress_timeout_s=20.0)
+        for t in range(5):
+            clk.t = float(t)
+            mon.record_heartbeat("h1", 0, step=t)   # advancing: healthy
+        for t in range(5, 26):
+            clk.t = float(t)
+            mon.record_heartbeat("h1", 0, step=4)   # beats go on, step stuck
+            mon.check()
+            if deaths:
+                break
+        assert deaths and deaths[0][3] == "no step progress (hung)"
+        # detect_s: stagnation span since the last step advance (t=4)
+        assert deaths[0][2] >= 20.0
+
+    def test_progress_detector_off_by_default(self):
+        clk, deaths = Clock(), []
+        mon = make_monitor(clk, deaths, dead_s=1e9)
+        for t in range(0, 10 ** 4, 100):
+            clk.t = float(t)
+            mon.record_heartbeat("h1", 0, step=1)
+            mon.check()
+        assert deaths == []
+
+
+class TestBookkeeping:
+    def test_purge_drops_unassigned(self):
+        clk, deaths = Clock(), []
+        mon = make_monitor(clk, deaths)
+        mon.record_heartbeat("h1", 0)
+        mon.record_heartbeat("h2", 0)
+        mon.purge({("h1", 0)})
+        clk.t = 100.0
+        assert mon.check() == [("h1", 0)]     # h2 was purged, not declared
+
+    def test_forget(self):
+        clk, deaths = Clock(), []
+        mon = make_monitor(clk, deaths)
+        mon.record_heartbeat("h1", 0)
+        mon.forget("h1", 0)
+        clk.t = 100.0
+        assert mon.check() == []
+
+    def test_max_step(self):
+        clk, deaths = Clock(), []
+        mon = make_monitor(clk, deaths)
+        assert mon.max_step() == -1
+        mon.record_heartbeat("h1", 0, step=7)
+        mon.record_heartbeat("h2", 0, step=12)
+        assert mon.max_step() == 12
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_ELASTIC_HEARTBEAT_INTERVAL", "0.5")
+        monkeypatch.setenv("HOROVOD_ELASTIC_HEARTBEAT_SUSPECT_MISSES", "4")
+        monkeypatch.setenv("HOROVOD_ELASTIC_HEARTBEAT_DEAD_S", "7.5")
+        monkeypatch.setenv("HOROVOD_ELASTIC_PROGRESS_TIMEOUT_S", "33")
+        mon = HealthMonitor.from_env(lambda *a: None)
+        assert (mon.interval_s, mon.suspect_misses, mon.dead_s,
+                mon.progress_timeout_s) == (0.5, 4, 7.5, 33.0)
+
+    def test_dead_s_defaults_to_ten_intervals(self):
+        mon = make_monitor(Clock(), [], interval_s=2.0, dead_s=None)
+        assert mon.dead_s == 20.0

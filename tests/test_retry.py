@@ -1,0 +1,117 @@
+"""Unified retry policy: backoff shape, jitter determinism, deadline and
+selective retryability (runtime/retry.py)."""
+
+import pytest
+
+from horovod_tpu.runtime.retry import RetryPolicy, retry_call
+
+
+def make_policy(**kw):
+    kw.setdefault("sleep", lambda s: None)
+    kw.setdefault("max_attempts", 4)
+    kw.setdefault("base_s", 0.1)
+    kw.setdefault("max_s", 5.0)
+    kw.setdefault("deadline_s", 60.0)
+    return RetryPolicy(**kw)
+
+
+class Flaky:
+    def __init__(self, failures, exc=OSError):
+        self.failures = failures
+        self.exc = exc
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise self.exc(f"transient #{self.calls}")
+        return "ok"
+
+
+class TestBackoff:
+    def test_exponential_growth_without_jitter(self):
+        p = make_policy(jitter=False, base_s=0.1, max_s=10.0)
+        assert [p.backoff_s(a) for a in range(4)] == \
+            [0.1, 0.2, 0.4, 0.8]
+
+    def test_cap(self):
+        p = make_policy(jitter=False, base_s=1.0, max_s=3.0)
+        assert p.backoff_s(10) == 3.0
+
+    def test_full_jitter_bounds_and_seed_determinism(self):
+        a = make_policy(jitter=True, seed=11, base_s=0.5, max_s=4.0)
+        b = make_policy(jitter=True, seed=11, base_s=0.5, max_s=4.0)
+        sa = [a.backoff_s(i) for i in range(8)]
+        sb = [b.backoff_s(i) for i in range(8)]
+        assert sa == sb                       # seeded → reproducible
+        for i, s in enumerate(sa):
+            assert 0.0 <= s <= min(4.0, 0.5 * 2 ** i)
+        assert len(set(sa)) > 1               # actually jittered
+
+    def test_env_defaults(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_RETRY_MAX_ATTEMPTS", "9")
+        monkeypatch.setenv("HOROVOD_RETRY_BASE_S", "0.25")
+        monkeypatch.setenv("HOROVOD_RETRY_MAX_S", "2.5")
+        monkeypatch.setenv("HOROVOD_RETRY_DEADLINE_S", "12")
+        monkeypatch.setenv("HOROVOD_RETRY_JITTER", "0")
+        p = RetryPolicy()
+        assert (p.max_attempts, p.base_s, p.max_s, p.deadline_s,
+                p.jitter) == (9, 0.25, 2.5, 12.0, False)
+
+
+class TestCall:
+    def test_succeeds_after_transient_failures(self):
+        fn = Flaky(2)
+        assert make_policy().call(fn) == "ok"
+        assert fn.calls == 3
+
+    def test_exhausts_attempts_and_reraises_last(self):
+        fn = Flaky(99)
+        with pytest.raises(OSError, match="transient #4"):
+            make_policy(max_attempts=4).call(fn)
+        assert fn.calls == 4
+
+    def test_non_retryable_raises_immediately(self):
+        fn = Flaky(99, exc=ValueError)
+        with pytest.raises(ValueError):
+            make_policy(retry_on=(OSError,)).call(fn)
+        assert fn.calls == 1
+
+    def test_custom_retry_on(self):
+        fn = Flaky(1, exc=ValueError)
+        assert make_policy(retry_on=(ValueError,)).call(fn) == "ok"
+
+    def test_deadline_stops_retrying(self):
+        # fake clock: each attempt "takes" 10 s; deadline 25 s admits
+        # attempts at t=0, 10, 20 and refuses the sleep past 25
+        t = [0.0]
+
+        def clock():
+            t[0] += 10.0
+            return t[0]
+
+        fn = Flaky(99)
+        with pytest.raises(OSError):
+            make_policy(max_attempts=10, jitter=False, base_s=1.0,
+                        deadline_s=25.0, clock=clock).call(fn)
+        assert fn.calls < 10
+
+    def test_zero_deadline_means_no_deadline(self):
+        fn = Flaky(3)
+        assert make_policy(max_attempts=5, deadline_s=0.0).call(fn) == "ok"
+
+    def test_sleeps_between_attempts(self):
+        slept = []
+        p = make_policy(jitter=False, base_s=0.1, max_s=5.0,
+                        max_attempts=4, sleep=slept.append)
+        with pytest.raises(OSError):
+            p.call(Flaky(99))
+        assert slept == [0.1, 0.2, 0.4]    # no sleep after the last try
+
+    def test_retry_call_convenience(self):
+        fn = Flaky(1)
+        assert retry_call(fn, name="t") == "ok"
+
+    def test_min_one_attempt(self):
+        p = make_policy(max_attempts=0)
+        assert p.max_attempts == 1
