@@ -1,0 +1,41 @@
+"""hvdlint: distributed-correctness static analysis for horovod_tpu.
+
+The compile-time half of the repo's correctness tooling (docs/
+analysis.md): an AST-based rule engine that finds the bug classes the
+paper's runtime controller policed dynamically — rank-divergent
+collectives (HVD001), host syncs in jitted bodies (HVD002), retrace/
+warm-start-miss hazards (HVD003), unlocked cross-thread mutations and
+lock-order inversions (HVD004), undeclared/undocumented env knobs
+(HVD005), chaos-hook coverage rot (HVD006) — plus an offline HLO/
+bench-artifact rule pack (:mod:`~horovod_tpu.analysis.hlo_lint`).
+
+The package self-run is a tier-1 test (``tests/test_analysis.py``)::
+
+    python -m horovod_tpu.analysis horovod_tpu/
+    python -m horovod_tpu.analysis --changed --json
+    python -m horovod_tpu.analysis --artifact BENCH_r05.json
+
+The rule engine is AST-only and never imports the analyzed code, so a
+module that cannot import (missing optional dep, syntax error) can
+still be linted.
+"""
+
+from horovod_tpu.analysis.engine import (
+    Finding,
+    Report,
+    Rule,
+    Severity,
+    default_rules,
+    run_analysis,
+    write_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "Report",
+    "Rule",
+    "Severity",
+    "default_rules",
+    "run_analysis",
+    "write_baseline",
+]

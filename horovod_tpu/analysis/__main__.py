@@ -1,0 +1,161 @@
+"""``python -m horovod_tpu.analysis`` — the hvdlint CLI.
+
+Usage::
+
+    python -m horovod_tpu.analysis horovod_tpu/          # full scan
+    python -m horovod_tpu.analysis --changed             # git-diff scope
+    python -m horovod_tpu.analysis --json horovod_tpu/   # machine output
+    python -m horovod_tpu.analysis --hlo dump.txt        # HLO rule pack
+    python -m horovod_tpu.analysis --artifact BENCH.json # bench artifact
+    python -m horovod_tpu.analysis --write-baseline ...  # accept findings
+
+Exit codes: 0 clean, 1 findings, 2 usage/environment error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from horovod_tpu.analysis import engine
+from horovod_tpu.analysis import hlo_lint
+
+DEFAULT_BASELINE = "analysis_baseline.json"
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis",
+        description="hvdlint: distributed-correctness static analysis "
+                    "for horovod_tpu (rules HVD001-HVD006; see "
+                    "docs/analysis.md)")
+    p.add_argument("paths", nargs="*",
+                   help="files or directories to lint (default: the "
+                        "horovod_tpu package next to the repo root)")
+    p.add_argument("--changed", action="store_true",
+                   help="lint only files changed vs HEAD (staged + "
+                        "unstaged + untracked)")
+    p.add_argument("--json", action="store_true", dest="json_out",
+                   help="emit one JSON object instead of text")
+    p.add_argument("--select", default=None, metavar="RULES",
+                   help="comma-separated rule ids to run (e.g. "
+                        "HVD001,HVD004)")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help=f"baseline file (default: <repo>/"
+                        f"{DEFAULT_BASELINE} when present)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings to the baseline "
+                        "file and exit 0")
+    p.add_argument("--hlo", action="append", default=[], metavar="PATH",
+                   help="lint an HLO text dump with the HLO rule pack "
+                        "(repeatable)")
+    p.add_argument("--artifact", action="append", default=[],
+                   metavar="PATH",
+                   help="lint a bench --json-out artifact with the HLO "
+                        "rule pack (repeatable)")
+    p.add_argument("--expect-hierarchy", default=None,
+                   choices=("flat", "two_level"),
+                   help="assert the exchange topology when linting "
+                        "--hlo dumps")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    return p
+
+
+def _list_rules() -> int:
+    for rule in engine.default_rules():
+        print(f"{rule.id}  [{rule.severity}]  {rule.name}")
+        print(f"        {rule.rationale}")
+    print("HLO001-HLO004  (offline HLO/artifact rule pack; "
+          "--hlo/--artifact)")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        return _list_rules()
+
+    t0 = time.perf_counter()
+    hlo_findings = []
+    try:
+        if args.hlo:
+            hlo_findings.extend(hlo_lint.lint_paths(
+                args.hlo, expect_hierarchy=args.expect_hierarchy))
+        if args.artifact:
+            for p in args.artifact:
+                hlo_findings.extend(hlo_lint.lint_artifact_path(p))
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"hvdlint: cannot read artifact: {e}", file=sys.stderr)
+        return 2
+
+    report = None
+    if args.paths or args.changed or not (args.hlo or args.artifact):
+        paths = list(args.paths)
+        repo_root = engine.find_repo_root(
+            paths[0] if paths else os.getcwd()) or os.getcwd()
+        if args.changed:
+            try:
+                changed = engine.changed_files(repo_root)
+            except Exception as e:     # noqa: BLE001 — not a git tree
+                print(f"hvdlint: --changed needs a git checkout: {e}",
+                      file=sys.stderr)
+                return 2
+            scope = [os.path.abspath(p) for p in paths] if paths else None
+            paths = [f for f in changed
+                     if scope is None
+                     or any(os.path.abspath(f).startswith(s + os.sep)
+                            or os.path.abspath(f) == s for s in scope)]
+            if not paths:
+                print("hvdlint: no changed Python files in scope")
+        elif not paths:
+            default_pkg = os.path.join(repo_root, "horovod_tpu")
+            if not os.path.isdir(default_pkg):
+                print("hvdlint: no paths given and no horovod_tpu/ "
+                      "package found", file=sys.stderr)
+                return 2
+            paths = [default_pkg]
+        baseline = args.baseline or os.path.join(repo_root,
+                                                 DEFAULT_BASELINE)
+        select = {r.strip() for r in args.select.split(",")} \
+            if args.select else None
+        report = engine.run_analysis(paths, select=select,
+                                     baseline_path=baseline,
+                                     root=repo_root)
+        if args.write_baseline:
+            engine.write_baseline(baseline, report.findings)
+            print(f"hvdlint: wrote {len(report.findings)} finding(s) to "
+                  f"{baseline}")
+            return 0
+
+    elapsed = time.perf_counter() - t0
+    if args.json_out:
+        out = report.as_json() if report is not None else \
+            {"files_scanned": 0, "findings": [], "suppressed": [],
+             "baselined": []}
+        out["hlo_findings"] = [f.as_json() for f in hlo_findings]
+        out["elapsed_s"] = round(elapsed, 3)
+        print(json.dumps(out, indent=2))
+    else:
+        for f in hlo_findings:
+            print(f.format())
+        if report is not None:
+            for f in report.findings:
+                print(f.format())
+            print(f"hvdlint: {report.files_scanned} file(s), "
+                  f"{len(report.findings)} finding(s), "
+                  f"{len(report.suppressed)} suppressed, "
+                  f"{len(report.baselined)} baselined "
+                  f"in {elapsed:.2f}s")
+
+    failed = bool(hlo_findings) or \
+        (report is not None and report.exit_code != 0)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,174 @@
+"""Shared AST plumbing for the ``hvdlint`` rules.
+
+Every rule needs the same three capabilities: resolving a call/decorator
+to a dotted name (``jax.jit``, ``threading.Thread``), walking *upward*
+(is this collective call inside a rank-dependent branch? is this
+mutation under ``with self._lock``?), and mapping functions to the
+functions they reference (thread targets, jit-wrapped defs).  They live
+here so the rule modules stay readable statements of their invariant.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains; for a Call, the callee's
+    dotted name.  ``None`` for anything dynamic (subscripts, calls in
+    the middle of the chain)."""
+    if isinstance(node, ast.Call):
+        return dotted_name(node.func)
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return None if base is None else f"{base}.{node.attr}"
+    return None
+
+
+def name_tail(node: ast.AST) -> Optional[str]:
+    """The last segment of a dotted name (``jit`` for ``jax.jit``)."""
+    d = dotted_name(node)
+    return None if d is None else d.rsplit(".", 1)[-1]
+
+
+class ParentMap:
+    """child → parent links for one module tree, so rules can walk
+    upward (ancestor If/With/FunctionDef) from any node."""
+
+    def __init__(self, tree: ast.AST):
+        self._parent: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parent[child] = parent
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parent.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self._parent.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parent.get(cur)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return a
+        return None
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        for a in self.ancestors(node):
+            if isinstance(a, ast.ClassDef):
+                return a
+        return None
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """``x`` when ``node`` is exactly ``self.x``, else ``None``."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def assign_targets(stmt: ast.AST) -> List[ast.AST]:
+    """The target expressions a statement writes to (Assign/AugAssign/
+    AnnAssign, tuple targets flattened)."""
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    else:
+        return []
+    flat: List[ast.AST] = []
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            flat.extend(t.elts)
+        else:
+            flat.append(t)
+    return flat
+
+
+def iter_self_attr_stores(fn: ast.AST) -> Iterator[Tuple[str, ast.AST]]:
+    """``(attr, node)`` for every ``self.attr = ...`` (or aug/ann
+    assign) anywhere inside ``fn``, including nested functions."""
+    for node in ast.walk(fn):
+        for target in assign_targets(node):
+            attr = self_attr(target)
+            if attr is not None:
+                yield attr, node
+
+
+_LOCKISH = ("lock", "mutex", "cond")
+
+
+def is_lock_expr(expr: ast.AST) -> Optional[str]:
+    """A ``with`` context expression that is a lock by naming
+    convention: ``self._lock`` / a bare ``lock`` name / ``x.lock`` —
+    returns the lock's attribute/bare name, else None."""
+    name = self_attr(expr)
+    if name is None:
+        if isinstance(expr, ast.Name):
+            name = expr.id
+        elif isinstance(expr, ast.Attribute):
+            name = expr.attr
+        elif isinstance(expr, ast.Call):
+            # ``with self._lock:`` is the idiom; ``with self._lock.acquire()``
+            # style does not exist here, but ``with lock_for(x):`` might
+            return is_lock_expr(expr.func)
+    if name is None:
+        return None
+    low = name.lower()
+    return name if any(k in low for k in _LOCKISH) else None
+
+
+def with_lock_names(node: ast.With) -> List[str]:
+    out = []
+    for item in node.items:
+        n = is_lock_expr(item.context_expr)
+        if n is not None:
+            out.append(n)
+    return out
+
+
+def under_lock(node: ast.AST, parents: ParentMap) -> bool:
+    """Is ``node`` lexically inside a ``with <lock>:`` block (within its
+    own function — a lock held by a *caller* is invisible here, which is
+    exactly the discipline the rule wants to enforce: mutations of
+    shared state should sit visibly under the class's declared lock)."""
+    fn = parents.enclosing_function(node)
+    for a in parents.ancestors(node):
+        if a is fn:
+            break
+        if isinstance(a, ast.With) and with_lock_names(a):
+            return True
+    return False
+
+
+def local_functions(fn: ast.AST) -> Dict[str, ast.FunctionDef]:
+    """Nested ``def``s of a function body, by name (one level)."""
+    out = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.FunctionDef) and node is not fn:
+            out.setdefault(node.name, node)
+    return out
+
+
+def called_names(fn: ast.AST) -> List[str]:
+    """Dotted names of every call inside ``fn``."""
+    out = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            if d is not None:
+                out.append(d)
+    return out
+
+
+def str_constants(tree: ast.AST) -> Iterator[Tuple[str, ast.Constant]]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node.value, node

@@ -1,0 +1,204 @@
+"""Offline HLO/artifact lint: the compiled-collective guards as a rule
+pack.
+
+``tests/test_hlo_guards.py`` pins the exchange structure by lowering
+the real train step — which needs a JAX install and a compile.  This
+module promotes the *invariants* those guards assert into rules that
+run against artifacts that already exist on disk:
+
+* an **HLO text dump** (``step.compiled_text(...)`` saved to a file,
+  or any ``--xla_dump_to`` module): full structural checks;
+* a **bench JSON artifact** (``bench.py --json-out``): the collective
+  structure fields the overlap probe embeds (``exchange_rs_scopes``,
+  ``exchange_hierarchy``, ``*_grad_sized_allreduces``), so a
+  MULTICHIP/BENCH artifact from a real pod can be linted on a laptop
+  without recompiling anything.
+
+Rules (shared ids with the docs table):
+
+=========  ==============================================================
+HLO001     gradient-sized all-reduce in a sharded-exchange module (the
+           silent de-fusion/regression-to-allreduce the ZeRO path bans)
+HLO002     async ``-start`` without matching ``-done`` (broken pairing
+           loses the latency hiding the scheduler provides)
+HLO003     two-level exchange without a low-precision (s8/u8/fp8) DCN
+           hop — the cross-slice phase is paying full-width wire bytes
+HLO004     artifact structure: hierarchy says two_level but the scope
+           set isn't two distinct scopes (or flat with >1 scope)
+=========  ==============================================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence
+
+from horovod_tpu.utils import hlo as H
+
+_SCALAR_MAX_BYTES = 256     # "gradient-sized" = anything bigger than this
+_LOW_PRECISION = {"s8", "u8", "f8e4m3fn", "f8e5m2"}
+
+
+@dataclasses.dataclass(frozen=True)
+class HloFinding:
+    rule: str
+    message: str
+    detail: str = ""
+
+    def format(self) -> str:
+        d = f" ({self.detail})" if self.detail else ""
+        return f"{self.rule}: {self.message}{d}"
+
+    def as_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def lint_hlo_text(text: str,
+                  expect_hierarchy: Optional[str] = None,
+                  grad_bytes: Optional[int] = None) -> List[HloFinding]:
+    """Structural lint of one optimized-HLO module dump.
+
+    ``grad_bytes`` (when known) sharpens HLO001 to "all-reduce >= the
+    gradient payload"; without it any non-scalar all-reduce in a module
+    that also reduce-scatters counts.  ``expect_hierarchy`` enables the
+    two-level checks (scope count, int8 DCN hop)."""
+    findings: List[HloFinding] = []
+    ops = H.collective_ops(text)
+    kinds = H.count_by_kind(ops)
+
+    # HLO001 — the sharded exchange must never fall back to a
+    # gradient-sized all-reduce (same math, 2x optimizer FLOPs + N x
+    # state memory on a real pod; invisible to numerics tests)
+    if kinds.get("reduce-scatter", 0) >= 1:
+        threshold = grad_bytes if grad_bytes is not None \
+            else _SCALAR_MAX_BYTES
+        offenders = [o for o in ops if o.kind == "all-reduce"
+                     and o.bytes >= threshold]
+        if grad_bytes is None:
+            offenders = [o for o in offenders
+                         if o.bytes > _SCALAR_MAX_BYTES]
+        for o in offenders:
+            findings.append(HloFinding(
+                "HLO001",
+                f"gradient-sized all-reduce ({o.bytes} bytes) in a "
+                f"module that reduce-scatters — the sharded exchange "
+                f"regressed to allreduce",
+                detail=o.line[:160]))
+
+    # HLO002 — every -start must close with a -done
+    for kind in ("all-reduce", "reduce-scatter", "all-gather",
+                 "collective-permute"):
+        starts = text.count(f"{kind}-start(")
+        dones = text.count(f"{kind}-done(")
+        if starts != dones:
+            findings.append(HloFinding(
+                "HLO002",
+                f"async pairing broken for {kind}: {starts} -start vs "
+                f"{dones} -done"))
+
+    # HLO003 — the two-level exchange's cross-slice hop must be
+    # low-precision (the int8 DCN wire PR 2 introduced)
+    scopes = H.scopes_by_kind(ops)
+    rs_scopes = scopes.get("reduce-scatter", ())
+    if expect_hierarchy == "two_level":
+        distinct = [s for s in rs_scopes if s is not None]
+        if len(distinct) < 2:
+            findings.append(HloFinding(
+                "HLO004",
+                f"hierarchy=two_level but reduce-scatter scopes are "
+                f"{rs_scopes} — expected two distinct scopes (ici + "
+                f"dcn); the exchange compiled flat"))
+        else:
+            low = {o.group_size for o in ops
+                   if o.dtypes & _LOW_PRECISION}
+            if not low:
+                findings.append(HloFinding(
+                    "HLO003",
+                    "two-level exchange carries no low-precision "
+                    "(s8/u8/fp8) collective — the DCN hop is paying "
+                    "full-width wire bytes"))
+    elif expect_hierarchy == "flat":
+        distinct = [s for s in rs_scopes if s is not None]
+        if len(distinct) > 1:
+            findings.append(HloFinding(
+                "HLO004",
+                f"hierarchy=flat but reduce-scatter runs {len(distinct)} "
+                f"distinct scopes {rs_scopes} — expected one"))
+    return findings
+
+
+def _prefixes(artifact: Dict) -> List[str]:
+    """Field prefixes present in a bench artifact (PR 3-5 emit
+    ``transformer_*`` alongside unprefixed resnet fields)."""
+    out = {""}
+    for k in artifact:
+        for marker in ("exchange_hierarchy", "overlap_fraction",
+                       "exchange_rs_scopes"):
+            if k.endswith(marker) and k != marker:
+                out.add(k[: -len(marker)])
+    return sorted(out)
+
+
+def lint_artifact(artifact: Dict) -> List[HloFinding]:
+    """Lint the collective-structure fields of one ``--json-out`` bench
+    artifact (no JAX, no compile — pure dict checks)."""
+    findings: List[HloFinding] = []
+    for prefix in _prefixes(artifact):
+        hierarchy = artifact.get(f"{prefix}exchange_hierarchy")
+        rs_scopes = artifact.get(f"{prefix}exchange_rs_scopes")
+        grad_ars = artifact.get(f"{prefix}exchange_grad_sized_allreduces")
+        label = prefix.rstrip("_") or "default"
+        if grad_ars:
+            findings.append(HloFinding(
+                "HLO001",
+                f"[{label}] artifact reports "
+                f"{grad_ars} gradient-sized all-reduce(s) — the "
+                f"sharded exchange regressed to allreduce on the wire"))
+        if hierarchy == "two_level" and rs_scopes is not None:
+            distinct = [s for s in rs_scopes if s is not None]
+            if len(distinct) < 2:
+                findings.append(HloFinding(
+                    "HLO004",
+                    f"[{label}] exchange_hierarchy=two_level but "
+                    f"rs scopes are {rs_scopes} — expected two distinct "
+                    f"scopes (ici + dcn)"))
+        if hierarchy == "flat" and rs_scopes is not None:
+            distinct = [s for s in rs_scopes if s is not None]
+            if len(distinct) > 1:
+                findings.append(HloFinding(
+                    "HLO004",
+                    f"[{label}] exchange_hierarchy=flat but rs scopes "
+                    f"are {rs_scopes} — expected a single scope"))
+        frac = artifact.get(f"{prefix}overlap_fraction")
+        if frac is not None and not 0.0 <= float(frac) <= 1.0:
+            findings.append(HloFinding(
+                "HLO004",
+                f"[{label}] overlap_fraction={frac} out of [0, 1] — "
+                f"corrupt probe output"))
+    return findings
+
+
+def lint_artifact_path(path: str) -> List[HloFinding]:
+    with open(path, "r") as f:
+        data = json.load(f)
+    # MULTICHIP_r0*.json wraps the bench line under "parsed"
+    if isinstance(data.get("parsed"), dict):
+        data = dict(data, **data["parsed"])
+    return lint_artifact(data)
+
+
+def lint_paths(paths: Sequence[str],
+               expect_hierarchy: Optional[str] = None
+               ) -> List[HloFinding]:
+    """Dispatch on suffix: ``.json`` → bench artifact, anything else →
+    raw HLO text dump."""
+    findings: List[HloFinding] = []
+    for p in paths:
+        if p.endswith(".json"):
+            findings.extend(lint_artifact_path(p))
+        else:
+            with open(p, "r", errors="replace") as f:
+                findings.extend(lint_hlo_text(
+                    f.read(), expect_hierarchy=expect_hierarchy))
+    return findings

@@ -1,0 +1,321 @@
+"""HVD005-HVD006: runtime-contract rules.
+
+HVD005 makes the ``HOROVOD_*`` env contract a *registry*, not a
+convention: every knob the package reads or sets must be declared in
+``runtime/config.py``'s ``KNOWN_KNOBS`` and documented under ``docs/``.
+It subsumes the tier-1 doc-drift guard (``tests/test_env_knob_docs.py``
+now delegates here) and extends it — a knob read somewhere deep in
+``elastic/`` that never got registered is exactly how
+``HOROVOD_EXCHANGE_HIERARCHY`` shipped undocumented twice.
+
+HVD006 keeps the chaos plane honest: PR 5's fault-injection hooks are
+only as good as their coverage, and a *new* thread run-loop or connect
+path added without a ``faults.inject()`` site is invisible to every
+chaos plan — the fault scenarios silently stop covering the code that
+actually runs.  The rule requires every thread-target function
+containing a loop, and every ``*connect*`` function, to carry an
+inject site (directly or one call deep).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from horovod_tpu.analysis import astutil as A
+from horovod_tpu.analysis.engine import Finding, Module, Project, Rule, \
+    Severity
+
+KNOB_RE = re.compile(r"^HOROVOD_[A-Z][A-Z0-9_]*$")
+_ENV_READERS = {"os.environ.get", "environ.get", "os.getenv", "getenv"}
+_CONFIG_MODULE = "runtime/config.py"
+
+
+def parse_known_knobs(config_module: Optional[Module]) -> Optional[Set[str]]:
+    """The ``KNOWN_KNOBS`` frozenset/sets literal in runtime/config.py,
+    or None when the registry is missing."""
+    if config_module is None or config_module.tree is None:
+        return None
+    for node in ast.walk(config_module.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        names = {t.id for t in node.targets if isinstance(t, ast.Name)}
+        if "KNOWN_KNOBS" not in names:
+            continue
+        knobs: Set[str] = set()
+        for n in ast.walk(node.value):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                knobs.add(n.value)
+        return knobs
+    return None
+
+
+def referenced_knobs(project: Project
+                     ) -> Dict[str, Tuple[Module, ast.Constant]]:
+    """Every quoted ``HOROVOD_*`` literal in the analyzed set → first
+    reference site.  String literals are the actual env contract: both
+    reads and writes quote the name."""
+    out: Dict[str, Tuple[Module, ast.Constant]] = {}
+    for m in project.modules:
+        if m.tree is None:
+            continue
+        for value, node in A.str_constants(m.tree):
+            if KNOB_RE.match(value) and value not in out:
+                out[value] = (m, node)
+    return out
+
+
+def undocumented_knobs(project: Project) -> Dict[str, str]:
+    """knob → first-referencing relpath, for knobs missing from the doc
+    corpus.  Public seam for ``tests/test_env_knob_docs.py``."""
+    docs = project.docs_text()
+    return {k: m.relpath
+            for k, (m, _) in referenced_knobs(project).items()
+            if k not in docs}
+
+
+class EnvKnobRegistryRule(Rule):
+    id = "HVD005"
+    severity = Severity.P2
+    name = "env-knob-registry"
+    rationale = ("HOROVOD_* knobs read outside the registry or left "
+                 "undocumented drift out of the env contract")
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        config = project.module(_CONFIG_MODULE) \
+            or _load_config_module(project)
+        knobs = parse_known_knobs(config)
+        refs = referenced_knobs(project)
+        if knobs is None:
+            # only demand a registry from trees that actually speak the
+            # env contract — a scan with zero HOROVOD_* references
+            # (e.g. --changed in an unrelated checkout) has nothing to
+            # register
+            if refs:
+                yield Finding(
+                    rule=self.id, severity=Severity.P1,
+                    path=_CONFIG_MODULE, line=1, col=0,
+                    message=("KNOWN_KNOBS registry not found in "
+                             "runtime/config.py — declare every "
+                             "HOROVOD_* knob name in one frozenset"),
+                    context="")
+            return
+        # env *reads* outside config.py get the sharper message: those
+        # are the sites that bypass the registry, not just mention it
+        read_sites = {}
+        for m in project.modules:
+            if m.tree is None or m.relpath.endswith(_CONFIG_MODULE):
+                continue
+            for node in ast.walk(m.tree):
+                name = _env_read_knob(node)
+                if name is not None:
+                    read_sites.setdefault(name, (m, node))
+        docs = project.docs_text()
+        for knob, (m, node) in sorted(refs.items()):
+            if knob not in knobs:
+                if knob in read_sites:
+                    rm, rn = read_sites[knob]
+                    yield self.finding(
+                        rm, rn,
+                        f"env knob '{knob}' is read here but not "
+                        f"declared in runtime/config.py KNOWN_KNOBS — "
+                        f"register it so the env contract stays "
+                        f"greppable in one place",
+                        severity=Severity.P1)
+                else:
+                    yield self.finding(
+                        m, node,
+                        f"env knob '{knob}' is referenced but not "
+                        f"declared in runtime/config.py KNOWN_KNOBS")
+            if docs and knob not in docs:
+                yield self.finding(
+                    m, node,
+                    f"env knob '{knob}' is undocumented — add it to "
+                    f"the docs/running.md 'Env-var reference' table",
+                    severity=Severity.P1)
+        # registry hygiene: a registered knob NOTHING in the whole
+        # package references (outside the registry declaration itself)
+        # is a rename that left its registration behind.  Checked
+        # against the package on disk, not the scan scope — a --changed
+        # run over two files must not call every other knob stale.
+        if config is not None:
+            pkg_refs = _package_references(project)
+            if pkg_refs is not None:
+                for knob in sorted(knobs - pkg_refs):
+                    yield Finding(
+                        rule=self.id, severity=Severity.P3,
+                        path=config.relpath, line=1, col=0,
+                        message=(f"KNOWN_KNOBS declares '{knob}' but "
+                                 f"nothing in the package references "
+                                 f"it — stale registration?"),
+                        context="")
+
+
+def _package_references(project: Project) -> Optional[Set[str]]:
+    """Knob literals referenced anywhere in the on-disk package,
+    EXCLUDING the KNOWN_KNOBS declaration itself (a registration is not
+    a use — otherwise no registration could ever look stale)."""
+    import os
+
+    pkg = os.path.join(project.repo_root, "horovod_tpu")
+    if not os.path.isdir(pkg):
+        return None
+    refs: Set[str] = set()
+    for base, dirs, names in os.walk(pkg):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for n in sorted(names):
+            if not n.endswith(".py"):
+                continue
+            path = os.path.join(base, n)
+            with open(path, "r", errors="replace") as f:
+                src = f.read()
+            try:
+                tree = ast.parse(src)
+            except SyntaxError:
+                continue
+            registry_nodes: Set[int] = set()
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "KNOWN_KNOBS"
+                        for t in node.targets):
+                    registry_nodes = {id(x) for x in ast.walk(node)}
+                    break
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Constant) and \
+                        isinstance(node.value, str) and \
+                        KNOB_RE.match(node.value) and \
+                        id(node) not in registry_nodes:
+                    refs.add(node.value)
+    return refs
+
+
+def _load_config_module(project: Project) -> Optional[Module]:
+    """When the scan scope (e.g. ``--changed``) does not include
+    runtime/config.py, load it from disk so the registry is still the
+    source of truth."""
+    import os
+
+    for cand in (os.path.join(project.repo_root, "horovod_tpu",
+                              _CONFIG_MODULE.replace("/", os.sep)),):
+        if os.path.exists(cand):
+            with open(cand, "r", errors="replace") as f:
+                rel = os.path.relpath(cand, project.root) \
+                    .replace(os.sep, "/")
+                return Module(cand, rel, f.read())
+    return None
+
+
+def _env_read_knob(node: ast.AST) -> Optional[str]:
+    """The knob name when ``node`` is an env *read* of a HOROVOD_*
+    literal: ``os.environ.get("X")`` / ``os.getenv("X")`` /
+    ``os.environ["X"]``."""
+    if isinstance(node, ast.Call):
+        dotted = A.dotted_name(node.func) or ""
+        if dotted in _ENV_READERS or dotted.endswith(".environ.get"):
+            if node.args and isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str) and \
+                    KNOB_RE.match(node.args[0].value):
+                return node.args[0].value
+    if isinstance(node, ast.Subscript):
+        dotted = A.dotted_name(node.value) or ""
+        if dotted.endswith("environ"):
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and \
+                    isinstance(sl.value, str) and KNOB_RE.match(sl.value):
+                return sl.value
+    return None
+
+
+# -- HVD006 -----------------------------------------------------------------
+
+def _has_inject(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            d = A.dotted_name(node.func) or ""
+            if d.endswith("faults.inject") or d == "inject":
+                return True
+    return False
+
+
+def _has_loop(fn: ast.AST) -> bool:
+    # a run-loop is a `while` (poll/serve until told to stop); a one-shot
+    # thread body iterating a worklist with `for` is not chaos surface
+    for node in ast.walk(fn):
+        if isinstance(node, ast.While):
+            return True
+    return False
+
+
+class FaultHookCoverageRule(Rule):
+    id = "HVD006"
+    severity = Severity.P2
+    name = "fault-hook-coverage"
+    rationale = ("thread run-loops and connect paths without a "
+                 "faults.inject() site are invisible to chaos plans — "
+                 "the fault scenarios rot as the runtime grows")
+
+    def check(self, module: Module, project: Project) -> Iterable[Finding]:
+        if module.tree is None:
+            return
+        checked: Set[int] = set()
+        funcs_by_name: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.FunctionDef):
+                funcs_by_name.setdefault(node.name, node)
+
+        def covered(fn: ast.AST) -> bool:
+            if _has_inject(fn):
+                return True
+            # one call hop within the module: the loop body may delegate
+            # (e.g. _watch -> check) and the hook may live in the callee
+            for name in A.called_names(fn):
+                tail = name.rsplit(".", 1)[-1]
+                callee = funcs_by_name.get(tail)
+                if callee is not None and _has_inject(callee):
+                    return True
+            return False
+
+        # thread-target run loops
+        for cls in ast.walk(module.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            from horovod_tpu.analysis.rules_threads import (
+                _thread_entry_functions,
+            )
+
+            for key, fn in _thread_entry_functions(cls).items():
+                if id(fn) in checked:
+                    continue
+                checked.add(id(fn))
+                if key.startswith("method:") and \
+                        key.split(":", 1)[1].startswith("__"):
+                    continue
+                if not _has_loop(fn):
+                    continue    # one-shot targets aren't run-loops
+                if not covered(fn):
+                    fname = getattr(fn, "name", "<lambda>")
+                    yield self.finding(
+                        module, fn,
+                        f"thread run-loop '{cls.name}.{fname}' has no "
+                        f"faults.inject() site — chaos plans cannot "
+                        f"exercise this thread; add a named site and "
+                        f"document it in docs/faults.md")
+        # module-level thread targets (driver-style local closures are
+        # covered through the class scan; plain functions here)
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, ast.FunctionDef) or id(fn) in checked:
+                continue
+            lowname = fn.name.lower()
+            if "connect" in lowname and "disconnect" not in lowname:
+                checked.add(id(fn))
+                if not covered(fn):
+                    yield self.finding(
+                        module, fn,
+                        f"connect path '{fn.name}' has no "
+                        f"faults.inject() site — transient-connect "
+                        f"chaos scenarios cannot reach it; add a named "
+                        f"site and document it in docs/faults.md")
+
+
+RULES: List[Rule] = [EnvKnobRegistryRule, FaultHookCoverageRule]

@@ -1,0 +1,361 @@
+"""HVD004: thread/lock discipline.
+
+The runtime now runs five background threads sharing mutable state with
+the main thread — heartbeat sender, checkpoint writer, prefetch feeder,
+discovery loop, progress watchdog — and the PR 3/5 reviews each found
+an unlocked cross-thread mutation by hand (the ``last_recovery_s``
+log-under-lock fix, the sticky writer error).  This rule mechanizes
+that review:
+
+* **unlocked shared mutation** — within a class, an attribute assigned
+  both from a thread-entry function (a ``threading.Thread``/``Timer``
+  target or an executor ``submit`` callee, plus the class methods it
+  reaches) and from any other method must be assigned under a ``with
+  <lock>:`` block on *both* sides (``__init__`` is exempt: construction
+  happens-before the thread starts).
+* **lock-order inversion** — a directed graph of "acquired lock B while
+  holding lock A" edges, including one call-hop through attributes
+  whose class is known from ``__init__`` (``self._registry =
+  WorkerStateRegistry(...)``); any cycle is a potential deadlock and is
+  reported once per cycle.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from horovod_tpu.analysis import astutil as A
+from horovod_tpu.analysis.engine import Finding, Module, Project, Rule, \
+    Severity
+
+_THREAD_CTORS = {"Thread", "Timer"}
+
+
+def _thread_entry_functions(cls: ast.ClassDef) -> Dict[str, ast.AST]:
+    """Functions of ``cls`` that run on another thread: ``target=`` of a
+    Thread/Timer construction and first args of ``submit`` calls —
+    resolved to class methods (``self.m``) or to local ``def``s of the
+    constructing method."""
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, ast.FunctionDef)}
+    entries: Dict[str, ast.AST] = {}
+
+    def resolve(ref: ast.AST, locals_: Dict[str, ast.FunctionDef]) -> None:
+        attr = A.self_attr(ref)
+        if attr is not None and attr in methods:
+            entries[f"method:{attr}"] = methods[attr]
+        elif isinstance(ref, ast.Name) and ref.id in locals_:
+            entries[f"local:{ref.id}"] = locals_[ref.id]
+
+    for m in methods.values():
+        locals_ = A.local_functions(m)
+        for node in ast.walk(m):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = A.name_tail(node.func)
+            if tail in _THREAD_CTORS:
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        resolve(kw.value, locals_)
+                # Timer(interval, fn) / Thread positional target
+                if len(node.args) >= 2 and tail == "Timer":
+                    resolve(node.args[1], locals_)
+            elif tail == "submit" and node.args:
+                resolve(node.args[0], locals_)
+    return entries
+
+
+def _reachable_methods(cls: ast.ClassDef, roots: List[ast.AST]
+                       ) -> Set[str]:
+    """Names of class methods reachable from ``roots`` via ``self.m()``
+    calls (the thread's footprint inside the class)."""
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, ast.FunctionDef)}
+    seen: Set[str] = set()
+    stack = list(roots)
+    while stack:
+        fn = stack.pop()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                attr = A.self_attr(node.func)
+                if attr in methods and attr not in seen:
+                    seen.add(attr)
+                    stack.append(methods[attr])
+    return seen
+
+
+def _mutations(fn: ast.AST, parents: A.ParentMap
+               ) -> Iterable[Tuple[str, ast.AST, bool]]:
+    """``(attr, node, locked)`` for every ``self.attr = ...`` in ``fn``
+    (including nested defs — the checkpoint writer closure pattern)."""
+    for attr, node in A.iter_self_attr_stores(fn):
+        yield attr, node, A.under_lock(node, parents)
+
+
+class ThreadLockDisciplineRule(Rule):
+    id = "HVD004"
+    severity = Severity.P1
+    name = "thread-lock-discipline"
+    rationale = ("attribute mutated from a thread and a method without "
+                 "the class's lock → torn state/lost updates; "
+                 "lock-order cycles → deadlock")
+
+    def check(self, module: Module, project: Project) -> Iterable[Finding]:
+        if module.tree is None:
+            return
+        parents = A.ParentMap(module.tree)
+        for cls in ast.walk(module.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            yield from self._check_class(module, cls, parents)
+
+    def _check_class(self, module: Module, cls: ast.ClassDef,
+                     parents: A.ParentMap) -> Iterable[Finding]:
+        entries = _thread_entry_functions(cls)
+        if not entries:
+            return
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, ast.FunctionDef)}
+        thread_roots = list(entries.values())
+        thread_method_names = {k.split(":", 1)[1]
+                               for k in entries if k.startswith("method:")}
+        thread_method_names |= _reachable_methods(cls, thread_roots)
+
+        # thread-side mutations: the entry functions themselves (incl.
+        # local closures) + reachable methods
+        thread_mut: Dict[str, List[Tuple[ast.AST, bool]]] = {}
+        for fn in thread_roots:
+            for attr, node, locked in _mutations(fn, parents):
+                thread_mut.setdefault(attr, []).append((node, locked))
+        for name in thread_method_names:
+            fn = methods.get(name)
+            if fn is None:
+                continue
+            for attr, node, locked in _mutations(fn, parents):
+                thread_mut.setdefault(attr, []).append((node, locked))
+
+        # main-side mutations: every *other* method except __init__
+        # (construction happens-before thread start).  A _private method
+        # reachable only from the thread entries is thread-local by
+        # within-class evidence and stays off the main side; a *public*
+        # thread-reachable method is callable from anywhere and counts
+        # on both sides (shared footprint).
+        main_mut: Dict[str, List[Tuple[str, ast.AST, bool]]] = {}
+        spawning = {n for n in methods
+                    if any(e is methods.get(n) for e in thread_roots)}
+        for name, fn in methods.items():
+            if name == "__init__" or name in spawning:
+                continue
+            if name in thread_method_names and name.startswith("_"):
+                continue
+            own_locals = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.FunctionDef) and node is not fn:
+                    own_locals.add(node)
+            for attr, node, locked in _mutations(fn, parents):
+                # skip stores inside nested defs already counted as
+                # thread entries (the writer-closure pattern)
+                if any(node in set(ast.walk(loc)) for loc in own_locals
+                       if loc in thread_roots):
+                    continue
+                main_mut.setdefault(attr, []).append((name, node, locked))
+
+        for attr in sorted(set(thread_mut) & set(main_mut)):
+            t_sites = thread_mut[attr]
+            m_sites = main_mut[attr]
+            unlocked = [(n, "thread") for n, lk in t_sites if not lk] + \
+                       [(n, f"method '{m}'") for m, n, lk in m_sites
+                        if not lk]
+            if not unlocked:
+                continue
+            node, side = unlocked[0]
+            other = "a background thread" if side != "thread" \
+                else "other methods"
+            yield self.finding(
+                module, node,
+                f"'{cls.name}.{attr}' is mutated from {side} without "
+                f"the class's lock, but is also mutated from {other} "
+                f"({len(t_sites)} thread-side / {len(m_sites)} "
+                f"method-side sites) — guard every store with the "
+                f"class lock or document the happens-before edge")
+
+    # -- lock-order graph ---------------------------------------------------
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        # lock identity: (ClassName, attrname) — coarse but stable.
+        # attr_types: (ClassName, attr) -> ClassName for `self.x = Cls(...)`
+        classes: Dict[str, ast.ClassDef] = {}
+        class_module: Dict[str, Module] = {}
+        for m in project.modules:
+            if m.tree is None:
+                continue
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.ClassDef):
+                    classes.setdefault(node.name, node)
+                    class_module.setdefault(node.name, m)
+        attr_types: Dict[Tuple[str, str], str] = {}
+
+        def init_of(cls: ast.ClassDef) -> Optional[ast.FunctionDef]:
+            for fn in cls.body:
+                if isinstance(fn, ast.FunctionDef) and \
+                        fn.name == "__init__":
+                    return fn
+            return None
+
+        for cname, cls in classes.items():
+            init = init_of(cls)
+            if init is None:
+                continue
+            for node in ast.walk(init):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                tgt_attr = None
+                for t in node.targets:
+                    tgt_attr = A.self_attr(t) or tgt_attr
+                callee = A.name_tail(node.value.func)
+                if not tgt_attr or callee not in classes:
+                    continue
+                attr_types[(cname, tgt_attr)] = callee
+                # ctor-argument flow: `self.x = Other(self, ...)` hands
+                # THIS object to Other.__init__; whatever attribute
+                # Other stores that parameter under has OUR type — the
+                # registry/driver back-reference pattern the elastic
+                # inversion hid behind
+                callee_init = init_of(classes[callee])
+                if callee_init is None:
+                    continue
+                params = [a.arg for a in callee_init.args.args]
+                for i, arg in enumerate(node.value.args):
+                    if not (isinstance(arg, ast.Name)
+                            and arg.id == "self"):
+                        continue
+                    if i + 1 >= len(params):
+                        continue
+                    pname = params[i + 1]
+                    for st in ast.walk(callee_init):
+                        if isinstance(st, ast.Assign) and \
+                                isinstance(st.value, ast.Name) and \
+                                st.value.id == pname:
+                            for t in st.targets:
+                                back = A.self_attr(t)
+                                if back is not None:
+                                    attr_types[(callee, back)] = cname
+
+        # per-method top-level lock acquisitions, per class
+        def method_locks(cname: str, mname: str) -> Set[Tuple[str, str]]:
+            cls = classes.get(cname)
+            if cls is None:
+                return set()
+            for fn in cls.body:
+                if isinstance(fn, ast.FunctionDef) and fn.name == mname:
+                    out = set()
+                    for node in ast.walk(fn):
+                        if isinstance(node, ast.With):
+                            for ln in A.with_lock_names(node):
+                                out.add((cname, ln))
+                    return out
+            return set()
+
+        edges: Dict[Tuple[Tuple[str, str], Tuple[str, str]],
+                    Tuple[str, int]] = {}
+
+        for m in project.modules:
+            if m.tree is None:
+                continue
+            parents = A.ParentMap(m.tree)
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.With):
+                    continue
+                held_names = A.with_lock_names(node)
+                if not held_names:
+                    continue
+                cls = parents.enclosing_class(node)
+                cname = cls.name if cls is not None else m.relpath
+                held = [(cname, n) for n in held_names]
+                for inner in ast.walk(node):
+                    if inner is node:
+                        continue
+                    # direct nesting: with A: ... with B:
+                    if isinstance(inner, ast.With):
+                        for n2 in A.with_lock_names(inner):
+                            tgt = (cname, n2)
+                            for h in held:
+                                if h != tgt and (h, tgt) not in edges:
+                                    edges[(h, tgt)] = (m.relpath,
+                                                       inner.lineno)
+                    # one call-hop: with A: self._other.m() where
+                    # self._other's class is known and m() takes a lock
+                    if isinstance(inner, ast.Call) and \
+                            isinstance(inner.func, ast.Attribute):
+                        recv_attr = A.self_attr(inner.func.value)
+                        if recv_attr is None:
+                            continue
+                        tcls = attr_types.get((cname, recv_attr))
+                        if tcls is None:
+                            continue
+                        for tgt in method_locks(tcls, inner.func.attr):
+                            for h in held:
+                                if h != tgt and (h, tgt) not in edges:
+                                    edges[(h, tgt)] = (m.relpath,
+                                                       inner.lineno)
+
+        # cycle detection over the edge set
+        graph: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+        reported: Set[frozenset] = set()
+        for start in sorted(graph):
+            cyc = _find_cycle(graph, start)
+            if cyc is None:
+                continue
+            key = frozenset(cyc)
+            if key in reported:
+                continue
+            reported.add(key)
+            # anchor the report at the first edge of the cycle we know
+            a, b = cyc[0], cyc[1 % len(cyc)]
+            relpath, lineno = edges.get((a, b), ("", 1))
+            mod = None
+            for pm in project.modules:
+                if pm.relpath == relpath:
+                    mod = pm
+                    break
+            order = " -> ".join(f"{c}.{n}" for c, n in cyc + [cyc[0]])
+            f = Finding(
+                rule=self.id, severity=Severity.P1,
+                path=relpath or (project.modules[0].relpath
+                                 if project.modules else ""),
+                line=lineno, col=0,
+                message=(f"lock-acquisition-order cycle: {order} — two "
+                         f"threads taking these locks in opposite order "
+                         f"deadlock; impose a single global order or "
+                         f"drop one lock before acquiring the next"),
+                context=mod.context_line(lineno) if mod else "")
+            yield f
+
+
+def _find_cycle(graph: Dict, start) -> Optional[List]:
+    path: List = []
+    on_path: Set = set()
+    visited: Set = set()
+
+    def dfs(node) -> Optional[List]:
+        if node in on_path:
+            i = path.index(node)
+            return path[i:]
+        if node in visited:
+            return None
+        visited.add(node)
+        path.append(node)
+        on_path.add(node)
+        for nxt in sorted(graph.get(node, ())):
+            cyc = dfs(nxt)
+            if cyc is not None:
+                return cyc
+        path.pop()
+        on_path.discard(node)
+        return None
+
+    return dfs(start)

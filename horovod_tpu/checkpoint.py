@@ -134,6 +134,13 @@ class Checkpointer:
         self._max_to_keep = max_to_keep
         self._async = async_save
         self._writer: Optional[threading.Thread] = None
+        # guards _writer_error: written by the writer thread, consumed
+        # by wait()/clear_error() on the caller's thread.  wait()'s
+        # join() already orders the steady-state handoff, but
+        # clear_error() has no such edge — without the lock it can race
+        # a writer failing mid-flight and acknowledge an error it never
+        # returned to the caller.
+        self._error_lock = threading.Lock()
         self._writer_error: Optional[BaseException] = None
         # observability for the bench probe: the train-loop blocking
         # time of the last save (D2H cut only, async) and the last
@@ -188,14 +195,17 @@ class Checkpointer:
         if w is not None:
             w.join()
             self._writer = None
-        if self._writer_error is not None:
-            raise self._writer_error
+        with self._error_lock:
+            err = self._writer_error
+        if err is not None:
+            raise err
 
     def clear_error(self) -> Optional[BaseException]:
         """Acknowledge (and return) the sticky writer error, unblocking
         further saves — the caller has decided how to proceed (retry
         the save, fail over to another directory, abort)."""
-        err, self._writer_error = self._writer_error, None
+        with self._error_lock:
+            err, self._writer_error = self._writer_error, None
         return err
 
     def close(self) -> None:
@@ -215,16 +225,18 @@ class Checkpointer:
                 faults.inject("checkpoint.write")   # chaos hook
                 fn()
             except BaseException as e:  # noqa: BLE001 — surfaced at wait()
-                self._writer_error = e
+                with self._error_lock:
+                    self._writer_error = e
             finally:
                 self.last_write_s = time.perf_counter() - t0
 
         if not self._async:
             run()
-            if self._writer_error is not None:
+            with self._error_lock:
                 # synchronous surfacing: the caller sees the error right
                 # here, so it is consumed rather than left sticky
                 err, self._writer_error = self._writer_error, None
+            if err is not None:
                 raise err
             return
         # non-daemon: a process exiting right after save() (last epoch,

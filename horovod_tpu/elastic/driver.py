@@ -229,8 +229,11 @@ class ElasticDriver:
         abort event kills the tree)."""
         if self._shutdown.is_set():
             return    # completed/stopped job: silence is expected
-        self.last_detect_s = detect_s
-        self.last_detect_reason = reason
+        # the monitor thread calls this; _check_generation_ready reads
+        # and consumes last_detect_s under the lock
+        with self._lock:
+            self.last_detect_s = detect_s
+            self.last_detect_reason = reason
         hvd_logging.warning(
             "elastic: worker %s:%d declared dead (%s) — detect_s=%.2f; "
             "regenerating without waiting for process exit",
@@ -272,9 +275,14 @@ class ElasticDriver:
         self._spawn_all()
 
     def stop(self, exit_code: int = 1) -> None:
-        if not self._finished.is_set():
-            self._exit_code = exit_code
-            self._finished.set()
+        # under the lock: stop() runs from resume/discovery threads as
+        # well as the main thread, and record_worker_exit's success path
+        # writes _exit_code concurrently — first finisher wins, torn
+        # writes lose (hvdlint HVD004)
+        with self._lock:
+            if not self._finished.is_set():
+                self._exit_code = exit_code
+                self._finished.set()
         self._shutdown.set()
         self._health.stop()
         with self._lock:
@@ -366,27 +374,32 @@ class ElasticDriver:
         discovery order, so a surviving (host, local_rank) keeps its rank
         unless an earlier host vanished; at least one previously-assigned
         host must survive to carry the state forward."""
-        current = self._host_manager.current_hosts
-        prev = self._assignments
-        if prev:
-            surviving = {h for h, _ in prev} & set(current)
-            if not surviving:
-                raise RuntimeError(
-                    "elastic: no previously-assigned host survived — model "
-                    "state is lost (reference guarantee driver.py:236-242)")
-        hosts = [HostInfo(h, s) for h, s in current.items()]
-        assignments = get_host_assignments(
-            hosts, self._min_np,
-            self._max_np or sum(h.slots for h in hosts))
-        self._assignments = {(s.hostname, s.local_rank): s
-                             for s in assignments}
-        self._registry.purge_unassigned(set(self._assignments))
-        self._health.purge(set(self._assignments))
-        self._coordinator_addr = self._new_coordinator_addr(assignments)
-        self._generation += 1
-        self._generation_started = time.monotonic()
-        self._regen_requests.clear()
-        return self._assignments
+        # every caller already holds self._lock, but the generation swap
+        # must be atomic regardless of future call sites — the RLock
+        # makes re-acquiring free (hvdlint HVD004)
+        with self._lock:
+            current = self._host_manager.current_hosts
+            prev = self._assignments
+            if prev:
+                surviving = {h for h, _ in prev} & set(current)
+                if not surviving:
+                    raise RuntimeError(
+                        "elastic: no previously-assigned host survived — "
+                        "model state is lost (reference guarantee "
+                        "driver.py:236-242)")
+            hosts = [HostInfo(h, s) for h, s in current.items()]
+            assignments = get_host_assignments(
+                hosts, self._min_np,
+                self._max_np or sum(h.slots for h in hosts))
+            self._assignments = {(s.hostname, s.local_rank): s
+                                 for s in assignments}
+            self._registry.purge_unassigned(set(self._assignments))
+            self._health.purge(set(self._assignments))
+            self._coordinator_addr = self._new_coordinator_addr(assignments)
+            self._generation += 1
+            self._generation_started = time.monotonic()
+            self._regen_requests.clear()
+            return self._assignments
 
     def _new_coordinator_addr(self, assignments: List[SlotInfo]) -> str:
         """Fresh coordination service per generation, hosted HERE in the
@@ -545,9 +558,10 @@ class ElasticDriver:
                 all_done = all(
                     self._registry.get_state(h, lr) == "SUCCESS"
                     for (h, lr) in self._assignments)
+                if all_done and not self._finished.is_set():
+                    self._exit_code = 0
+                    self._finished.set()
             if all_done:
-                self._exit_code = 0
-                self._finished.set()
                 self._shutdown.set()
         else:
             # record_failure's check-and-set is atomic: it returns False

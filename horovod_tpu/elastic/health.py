@@ -38,6 +38,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
+from horovod_tpu import faults
 from horovod_tpu.utils import logging as hvd_logging
 from horovod_tpu.utils.stall import ProgressWatchdog
 
@@ -119,6 +120,9 @@ class HealthMonitor:
     def _watch(self) -> None:
         poll = max(self.interval_s / 2.0, 0.05)
         while not self._stop.wait(poll):
+            # chaos hook: a hang/delay here models a stalled monitor —
+            # death detection latency degrades to the process-exit path
+            faults.inject("driver.health")
             self.check()
 
     # -- recording ----------------------------------------------------------

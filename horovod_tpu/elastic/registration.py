@@ -78,14 +78,23 @@ class WorkerStateRegistry:
         return True
 
     def _maybe_resume(self) -> None:
+        # decide under the lock, call the driver OUTSIDE it: stop()
+        # takes the driver lock, and the driver calls back into this
+        # registry (purge_unassigned) while holding it — calling out
+        # with our lock held is the registry->driver half of a
+        # driver->registry lock-order inversion, i.e. a deadlock with
+        # the resume path (hvdlint HVD004 lock-order graph)
         with self._lock:
-            if self._reset_limit and self._reset_count >= self._reset_limit:
-                hvd_logging.warning(
-                    "elastic: reset limit %d reached — stopping job",
-                    self._reset_limit)
-                self._driver.stop()
-                return
-            self._reset_count += 1
+            stop = bool(self._reset_limit
+                        and self._reset_count >= self._reset_limit)
+            if not stop:
+                self._reset_count += 1
+        if stop:
+            hvd_logging.warning(
+                "elastic: reset limit %d reached — stopping job",
+                self._reset_limit)
+            self._driver.stop()
+            return
         self._driver.resume()
 
     def count(self, state: str) -> int:

@@ -26,7 +26,15 @@ Sites currently instrumented (grep ``faults.inject`` for ground truth):
 ``discovery.script``        each discovery-script execution
 ``checkpoint.write``        the checkpoint writer (thread) before the write
 ``data.feed``               prefetch feeder, once per source batch
+``driver.health``           each health-monitor watch pass (driver thread)
+``stall.watch``             each stall-inspector poll pass
+``timeline.write``          timeline writer thread, once per event
+``probe.connect``           NIC-probe task → driver connect scan
 ==========================  =================================================
+
+(Coverage is enforced statically: hvdlint rule HVD006 fails on any
+thread run-loop or connect path without an ``inject`` site, so this
+table can only grow with the runtime — see docs/analysis.md.)
 
 Typical use::
 

@@ -181,6 +181,11 @@ def _connect_driver(driver_addrs: str, secret_key: Optional[str]
     from horovod_tpu.runtime.retry import RetryPolicy
 
     def scan() -> BasicClient:
+        from horovod_tpu import faults
+
+        # chaos hook: a transient OSError here exercises the retry
+        # policy exactly as a refused connect during driver bind does
+        faults.inject("probe.connect")
         last_err: Optional[Exception] = None
         for addr in driver_addrs.split(","):
             host, port = addr.rsplit(":", 1)

@@ -47,6 +47,7 @@ import hashlib
 import json
 import os
 import pickle
+import re
 import tempfile
 import threading
 from typing import Any, Optional, Tuple
@@ -149,6 +150,23 @@ def _env_fields() -> dict:
     }
 
 
+# default object repr / bound-method repr memory addresses: a key built
+# from them differs every process start, so every warm start misses
+_ADDR_RE = re.compile(r" at 0x[0-9a-fA-F]+")
+
+
+def _stable_repr(obj: Any) -> str:
+    """Process-stable fallback serializer for non-JSON key fields.
+
+    ``repr`` of an arbitrary object embeds its memory address
+    (``<Mesh object at 0x7f...>``) — a different AOT key every process,
+    i.e. a warm start that silently never hits (hvdlint HVD003).  Strip
+    the address; the remaining type/name text still distinguishes
+    semantically different values, and anything that needs finer
+    identity must be passed as a JSON-serializable extra."""
+    return _ADDR_RE.sub("", repr(obj))
+
+
 def executable_key(lowered_text: str, extras: Optional[dict] = None,
                    compiler_options: Optional[dict] = None) -> str:
     """Content hash identifying one compiled executable.
@@ -166,7 +184,7 @@ def executable_key(lowered_text: str, extras: Optional[dict] = None,
         "module_sha": hashlib.sha256(
             lowered_text.encode("utf-8", "replace")).hexdigest(),
     }
-    blob = json.dumps(payload, sort_keys=True, default=repr)
+    blob = json.dumps(payload, sort_keys=True, default=_stable_repr)
     return hashlib.sha256(blob.encode()).hexdigest()
 
 

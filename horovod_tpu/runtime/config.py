@@ -20,6 +20,65 @@ import os
 from typing import Optional
 
 
+# The HOROVOD_* env-var registry (reference knob table common.h:64-90):
+# every knob the package reads OR sets must be declared here — the
+# static analyzer (HVD005, docs/analysis.md) fails on any quoted
+# HOROVOD_* literal missing from this set, and the doc-drift guard
+# (tests/test_env_knob_docs.py) separately requires each to appear in
+# docs/.  One greppable place instead of knobs scattered per-module.
+KNOWN_KNOBS = frozenset({
+    # -- process identity (set by the launcher)
+    "HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
+    "HOROVOD_LOCAL_SIZE", "HOROVOD_CROSS_RANK", "HOROVOD_CROSS_SIZE",
+    "HOROVOD_HOSTNAME", "HOROVOD_COORDINATOR_ADDR",
+    # -- data plane / fusion
+    "HOROVOD_TPU_OPERATIONS", "HOROVOD_FUSION_THRESHOLD",
+    "HOROVOD_CYCLE_TIME", "HOROVOD_CACHE_CAPACITY",
+    "HOROVOD_HIERARCHICAL_ALLREDUCE", "HOROVOD_HIERARCHICAL_ALLGATHER",
+    "HOROVOD_EXCHANGE_BUCKET_BYTES", "HOROVOD_EXCHANGE_HIERARCHY",
+    "HOROVOD_ADASUM_NUM_CHUNKS", "HOROVOD_DEBUG_SPARSE",
+    "HOROVOD_TPU_MESH_SHAPE",
+    # -- warm-start compile cache
+    "HOROVOD_COMPILE_CACHE", "HOROVOD_COMPILE_CACHE_DIR",
+    # -- input pipeline
+    "HOROVOD_PREFETCH_DEPTH", "HOROVOD_INPUT_THREADS",
+    # -- autotune
+    "HOROVOD_AUTOTUNE", "HOROVOD_AUTOTUNE_LOG",
+    "HOROVOD_AUTOTUNE_WARMUP_SAMPLES",
+    "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES",
+    "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE",
+    "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE",
+    # -- timeline / stall inspector / logging
+    "HOROVOD_TIMELINE", "HOROVOD_TIMELINE_MARK_CYCLES",
+    "HOROVOD_TIMELINE_PYTHON", "HOROVOD_STALL_CHECK_DISABLE",
+    "HOROVOD_STALL_CHECK_TIME_SECONDS",
+    "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS",
+    "HOROVOD_LOG_LEVEL", "HOROVOD_LOG_HIDE_TIME",
+    # -- elastic runtime
+    "HOROVOD_ELASTIC", "HOROVOD_ELASTIC_DRIVER_ADDR",
+    "HOROVOD_ELASTIC_NOTIFY_ADDR", "HOROVOD_ELASTIC_GENERATION",
+    "HOROVOD_ELASTIC_START_TIMEOUT", "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT",
+    "HOROVOD_ELASTIC_HEARTBEAT_INTERVAL",
+    "HOROVOD_ELASTIC_HEARTBEAT_SUSPECT_MISSES",
+    "HOROVOD_ELASTIC_HEARTBEAT_DEAD_S",
+    "HOROVOD_ELASTIC_PROGRESS_TIMEOUT_S",
+    # -- health / quarantine / retry / chaos
+    "HOROVOD_QUARANTINE_BASE_S", "HOROVOD_QUARANTINE_MAX_S",
+    "HOROVOD_QUARANTINE_PROBATION_S", "HOROVOD_QUARANTINE_DISABLE",
+    "HOROVOD_RETRY_MAX_ATTEMPTS", "HOROVOD_RETRY_BASE_S",
+    "HOROVOD_RETRY_MAX_S", "HOROVOD_RETRY_DEADLINE_S",
+    "HOROVOD_RETRY_JITTER", "HOROVOD_FAULT_PLAN",
+    # -- launcher / runner / spark
+    "HOROVOD_CONTROLLER", "HOROVOD_SECRET_KEY", "HOROVOD_RUN_SECRET",
+    "HOROVOD_RUN_SERVICE_ADDR", "HOROVOD_THREAD_AFFINITY",
+    "HOROVOD_TPU_DISCOVERY_CACHE_TTL",
+    "HOROVOD_LSF_ACCELERATORS_PER_NODE", "HOROVOD_LSF_CORES_PER_NODE",
+    "HOROVOD_LSF_THREADS_PER_CORE",
+    "HOROVOD_SPARK_ELASTIC_RUN_ID", "HOROVOD_SPARK_HOST_HASH",
+    "HOROVOD_SPARK_START_TIMEOUT",
+})
+
+
 def _env_int(name: str, default: int) -> int:
     v = os.environ.get(name)
     if v is None or v == "":

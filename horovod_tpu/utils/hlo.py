@@ -40,9 +40,14 @@ _KINDS = ("all-reduce", "reduce-scatter", "all-gather", "all-to-all",
 
 # one result tensor: dtype[dims]{layout} — layout block optional
 _SHAPE_RE = re.compile(r"([a-z]\w*)\[([\d,]*)\](?:\{[^}]*\})?")
-# op-definition line: "%name = <result-type> <kind>[-start](operands...)"
+# op-definition line: "%name = <result-type> <kind>[-start](operands...)".
+# The result type may be a tuple wrapped in extra parens with trailing
+# context scalars — newer XLA emits ``((f32[...], f32[...]), u32[])``
+# and ``(f32[...], u32[])`` variants — so the kind match anchors on the
+# closing bracket/brace of the type (``(?<=[\]})])``) and tolerates a
+# missing separator space rather than requiring ``<type> <kind>``.
 _OP_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.+?)\s+("
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.+?)(?<=[\]})])\s*\b("
     + "|".join(_KINDS) + r")(-start)?\(")
 
 
@@ -118,9 +123,18 @@ def collective_ops(hlo_text: str) -> List[CollectiveOp]:
             continue
         result_type, kind, is_async = m.group(1), m.group(2), m.group(3)
         shapes = _parse_shapes(result_type)
-        # async starts of gather/scatter/permute carry `(input, output,
-        # ...)` tuples (plus scalar context values on TPU); the payload
-        # is the output alone — summing the whole tuple double-counts
+        # async start tuples carry trailing scalar context values on
+        # TPU (the u32[] in `(f32[...], u32[])`); they are bookkeeping,
+        # not payload — drop them BEFORE picking the output element,
+        # otherwise the context scalar is mistaken for the output (and
+        # every byte-based fusion guard sees a 4-byte collective)
+        if is_async and len(shapes) >= 2:
+            while len(shapes) > 1 and shapes[-1][1] == () and \
+                    shapes[-1][0] in ("u32", "s32"):
+                shapes = shapes[:-1]
+        # async starts of gather/scatter/permute carry `(input, output)`
+        # tuples; the payload is the output alone — summing the whole
+        # tuple double-counts
         if is_async and kind in ("all-gather", "reduce-scatter",
                                  "collective-permute") \
                 and len(shapes) >= 2:

@@ -95,6 +95,12 @@ class OverlapReport:
                 round(self.exchange_cross_s, 6)
         if self.rs_scopes:
             fields[f"{prefix}exchange_rs_scopes"] = list(self.rs_scopes)
+            # the count the offline HLO lint (analysis/hlo_lint.py
+            # HLO001) checks in saved artifacts: any non-zero value
+            # means the sharded exchange regressed to allreduce on the
+            # wire of the run that produced this JSON
+            fields[f"{prefix}exchange_grad_sized_allreduces"] = \
+                int(self.grad_sized_allreduces)
         return fields
 
 

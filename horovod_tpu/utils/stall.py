@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from horovod_tpu import faults
 from horovod_tpu.utils import logging as hvd_logging
 
 _STATUS_DIR = "hvdstall/status"
@@ -206,6 +207,9 @@ class StallInspector:
 
     def _watch(self) -> None:
         while not self._stop.wait(self._poll_interval_s):
+            # chaos hook: a hang here silences stall warnings — the
+            # degradation mode where the inspector itself is wedged
+            faults.inject("stall.watch")
             now = time.monotonic()
             stalled, fatal, publish_due = [], [], []
             with self._lock:

@@ -25,6 +25,8 @@ import threading
 import time
 from typing import Optional
 
+from horovod_tpu import faults
+
 # Activity names mirroring common.h:32-62
 QUEUE = "QUEUE"
 NEGOTIATE = "NEGOTIATE"            # NEGOTIATE_ALLREDUCE/... analogue
@@ -154,6 +156,9 @@ class Timeline:
             ev = self._queue.get()
             if ev is None:
                 return
+            # chaos hook: a raise/delay models a failing trace sink —
+            # tracing must degrade without stalling the training loop
+            faults.inject("timeline.write")
             if not self._first:
                 self._file.write(",\n")
             self._first = False

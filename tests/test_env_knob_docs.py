@@ -4,53 +4,57 @@ sets must appear in the documentation.
 The knob table (docs/running.md "Env-var reference") has drifted twice
 already — ``HOROVOD_EXCHANGE_HIERARCHY`` and
 ``HOROVOD_EXCHANGE_BUCKET_BYTES`` shipped undocumented — so this is a
-tier-1 structural test: it greps the package for quoted
-``HOROVOD_[A-Z0-9_]*`` string literals (the actual env contract — env
-reads and env writes both quote the name) and asserts each one occurs
-somewhere under ``docs/`` or the repo-root design docs.
+tier-1 structural test.  Since the static analyzer landed it
+**delegates to hvdlint rule HVD005** (``analysis/rules_runtime.py``):
+the same knob scan and doc corpus back both the test and
+``python -m horovod_tpu.analysis``, so the doc guard and the analyzer
+cannot drift apart — a knob this test would flag is exactly a knob the
+analyzer flags, by construction.
 """
 
-import re
 from pathlib import Path
 
+from horovod_tpu.analysis.engine import Project, collect_files, load_modules
+from horovod_tpu.analysis.rules_runtime import (
+    parse_known_knobs,
+    referenced_knobs,
+    undocumented_knobs,
+)
+
 REPO = Path(__file__).resolve().parent.parent
-KNOB_RE = re.compile(r"""["'](HOROVOD_[A-Z][A-Z0-9_]*)["']""")
 
 
-def referenced_knobs():
-    knobs = {}
-    for py in sorted((REPO / "horovod_tpu").rglob("*.py")):
-        for m in KNOB_RE.finditer(py.read_text(errors="replace")):
-            knobs.setdefault(m.group(1), py.relative_to(REPO))
-    return knobs
-
-
-def documented_text():
-    texts = []
-    for md in sorted((REPO / "docs").rglob("*.md")):
-        texts.append(md.read_text(errors="replace"))
-    for name in ("README.md", "PERF_NOTES.md"):
-        p = REPO / name
-        if p.exists():
-            texts.append(p.read_text(errors="replace"))
-    return "\n".join(texts)
+def _project() -> Project:
+    files = collect_files([str(REPO / "horovod_tpu")])
+    return Project(load_modules(files, str(REPO)), root=str(REPO))
 
 
 def test_every_env_knob_is_documented():
-    knobs = referenced_knobs()
+    project = _project()
+    knobs = referenced_knobs(project)
     assert knobs, "expected HOROVOD_* knobs in horovod_tpu/ — did the " \
                   "package move?"
-    docs = documented_text()
-    missing = {k: str(f) for k, f in knobs.items() if k not in docs}
+    missing = undocumented_knobs(project)
     assert not missing, (
         "undocumented HOROVOD_* env knobs (add them to the docs/running.md "
         f"'Env-var reference' table): {missing}")
 
 
+def test_every_env_knob_is_registered():
+    """The HVD005 half the analyzer adds on top of the doc check: every
+    referenced knob is declared in runtime/config.py KNOWN_KNOBS."""
+    project = _project()
+    registry = parse_known_knobs(project.module("runtime/config.py"))
+    assert registry, "KNOWN_KNOBS registry missing from runtime/config.py"
+    missing = sorted(set(referenced_knobs(project)) - registry)
+    assert missing == [], (
+        f"knobs referenced but not in KNOWN_KNOBS: {missing}")
+
+
 def test_warmstart_knobs_present():
-    # the knobs this PR introduced are part of the contract now — pin
-    # them explicitly so a rename can't slip through the generic scan
-    knobs = referenced_knobs()
+    # the knobs the warm-start PR introduced are part of the contract —
+    # pin them explicitly so a rename can't slip through the generic scan
+    knobs = referenced_knobs(_project())
     assert "HOROVOD_COMPILE_CACHE" in knobs
     assert "HOROVOD_COMPILE_CACHE_DIR" in knobs
     assert "HOROVOD_CACHE_CAPACITY" in knobs

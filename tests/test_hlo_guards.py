@@ -466,3 +466,49 @@ class TestHloParser:
     def test_ignores_non_collective_lines(self):
         text = "  %dot.5 = f32[256,256]{1,0} dot(%a, %b)"
         assert H.collective_ops(text) == []
+
+    def test_parses_tuple_wrapped_in_extra_parens(self):
+        # newer XLA wraps the async (input, output) tuple in an extra
+        # paren level and appends a u32[] context scalar:
+        # ((f32[...], f32[...]), u32[]) — the old _OP_RE/shape handling
+        # picked the context scalar as the payload
+        line = ("  %rs = ((f32[104]{0}, f32[13]{0}), u32[]) "
+                "reduce-scatter-start(%x), replica_groups=[1,8]<=[8], "
+                "dimensions={0}, to_apply=%add")
+        (op,) = H.collective_ops(line)
+        assert op.kind == "reduce-scatter"
+        assert op.asynchronous
+        assert op.bytes == 13 * 4
+        assert op.group_size == 8
+
+    def test_context_scalar_not_mistaken_for_output(self):
+        # the (payload, u32[]) two-element variant: element 1 is the
+        # context scalar, NOT the gathered output — payload must be the
+        # f32 tensor, not 4 bytes
+        line = ("  %ag = (f32[64,128]{1,0}, u32[]) all-gather-start(%x), "
+                "replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={0}")
+        (op,) = H.collective_ops(line)
+        assert op.kind == "all-gather"
+        assert op.bytes == 64 * 128 * 4
+
+    def test_context_scalar_not_counted_in_allreduce_payload(self):
+        line = ("  %ar = (f32[256]{0}, u32[]) all-reduce-start(%a), "
+                "channel_id=1, replica_groups=[1,8]<=[8], to_apply=%add")
+        (op,) = H.collective_ops(line)
+        assert op.bytes == 256 * 4
+
+    def test_parses_missing_separator_space(self):
+        # some dumps drop the space between the result tuple and the op
+        line = ("  %rs = (f32[104]{0}, f32[13]{0})reduce-scatter-start"
+                "(%x), replica_groups=[1,8]<=[8], dimensions={0}, "
+                "to_apply=%add")
+        (op,) = H.collective_ops(line)
+        assert op.kind == "reduce-scatter"
+        assert op.bytes == 13 * 4
+
+    def test_tile_layout_parens_in_layout_block(self):
+        line = ("  %rs = (f32[104]{0:T(256)}, f32[13]{0:T(256)S(1)}) "
+                "reduce-scatter-start(%x), replica_groups=[1,8]<=[8], "
+                "dimensions={0}, to_apply=%add")
+        (op,) = H.collective_ops(line)
+        assert op.bytes == 13 * 4
