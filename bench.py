@@ -975,6 +975,35 @@ def run_autotune(args, hvd):
             "autotune_log": log_path}
 
 
+def artifact_metadata(hvd):
+    """BENCH-JSON provenance (``schema_version`` 1, docs/perf_gate.md):
+    the perf gate validates these fields and REFUSES to diff artifacts
+    whose device/mesh identity differs — a v5e number compared against
+    a v4 run is not a regression, it's a category error.  Legacy
+    artifacts without the block still load as schema 0."""
+    meta = {
+        "schema_version": 1,
+        "jax_version": jax.__version__,
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "n_devices": hvd.size(),
+    }
+    try:
+        import jaxlib
+
+        meta["jaxlib_version"] = getattr(jaxlib, "__version__", None)
+    except Exception:  # noqa: BLE001 — provenance must not sink the bench
+        meta["jaxlib_version"] = None
+    try:
+        from horovod_tpu.runtime import state
+
+        mesh = state.global_state().mesh
+        meta["mesh_shape"] = [int(s) for s in mesh.shape.values()]
+    except Exception:  # noqa: BLE001
+        meta["mesh_shape"] = [1, hvd.size()]
+    return meta
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="both",
@@ -1126,10 +1155,12 @@ def main():
 
     hvd.init()
     if args.chaos:
-        emit(run_chaos(args, hvd), args.json_out)
+        emit(dict(run_chaos(args, hvd), **artifact_metadata(hvd)),
+             args.json_out)
         return
     if args.autotune:
-        emit(run_autotune(args, hvd), args.json_out)
+        emit(dict(run_autotune(args, hvd), **artifact_metadata(hvd)),
+             args.json_out)
         return
     out = {}
     if args.model in ("both", "resnet"):
@@ -1148,6 +1179,7 @@ def main():
                 "cache_misses": stats.get("misses", 0),
                 "aot_disk_hits": stats.get("aot_disk_hits", 0),
                 "aot_disk_misses": stats.get("aot_disk_misses", 0)})
+    out.update(artifact_metadata(hvd))
     emit(out, args.json_out)
 
 

@@ -8,6 +8,8 @@ Usage::
     python -m horovod_tpu.analysis --hlo dump.txt        # HLO rule pack
     python -m horovod_tpu.analysis --artifact BENCH.json # bench artifact
     python -m horovod_tpu.analysis --write-baseline ...  # accept findings
+    python -m horovod_tpu.analysis perf-gate --candidate new.json
+    python -m horovod_tpu.analysis ci                    # lint+artifacts+gate
 
 Exit codes: 0 clean, 1 findings, 2 usage/environment error.
 """
@@ -76,6 +78,18 @@ def _list_rules() -> int:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    # subcommands ride the same CLI (and the hvdlint console script):
+    # dispatch BEFORE argparse so "perf-gate" is never mistaken for a
+    # lint path
+    if argv and argv[0] == "perf-gate":
+        from horovod_tpu.analysis import perf_gate
+
+        return perf_gate.main(argv[1:])
+    if argv and argv[0] == "ci":
+        from horovod_tpu.analysis import ci
+
+        return ci.main(argv[1:])
     args = _build_parser().parse_args(argv)
     if args.list_rules:
         return _list_rules()

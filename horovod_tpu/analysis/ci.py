@@ -1,0 +1,119 @@
+"""``python -m horovod_tpu.analysis ci`` / ``hvdci`` — the one-shot CI
+entry point.
+
+Three gates, one invocation, one exit code (docs/perf_gate.md):
+
+1. **hvdlint** over the pre-commit scope (``--changed``: staged +
+   unstaged + untracked files under ``horovod_tpu/``; falls back to the
+   full package scan outside a git checkout — an sdist CI job still
+   gets linted, just wider);
+2. the **HLO/artifact rule pack** over every checked-in
+   ``BENCH_r0*.json`` / ``MULTICHIP_r0*.json``;
+3. the **perf gate** trajectory self-walk.
+
+The whole run is a tier-1 test with the same <30 s budget as the
+hvdlint self-run, so "CI passed" and "the analysis suite passed" are
+the same fact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from horovod_tpu.analysis import engine, hlo_lint, perf_gate
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis ci",
+        description="run hvdlint (--changed scope), the artifact rule "
+                    "pack and the perf gate in one invocation")
+    p.add_argument("--full", action="store_true",
+                   help="lint the whole package instead of the "
+                        "--changed scope")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: auto-detected)")
+    p.add_argument("--json", action="store_true", dest="json_out")
+    args = p.parse_args(argv)
+
+    t0 = time.perf_counter()
+    root = args.root or engine.find_repo_root(os.getcwd()) or os.getcwd()
+    pkg = os.path.join(root, "horovod_tpu")
+
+    # 1 — hvdlint
+    scope = "full"
+    paths: List[str] = [pkg]
+    if not args.full:
+        try:
+            changed = [f for f in engine.changed_files(root)
+                       if os.path.abspath(f).startswith(pkg + os.sep)]
+            paths, scope = changed, "--changed"
+        except Exception:          # noqa: BLE001 — not a git checkout
+            pass
+    baseline = os.path.join(root, "analysis_baseline.json")
+    if paths:
+        lint = engine.run_analysis(
+            paths, root=root,
+            baseline_path=baseline if os.path.exists(baseline) else None)
+    else:
+        lint = engine.Report(findings=[], suppressed=[], baselined=[])
+
+    # 2 — artifact rule pack (HLO001-HLO004 over the checked-in runs)
+    artifacts = perf_gate.default_trajectory(root)
+    art_findings = []
+    art_error = None
+    for art in artifacts:
+        try:
+            art_findings.extend(hlo_lint.lint_artifact_path(art))
+        except (OSError, json.JSONDecodeError) as e:
+            art_error = f"cannot read {art}: {e}"
+            break
+
+    # 3 — perf gate trajectory self-walk
+    gate_error = None
+    gate = None
+    if artifacts and art_error is None:
+        try:
+            gate = perf_gate.run_gate(artifacts)
+        except perf_gate.GateError as e:
+            gate_error = str(e)
+
+    elapsed = time.perf_counter() - t0
+    gate_findings = gate.findings if gate is not None else []
+    rc = 2 if (art_error or gate_error) else (
+        1 if (lint.findings or art_findings or gate_findings) else 0)
+
+    if args.json_out:
+        print(json.dumps({
+            "lint": dict(lint.as_json(), scope=scope),
+            "artifact_findings": [f.as_json() for f in art_findings],
+            "perf_gate": gate.as_json() if gate is not None else None,
+            "errors": [e for e in (art_error, gate_error) if e],
+            "elapsed_s": round(elapsed, 3),
+            "exit_code": rc,
+        }, indent=2))
+        return rc
+
+    for f in lint.findings:
+        print(f.format())
+    for f in art_findings:
+        print(f.format())
+    for f in gate_findings:
+        print(f.format())
+    for err in (art_error, gate_error):
+        if err:
+            print(f"hvdci: ERROR {err}", file=sys.stderr)
+    print(f"hvdci: lint[{scope}] {len(lint.findings)} · "
+          f"artifacts[{len(artifacts)}] {len(art_findings)} · "
+          f"perf-gate {len(gate_findings)} finding(s) "
+          f"in {elapsed:.2f}s — {'FAIL' if rc else 'ok'}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,386 @@
+"""Static HLO cost model: FLOPs, wire bytes per fabric level, memory
+high-water and a roofline step-time prediction — all without hardware.
+
+ROADMAP item 5 asks for a predictive cluster-scale model the autotuner
+and the (future) sharding-plan compiler can query before touching a
+chip.  Three layers, each usable alone:
+
+1. **Module accounting** — :func:`module_cost` parses a lowered
+   StableHLO / compiled-HLO dump (``utils/hlo.py`` parser) into
+   countable FLOPs (dot/convolution, fusion bodies included), collective
+   wire bytes attributed to the ICI vs DCN fabric level from the
+   replica-group structure, and a buffer-lifetime memory high-water
+   estimate per device.
+
+2. **Exchange model** — :func:`exchange_wire_bytes` prices the gradient
+   exchange per level from the mesh factorization alone: the two-level
+   path reduce-scatters the full payload over ICI but crosses DCN with
+   only the ``1/n_ici`` partial-sum shard at the (default int8) wire
+   width — the quantity ``utils/scaling.py`` now routes through here
+   instead of assuming a flat fp32 ring (the MULTICHIP v5e-64
+   projections overstated DCN traffic by ``4·n_ici×`` before this).
+
+3. **Calibrated roofline** — :func:`calibrate` fits per-workload-family
+   efficiency constants from the checked-in ``BENCH_r0*`` trajectory
+   (measured rate ÷ roofline ceiling, most recent artifact wins);
+   :func:`predict_rate` / :func:`predict_step_time_s` then predict new
+   configurations.  The perf gate (``analysis/perf_gate.py``) and the
+   autotune ``predict=`` path (``utils/autotune.py``) consume this.
+
+The module is stdlib-only (plus ``utils/hlo.py``, itself stdlib-only)
+so the analysis CLI stays importable without JAX.  Calibration
+procedure, roofline assumptions and their failure modes are documented
+in ``docs/perf_gate.md``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from horovod_tpu.utils import hlo as H
+
+# -- hardware ---------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class HardwareModel:
+    """Per-chip roofline constants for one accelerator generation."""
+
+    name: str
+    peak_flops_per_s: float     # bf16 matmul peak
+    hbm_bytes_per_s: float      # achievable HBM bandwidth
+    ici_bytes_per_s: float      # per-chip ICI link budget
+    dcn_bytes_per_s: float      # per-host DCN budget
+
+
+#: v5e figures: 197 bf16 TFLOP/s, ~810 GB/s measured HBM
+#: (PERF_NOTES.md hardware-envelope round), 1,600 Gbps ICI per chip,
+#: ~200 Gbps DCN per host — the same constants docs/scaling.md tables
+#: use.
+V5E = HardwareModel(name="v5e", peak_flops_per_s=197e12,
+                    hbm_bytes_per_s=810e9, ici_bytes_per_s=200e9,
+                    dcn_bytes_per_s=25e9)
+
+
+# -- exchange wire bytes per level ------------------------------------------
+
+
+def _ring_factor(n: int) -> float:
+    return (n - 1) / n if n > 1 else 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class WireBytes:
+    """Per-chip bytes on each fabric level for one gradient exchange
+    (reduce-scatter + allgather, i.e. one logical allreduce)."""
+
+    ici: float
+    dcn: float
+
+    @property
+    def total(self) -> float:
+        return self.ici + self.dcn
+
+
+def exchange_wire_bytes(payload_bytes: float,
+                        n_dcn: int = 1,
+                        n_ici: int = 1,
+                        hierarchy: str = "flat",
+                        wire_bits_dcn: int = 8,
+                        elem_bits: int = 32) -> WireBytes:
+    """Price one full gradient exchange per fabric level.
+
+    Both modes decompose hierarchically (XLA lowers multi-slice
+    collectives that way; the guards' ``[2,4]<=[8]`` replica groups are
+    exactly these two levels): a ring over ``n_ici`` chips inside the
+    slice and a ring over ``n_dcn`` slices across hosts, each costing
+    ``2·(n−1)/n·(bytes carried)`` per chip.
+
+    * ``flat``: the DCN hop carries the **full** payload at the element
+      width — ``2·(n_dcn−1)/n_dcn·B``.
+    * ``two_level``: the intra-slice reduce-scatter leaves only the
+      ``1/n_ici`` partial-sum shard to cross DCN, quantized to
+      ``wire_bits_dcn`` (int8 by default — the PR 2 DCN codec):
+      ``2·(n_dcn−1)/n_dcn·(B/n_ici)·(wire/elem)``.  This is the
+      correction :mod:`~horovod_tpu.utils.scaling` routes through.
+    """
+    if hierarchy not in ("flat", "two_level"):
+        raise ValueError(f"hierarchy must be flat|two_level, got "
+                         f"{hierarchy!r}")
+    n_dcn, n_ici = max(1, int(n_dcn)), max(1, int(n_ici))
+    ici = 2.0 * _ring_factor(n_ici) * payload_bytes
+    if hierarchy == "flat":
+        dcn = 2.0 * _ring_factor(n_dcn) * payload_bytes
+    else:
+        dcn = 2.0 * _ring_factor(n_dcn) * (payload_bytes / n_ici) \
+            * (wire_bits_dcn / elem_bits)
+    return WireBytes(ici=ici, dcn=dcn)
+
+
+def exchange_time_s(wire: WireBytes, hw: HardwareModel = V5E) -> float:
+    """Serial wire time of one exchange: each level at its own fabric
+    bandwidth (the levels cannot overlap each other — the DCN phase
+    consumes the ICI phase's output)."""
+    return wire.ici / hw.ici_bytes_per_s + wire.dcn / hw.dcn_bytes_per_s
+
+
+def _op_wire_bytes(op: H.CollectiveOp, world: int) -> float:
+    """Per-chip wire bytes of one compiled collective from its result
+    size: RS results are per-shard (input = bytes·g), AR/AG results are
+    the full payload."""
+    g = op.group_size or world
+    if g <= 1:
+        return 0.0
+    if op.kind == "all-reduce":
+        return 2.0 * _ring_factor(g) * op.bytes
+    if op.kind == "reduce-scatter":
+        return (g - 1) * op.bytes
+    if op.kind in ("all-gather", "all-to-all"):
+        return _ring_factor(g) * op.bytes
+    # permute / broadcast: the payload crosses once
+    return float(op.bytes)
+
+
+def collective_wire_by_level(ops: Sequence[H.CollectiveOp],
+                             n_dcn: int = 1,
+                             n_ici: int = 1) -> Dict[str, float]:
+    """Attribute each compiled collective's wire bytes to a fabric
+    level: an op whose replica-group size equals the DCN extent (on a
+    factored mesh) runs the cross-slice hop; everything else — the
+    intra-slice scopes and world-sized flat collectives — rides ICI.
+    This is the per-level measurement the overlap probe embeds in bench
+    artifacts (``exchange_wire_bytes_ici``/``_dcn``) for the perf gate
+    to diff."""
+    n_dcn, n_ici = max(1, int(n_dcn)), max(1, int(n_ici))
+    world = n_dcn * n_ici
+    out = {"ici": 0.0, "dcn": 0.0}
+    for op in ops:
+        level = "dcn" if n_dcn > 1 and op.group_size == n_dcn else "ici"
+        out[level] += _op_wire_bytes(op, world)
+    return out
+
+
+# -- whole-module static cost -----------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ModuleCost:
+    """Static accounting of one lowered module."""
+
+    flops: int                        # countable matmul-class FLOPs
+    wire_bytes: Dict[str, float]      # per-level collective bytes
+    memory_high_water_bytes: int      # buffer-lifetime peak estimate
+
+    def predicted_step_time_s(self, hw: HardwareModel = V5E,
+                              overlap_fraction: float = 0.0,
+                              efficiency: float = 1.0) -> float:
+        """Roofline step time: compute at ``efficiency × peak`` plus the
+        exposed share of the wire time.  ``efficiency`` comes from
+        :func:`calibrate` when a trajectory exists; 1.0 is the
+        theoretical floor."""
+        compute = self.flops / (hw.peak_flops_per_s * max(efficiency,
+                                                          1e-9))
+        wire = (self.wire_bytes.get("ici", 0.0) / hw.ici_bytes_per_s
+                + self.wire_bytes.get("dcn", 0.0) / hw.dcn_bytes_per_s)
+        return compute + wire * (1.0 - overlap_fraction)
+
+
+def module_cost(hlo_text: str, n_dcn: int = 1,
+                n_ici: int = 1) -> ModuleCost:
+    """Parse one HLO dump into the three static quantities the roofline
+    needs: FLOPs (:func:`~horovod_tpu.utils.hlo.module_flops`), wire
+    bytes per level, and the memory high-water estimate
+    (:func:`~horovod_tpu.utils.hlo.memory_high_water`)."""
+    ops = H.collective_ops(hlo_text)
+    return ModuleCost(
+        flops=H.module_flops(hlo_text),
+        wire_bytes=collective_wire_by_level(ops, n_dcn=n_dcn,
+                                            n_ici=n_ici),
+        memory_high_water_bytes=H.memory_high_water(hlo_text))
+
+
+# -- workload models + calibrated roofline ----------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadModel:
+    """Analytic per-unit costs of one bench family — the same FLOP
+    accounting ``bench.py`` prints (so model and measurement cannot
+    disagree about what a unit costs)."""
+
+    family: str                  # "resnet" | "transformer" | ...
+    rate_field: str              # the BENCH-JSON throughput field
+    unit: str                    # "img" | "token"
+    flops_per_unit: float
+    hbm_bytes_per_unit: float
+    units_per_step: float        # per-chip batch units in one step
+
+
+#: ResNet-50 HBM traffic per image at 224px: PERF_NOTES derives the
+#: per-op-fusion ceiling of ~4,100 img/s from ~810 GB/s of achievable
+#: bandwidth — i.e. ≈198 MB moved per image.  This is what makes the
+#: model HBM-bound on v5e (mfu ceiling ≈26%), which the roofline must
+#: know or it would predict 16,000 img/s from FLOPs alone.
+RESNET_HBM_BYTES_PER_IMG = 810e9 / 4100.0
+
+#: Parameter-traffic passes per step for the transformer HBM term:
+#: forward read + backward read + optimizer write (activations are
+#: small next to 871M params at batch 6).
+_PARAM_PASSES = 3
+
+
+def resnet_workload(image_size: int = 224,
+                    batch: int = 128) -> WorkloadModel:
+    scale = (image_size / 224.0) ** 2
+    return WorkloadModel(
+        family="resnet", rate_field="value", unit="img",
+        flops_per_unit=3 * 4.1e9 * scale,            # bench.py accounting
+        hbm_bytes_per_unit=RESNET_HBM_BYTES_PER_IMG * scale,
+        units_per_step=batch)
+
+
+def transformer_workload(params: float, layers: int = 16,
+                         d_model: int = 2048, seq: int = 1024,
+                         batch: int = 6,
+                         param_bytes: int = 2) -> WorkloadModel:
+    tokens_per_step = batch * seq
+    return WorkloadModel(
+        family="transformer", rate_field="transformer_tokens_per_sec",
+        unit="token",
+        flops_per_unit=6 * params + 6 * layers * seq * d_model,
+        hbm_bytes_per_unit=_PARAM_PASSES * param_bytes * params
+        / tokens_per_step,
+        units_per_step=tokens_per_step)
+
+
+def roofline_rate(w: WorkloadModel, hw: HardwareModel = V5E) -> float:
+    """units/sec ceiling: the binding one of the compute and HBM
+    rooflines.  ResNet-50 binds on HBM (~4,100 img/s on v5e), the
+    flagship transformer on compute (~36,300 tok/s)."""
+    return min(hw.peak_flops_per_s / w.flops_per_unit,
+               hw.hbm_bytes_per_s / w.hbm_bytes_per_unit)
+
+
+def workloads_from_artifact(artifact: Dict) -> List[WorkloadModel]:
+    """The workload models a bench artifact carries evidence for.
+    Transformer shape is keyed off ``transformer_params_m`` (the
+    flagship layer/seq defaults otherwise match every checked-in
+    round); artifacts without a family's fields contribute nothing."""
+    out: List[WorkloadModel] = []
+    if artifact.get("metric") == "resnet50_img_sec_per_chip" \
+            and artifact.get("value") is not None:
+        out.append(resnet_workload())
+    params_m = artifact.get("transformer_params_m")
+    if params_m is not None \
+            and artifact.get("transformer_tokens_per_sec") is not None:
+        out.append(transformer_workload(params=float(params_m) * 1e6))
+    return out
+
+
+@dataclasses.dataclass
+class Calibration:
+    """Fitted per-family efficiency constants (measured rate ÷ roofline
+    ceiling).  ``efficiency`` keeps the most recent fit — the newest
+    hardware measurement is the prediction anchor — while ``samples``
+    retains the whole trajectory for drift inspection."""
+
+    hw: HardwareModel
+    efficiency: Dict[str, float]
+    samples: Dict[str, List[Tuple[str, float]]]   # family → (src, eff)
+
+
+ArtifactLike = Union[str, os.PathLike, Dict]
+
+
+def _load_artifact(artifact: ArtifactLike) -> Tuple[str, Dict]:
+    if isinstance(artifact, dict):
+        data = artifact
+        name = str(data.get("metric", "<dict>"))
+    else:
+        name = os.path.basename(os.fspath(artifact))
+        with open(artifact) as f:
+            data = json.load(f)
+    if isinstance(data.get("parsed"), dict):     # MULTICHIP/driver wrapper
+        data = dict(data, **data["parsed"])
+    return name, data
+
+
+def calibrate(artifacts: Sequence[ArtifactLike],
+              hw: HardwareModel = V5E) -> Calibration:
+    """Fit the roofline's per-family efficiency from a BENCH trajectory.
+
+    For every artifact (in the given order — pass them oldest→newest)
+    and every workload family it measures, the sample is
+    ``measured_rate / roofline_rate``; the calibrated constant is the
+    LAST sample per family.  Deterministic: same inputs, same
+    calibration — the perf gate's two-run identity check relies on it.
+    """
+    eff: Dict[str, float] = {}
+    samples: Dict[str, List[Tuple[str, float]]] = {}
+    for art in artifacts:
+        name, data = _load_artifact(art)
+        for w in workloads_from_artifact(data):
+            rate = data.get(w.rate_field)
+            if rate is None:
+                continue
+            ceiling = roofline_rate(w, hw)
+            e = float(rate) / ceiling
+            eff[w.family] = e
+            samples.setdefault(w.family, []).append((name, e))
+    return Calibration(hw=hw, efficiency=eff, samples=samples)
+
+
+def predict_rate(cal: Calibration, w: WorkloadModel) -> Optional[float]:
+    """Calibrated units/sec prediction, or None for an unseen family."""
+    e = cal.efficiency.get(w.family)
+    if e is None:
+        return None
+    return e * roofline_rate(w, cal.hw)
+
+
+def predict_step_time_s(cal: Calibration, w: WorkloadModel,
+                        exposed_comm_s: float = 0.0) -> Optional[float]:
+    """Predicted per-step wall time: batch units at the calibrated rate
+    plus whatever exchange time is left exposed (0 on one chip;
+    :func:`exchange_time_s` × (1 − overlap) on a mesh)."""
+    rate = predict_rate(cal, w)
+    if rate is None or rate <= 0:
+        return None
+    return w.units_per_step / rate + exposed_comm_s
+
+
+# -- autotune predictor ------------------------------------------------------
+
+
+def make_fusion_predictor(payload_bytes: float, n_leaves: int,
+                          world: int = 8, hw: HardwareModel = V5E,
+                          dispatch_latency_s: float = 1e-3):
+    """Score function for the eager-plane autotune grid
+    (``utils/autotune.py`` ``predict=``): predicted bytes/sec of one
+    gradient exchange under a ``(fusion_threshold_bytes,
+    cycle_time_ms)`` point.
+
+    Model: a threshold of T splits the payload into ``ceil(B/T)``
+    flushes (T = 0 flushes per tensor), each paying one dispatch
+    latency; the wire itself is the flat ring ``2·(N−1)/N·B`` at ICI
+    bandwidth; the flush interval adds half a cycle of expected queue
+    wait.  Crude on purpose — it only needs to RANK the warm-up grid so
+    the manager measures the plausible half instead of all of it (the
+    measurement, not the model, still picks the winner)."""
+    def predict(point) -> float:
+        threshold, cycle_ms = point
+        if threshold and threshold > 0:
+            flushes = max(1, math.ceil(payload_bytes / threshold))
+        else:
+            flushes = max(1, int(n_leaves))
+        wire_s = 2.0 * _ring_factor(max(1, world)) * payload_bytes \
+            / hw.ici_bytes_per_s
+        t = flushes * dispatch_latency_s + wire_s \
+            + (float(cycle_ms) / 1e3) / 2.0
+        return payload_bytes / t
+
+    return predict

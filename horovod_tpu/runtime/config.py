@@ -62,6 +62,9 @@ KNOWN_KNOBS = frozenset({
     "HOROVOD_ELASTIC_HEARTBEAT_SUSPECT_MISSES",
     "HOROVOD_ELASTIC_HEARTBEAT_DEAD_S",
     "HOROVOD_ELASTIC_PROGRESS_TIMEOUT_S",
+    # -- perf regression gate (analysis/perf_gate.py, docs/perf_gate.md)
+    "HOROVOD_PERF_GATE_TOLERANCE", "HOROVOD_PERF_GATE_OVERLAP_TOLERANCE",
+    "HOROVOD_PERF_GATE_WIRE_TOLERANCE",
     # -- health / quarantine / retry / chaos
     "HOROVOD_QUARANTINE_BASE_S", "HOROVOD_QUARANTINE_MAX_S",
     "HOROVOD_QUARANTINE_PROBATION_S", "HOROVOD_QUARANTINE_DISABLE",

@@ -41,14 +41,28 @@ _WARMUP_GRID: List[Tuple[int, float]] = [
 _BO_BOUNDS = [(20.0, 28.0), (1.0, 20.0)]
 _BO_SAMPLES = 8
 
+#: warm-up points still MEASURED after cost-model pruning (predict=):
+#: the model ranks, the measurement decides — two survivors keep the
+#: decision empirical while skipping the predicted-hopeless majority
+_PREDICT_KEEP = 2
+
 
 class ParameterManager:
-    def __init__(self, config, log_path: Optional[str] = None):
+    """Online knob tuner; ``predict=`` (a scorer like
+    ``analysis.cost_model.make_fusion_predictor``) pre-prunes the
+    categorical warm-up grid by predicted bytes/sec so only the
+    plausible points pay for measurement steps — the ISSUE-7 path that
+    queries the static cost model before touching hardware."""
+
+    def __init__(self, config, log_path: Optional[str] = None,
+                 predict=None):
         self._config = config
         self._tunable = [k for k in ("fusion_threshold_bytes", "cycle_time_ms")
                          if k not in config.fixed_knobs]
         self._samples_per_point = config.autotune_steps_per_sample
         self._points = list(_WARMUP_GRID)
+        if predict is not None:
+            self._points = self._prune_by_prediction(predict)
         self._scores: List[Tuple[float, Tuple[int, float]]] = []
         self._point_idx = 0
         self._bytes_this_point = 0
@@ -64,6 +78,30 @@ class ParameterManager:
             config, 'autotune_gaussian_process_noise', 0.8)
         if not self._done:
             self._apply(self._points[0])
+
+    def _prune_by_prediction(self, predict) -> List[Tuple[int, float]]:
+        """Rank the warm-up grid by the cost model's predicted score
+        and keep the top ``_PREDICT_KEEP`` points (grid order
+        preserved).  A predictor that throws falls back to the full
+        grid — a broken model must cost tuning time, never correctness.
+        The Bayesian refinement after the warm-up is untouched: it can
+        still walk back into pruned territory if the measurements
+        disagree with the model."""
+        try:
+            scored = sorted(((float(predict(p)), p)
+                             for p in self._points),
+                            key=lambda s: -s[0])
+        except Exception as e:  # noqa: BLE001 — prediction is advisory
+            hvd_logging.warning(
+                "autotune: predict scorer failed (%s); measuring the "
+                "full warm-up grid", e)
+            return list(self._points)
+        top = {p for _, p in scored[:_PREDICT_KEEP]}
+        kept = [p for p in self._points if p in top]
+        hvd_logging.info(
+            "autotune: cost model pruned the warm-up grid %d -> %d "
+            "points (%s)", len(self._points), len(kept), kept)
+        return kept
 
     @property
     def active(self) -> bool:

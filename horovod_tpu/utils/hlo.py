@@ -148,6 +148,199 @@ def collective_ops(hlo_text: str) -> List[CollectiveOp]:
     return ops
 
 
+# -- whole-module accounting (cost model substrate) -------------------------
+#
+# The collective parser above serves the fusion guards; the functions
+# below extend the same text-level parse to the quantities the static
+# cost model (analysis/cost_model.py, docs/perf_gate.md) needs from a
+# lowered module without hardware: per-op FLOPs for the compute ceiling
+# and buffer lifetimes for a memory high-water estimate.
+
+# any op-definition line: "%name = <result-type> <opcode>(..."
+_ANY_OP_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?(%[\w.\-]+)\s*=\s*(.+?)(?<=[\]})])\s*\b([\w\-]+)\(")
+_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([\d,]*)\}")
+_DIM_LABELS_RE = re.compile(r"dim_labels=(\w+)_(\w+)->(\w+)")
+# every %name token on a line (defs and uses alike)
+_NAME_RE = re.compile(r"%[\w.\-]+")
+
+
+def _prod(dims) -> int:
+    n = 1
+    for d in dims:
+        n *= d
+    return n
+
+
+def result_bytes(result_type: str) -> int:
+    """Payload bytes of one result type string — tuple types sum their
+    elements (the tuple-wrapped async-start variants parse like any
+    other tuple; their u32[] context scalars are 4 bytes of noise in a
+    *memory* estimate, unlike the wire accounting above where
+    :func:`collective_ops` strips them)."""
+    return _nbytes(_parse_shapes(result_type))
+
+
+def _operand_shapes(line: str, opcode: str):
+    """Typed operand shapes of an op line: the shapes inside the
+    ``opcode(...)`` parens.  Dumps that elide operand types (bare
+    ``dot(%a, %b)``) yield [] — FLOP counting then skips the op rather
+    than guessing."""
+    start = line.find(opcode + "(")
+    if start < 0:
+        return []
+    seg, depth = [], 0
+    for ch in line[start + len(opcode):]:
+        if ch == "(":
+            depth += 1
+            if depth == 1:
+                continue
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                break
+        seg.append(ch)
+    shapes = []
+    for dt, dims in _SHAPE_RE.findall("".join(seg)):
+        if dt not in _DTYPE_BYTES:
+            continue
+        shapes.append((dt, tuple(int(d) for d in dims.split(",") if d)
+                       if dims else ()))
+    return shapes
+
+
+def _dot_flops(line: str, result_dims, opcode: str) -> Optional[int]:
+    """``2 · |result| · K`` for a dot: every output element costs one
+    multiply-add per contracted element.  K comes from the lhs operand
+    type + ``lhs_contracting_dims``; batch dims are already in the
+    result product."""
+    operands = _operand_shapes(line, opcode)
+    m = _CONTRACT_RE.search(line)
+    if not operands or m is None:
+        return None
+    lhs_dims = operands[0][1]
+    contract = [int(x) for x in m.group(1).split(",") if x != ""]
+    if any(c >= len(lhs_dims) for c in contract):
+        return None
+    k = _prod(lhs_dims[c] for c in contract)
+    return 2 * _prod(result_dims) * k
+
+
+def _conv_flops(line: str, result_dims, opcode: str) -> Optional[int]:
+    """``2 · |result| · (kernel elements per output feature)`` for a
+    convolution: each output element reduces over the kernel's spatial
+    × input-feature window.  The kernel's output-feature dim (``o`` in
+    ``dim_labels``' second segment) is excluded — it indexes outputs,
+    it is not reduced over."""
+    operands = _operand_shapes(line, opcode)
+    m = _DIM_LABELS_RE.search(line)
+    if len(operands) < 2 or m is None:
+        return None
+    kernel_dims = operands[1][1]
+    kernel_labels = m.group(2)
+    o_idx = kernel_labels.find("o")
+    if o_idx < 0 or o_idx >= len(kernel_dims) or kernel_dims[o_idx] == 0:
+        return None
+    window = _prod(kernel_dims) // kernel_dims[o_idx]
+    return 2 * _prod(result_dims) * window
+
+
+def op_flops(hlo_text: str) -> List[Tuple[str, str, int]]:
+    """``(op_name, opcode, flops)`` for every countable matmul-class op
+    (``dot``, ``convolution``) in the module text.
+
+    Fusion bodies are separate computations in the same dump, so a
+    ``fusion(...)`` op's inner dots are counted exactly once — at their
+    definition inside the fused computation — and the ``fusion`` line
+    itself contributes nothing.  Elementwise/reduce ops are ignored:
+    on the MXU the matmul class is the FLOP budget (everything else is
+    the memory-bound remainder the roofline's HBM term covers)."""
+    out: List[Tuple[str, str, int]] = []
+    for line in hlo_text.splitlines():
+        m = _ANY_OP_RE.match(line)
+        if m is None:
+            continue
+        name, result_type, opcode = m.group(1), m.group(2), m.group(3)
+        result_dims = [dims for dt, dims in _parse_shapes(result_type)]
+        if not result_dims:
+            continue
+        flops = None
+        if opcode == "dot":
+            flops = _dot_flops(line, result_dims[0], opcode)
+        elif opcode == "convolution":
+            flops = _conv_flops(line, result_dims[0], opcode)
+        if flops:
+            out.append((name, opcode, flops))
+    return out
+
+
+def module_flops(hlo_text: str) -> int:
+    """Total countable FLOPs of one module dump (see :func:`op_flops`)."""
+    return sum(f for _, _, f in op_flops(hlo_text))
+
+
+def entry_computation(hlo_text: str) -> str:
+    """The ENTRY computation's lines (between ``ENTRY ... {`` and its
+    matching brace), or the whole text when no ENTRY marker exists.
+    Memory accounting scopes here: fusion-body instructions never
+    materialize their own buffers, so counting them would double-book
+    the fusion op's result."""
+    lines = hlo_text.splitlines()
+    start = next((i for i, ln in enumerate(lines)
+                  if ln.lstrip().startswith("ENTRY ")), None)
+    if start is None:
+        return hlo_text
+    depth, out = 0, []
+    for ln in lines[start:]:
+        depth += ln.count("{") - ln.count("}")
+        out.append(ln)
+        if depth <= 0 and out:
+            break
+    return "\n".join(out)
+
+
+def buffer_liveness(hlo_text: str) -> List[Tuple[str, int, int, int]]:
+    """``(name, bytes, def_index, last_use_index)`` per ENTRY-scope
+    instruction, indices into the ENTRY line list.  A buffer is modeled
+    live from its defining line through the last line that mentions it
+    (a never-used def dies on its own line) — the classic linear-scan
+    lifetime, ignoring aliasing/donation, so the estimate is an upper
+    bound."""
+    lines = entry_computation(hlo_text).splitlines()
+    defs: List[Tuple[str, int, int]] = []        # (name, bytes, def idx)
+    last_use: dict = {}
+    for i, line in enumerate(lines):
+        m = _ANY_OP_RE.match(line)
+        if m is not None:
+            defs.append((m.group(1), result_bytes(m.group(2)), i))
+        for name in _NAME_RE.findall(line):
+            last_use[name] = i
+    return [(name, nbytes, d, max(last_use.get(name, d), d))
+            for name, nbytes, d in defs]
+
+
+def memory_high_water(hlo_text: str) -> int:
+    """Peak sum of simultaneously-live ENTRY buffers — the static
+    per-device memory high-water estimate the cost model reports
+    (docs/perf_gate.md lists the assumptions: no aliasing, no
+    donation, tuple results counted whole)."""
+    live = buffer_liveness(hlo_text)
+    if not live:
+        return 0
+    n = max(last for _, _, _, last in live) + 1
+    alloc = [0] * n
+    free = [0] * n
+    for _, nbytes, d, last in live:
+        alloc[d] += nbytes
+        free[last] += nbytes
+    cur = peak = 0
+    for i in range(n):
+        cur += alloc[i]
+        peak = max(peak, cur)
+        cur -= free[i]
+    return peak
+
+
 def count_by_kind(ops: List[CollectiveOp]) -> dict:
     out: dict = {}
     for o in ops:

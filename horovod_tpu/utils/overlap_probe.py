@@ -78,6 +78,11 @@ class OverlapReport:
     rs_scopes: tuple = ()
     ag_scopes: tuple = ()
     grad_sized_allreduces: int = 0
+    # per-level wire bytes of the compiled exchange (cost-model
+    # attribution over the parsed collectives) — the perf gate diffs
+    # these across artifacts (PERF003, docs/perf_gate.md)
+    wire_bytes_ici: Optional[int] = None
+    wire_bytes_dcn: Optional[int] = None
 
     def as_bench_fields(self, prefix: str = "") -> dict:
         """The fields ``bench.py`` merges into the bench JSON."""
@@ -101,6 +106,11 @@ class OverlapReport:
             # wire of the run that produced this JSON
             fields[f"{prefix}exchange_grad_sized_allreduces"] = \
                 int(self.grad_sized_allreduces)
+        if self.wire_bytes_ici is not None:
+            fields[f"{prefix}exchange_wire_bytes_ici"] = \
+                int(self.wire_bytes_ici)
+            fields[f"{prefix}exchange_wire_bytes_dcn"] = \
+                int(self.wire_bytes_dcn or 0)
         return fields
 
 
@@ -221,6 +231,7 @@ def measure_overlap(loss_fn: Callable,
     rs_scopes: tuple = ()
     ag_scopes: tuple = ()
     grad_ars = 0
+    wire_ici = wire_dcn = None
     payload = sum(x.size * x.dtype.itemsize
                   for x in jax.tree_util.tree_leaves(grads))
     try:
@@ -231,6 +242,17 @@ def measure_overlap(loss_fn: Callable,
         ag_scopes = scopes.get("all-gather", ())
         grad_ars = sum(1 for o in ops if o.kind == "all-reduce"
                        and o.bytes >= payload)
+        # per-level wire attribution from the compiled collectives —
+        # measured structure, not the analytic model, so a
+        # de-quantized DCN hop or a de-fused exchange shows up as more
+        # bytes in the run's own artifact (perf gate PERF003)
+        from horovod_tpu.analysis import cost_model as CM
+
+        n_outer = mesh.shape[axes[0]] if len(axes) == 2 else 1
+        levels = CM.collective_wire_by_level(
+            ops, n_dcn=n_outer, n_ici=mesh.shape[axes[-1]])
+        wire_ici = int(levels["ici"])
+        wire_dcn = int(levels["dcn"])
     except Exception:      # noqa: BLE001 — structure report is advisory
         pass
 
@@ -255,4 +277,5 @@ def measure_overlap(loss_fn: Callable,
         hierarchy=mode,
         exchange_intra_s=t_intra, exchange_cross_s=t_cross,
         rs_scopes=rs_scopes, ag_scopes=ag_scopes,
-        grad_sized_allreduces=grad_ars)
+        grad_sized_allreduces=grad_ars,
+        wire_bytes_ici=wire_ici, wire_bytes_dcn=wire_dcn)

@@ -36,10 +36,47 @@ def allreduce_wire_bytes(payload_bytes: float, n_chips: int) -> float:
     ``payload_bytes``: reduce-scatter + all-gather phases each move
     ``(N-1)/N`` of the payload (``2·(N-1)/N·B`` total).  XLA's TPU
     all-reduce is bandwidth-optimal on torus meshes, so the ring bound
-    is the right cost model (scaling-book recipe)."""
-    if n_chips <= 1:
-        return 0.0
-    return 2.0 * (n_chips - 1) / n_chips * payload_bytes
+    is the right cost model (scaling-book recipe).
+
+    This is the single-fabric (flat, full-width) cost; the two-level
+    exchange prices per level through
+    :func:`exchange_wire_bytes` — the same cost model
+    (``analysis/cost_model.py``) both this module and the perf gate
+    consume."""
+    from horovod_tpu.analysis import cost_model as CM
+
+    return CM.exchange_wire_bytes(payload_bytes, n_dcn=1,
+                                  n_ici=n_chips).ici
+
+
+def exchange_wire_bytes(payload_bytes: float, n_chips: int,
+                        hierarchy: str = "flat",
+                        n_ici: Optional[int] = None,
+                        wire_bits_dcn: int = 8):
+    """Per-level per-chip wire bytes of one gradient exchange over
+    ``n_chips`` split as ``(n_chips/n_ici) × n_ici`` (dcn × ici) —
+    delegated to :func:`horovod_tpu.analysis.cost_model.\
+exchange_wire_bytes`.  With ``hierarchy="two_level"`` the DCN hop
+    carries only the ``1/n_ici`` partial-sum shard at ``wire_bits_dcn``
+    (the int8 DCN codec), which is what the old flat-fp32-only model
+    overstated for the MULTICHIP v5e-64 projections.  Returns the cost
+    model's ``WireBytes`` (``.ici``/``.dcn``/``.total``)."""
+    from horovod_tpu.analysis import cost_model as CM
+
+    if n_ici in (None, 0):
+        if hierarchy == "two_level":
+            raise ValueError(
+                "hierarchy='two_level' needs n_ici (chips per slice) "
+                "to split the mesh; pass e.g. n_ici=4 for v5e hosts")
+        n_dcn, n_inner = 1, n_chips
+    else:
+        if n_chips % n_ici:
+            raise ValueError(
+                f"n_chips={n_chips} is not divisible by n_ici={n_ici}")
+        n_dcn, n_inner = n_chips // n_ici, n_ici
+    return CM.exchange_wire_bytes(payload_bytes, n_dcn=n_dcn,
+                                  n_ici=n_inner, hierarchy=hierarchy,
+                                  wire_bits_dcn=wire_bits_dcn)
 
 
 def step_payload_bytes(params) -> int:
@@ -88,12 +125,47 @@ def resolve_overlap_fraction(
     return 0.0
 
 
+def hierarchy_from_artifact(
+        artifact: Union[str, os.PathLike, dict],
+        prefix: str = "") -> Optional[str]:
+    """The exchange topology a BENCH artifact ran
+    (``{prefix}exchange_hierarchy``, emitted by the overlap probe), or
+    None when the run had no sharded exchange."""
+    if not isinstance(artifact, dict):
+        with open(artifact) as f:
+            artifact = json.loads(f.readline())
+    val = artifact.get(prefix + "exchange_hierarchy")
+    return None if val is None else str(val)
+
+
+def resolve_exchange_hierarchy(hierarchy: Optional[str] = None,
+                               artifact=None, prefix: str = "") -> str:
+    """Same precedence discipline as
+    :func:`resolve_overlap_fraction`: an explicit mode wins, else the
+    artifact's measured ``exchange_hierarchy``, else ``"flat"`` — the
+    conservative (most wire) assumption, never a silently-invented
+    topology."""
+    if hierarchy is not None:
+        if hierarchy not in ("flat", "two_level"):
+            raise ValueError(f"hierarchy must be flat|two_level, got "
+                             f"{hierarchy!r}")
+        return hierarchy
+    if artifact is not None:
+        measured = hierarchy_from_artifact(artifact, prefix)
+        if measured is not None:
+            return measured
+    return "flat"
+
+
 @dataclasses.dataclass
 class ScalingPoint:
     n_chips: int
     comm_time_s: float        # full (unoverlapped) wire time
     exposed_time_s: float     # comm left over after overlap
     efficiency: float         # step_time / (step_time + exposed)
+    hierarchy: str = "flat"   # exchange topology the wire was priced at
+    wire_bytes_ici: float = 0.0   # per-chip bytes on the ICI fabric
+    wire_bytes_dcn: float = 0.0   # per-chip bytes crossing DCN
 
 
 def scaling_efficiency(step_time_s: float,
@@ -102,7 +174,12 @@ def scaling_efficiency(step_time_s: float,
                        link_bytes_per_s: float = V5E_ICI_BYTES_PER_S,
                        overlap_fraction: Optional[float] = None,
                        artifact=None,
-                       artifact_prefix: str = "") -> ScalingPoint:
+                       artifact_prefix: str = "",
+                       hierarchy: Optional[str] = None,
+                       n_ici: Optional[int] = None,
+                       dcn_bytes_per_s: float =
+                       V5E_DCN_BYTES_PER_S_PER_HOST,
+                       wire_bits_dcn: int = 8) -> ScalingPoint:
     """Modeled weak-scaling efficiency at ``n_chips``.
 
     ``overlap_fraction`` is how much of the collective hides under
@@ -113,14 +190,32 @@ def scaling_efficiency(step_time_s: float,
     fully-exposed worst case (0.0) applies: collective serial after
     the backward pass.  Efficiency is per-step throughput relative to
     the single-chip rate: ``t / (t + exposed)``.
+
+    The wire is priced by the cost model
+    (``analysis/cost_model.py``), hierarchy-aware: with ``n_ici``
+    (chips per slice) the mesh factors into ``(n_chips/n_ici) ×
+    n_ici`` and each level pays its own fabric — ICI at
+    ``link_bytes_per_s``, DCN at ``dcn_bytes_per_s`` — with
+    ``hierarchy="two_level"`` crossing DCN at ``wire_bits_dcn`` on the
+    ``1/n_ici`` shard (the int8 DCN codec).  ``hierarchy`` resolves
+    like overlap: explicit > the artifact's measured
+    ``exchange_hierarchy`` > ``"flat"``.  Without ``n_ici`` the mesh
+    is a single ICI domain — exactly the old flat model.
     """
     overlap = resolve_overlap_fraction(overlap_fraction, artifact,
                                        artifact_prefix)
-    comm = allreduce_wire_bytes(payload_bytes, n_chips) / link_bytes_per_s
+    mode = resolve_exchange_hierarchy(hierarchy, artifact,
+                                      artifact_prefix)
+    wire = exchange_wire_bytes(payload_bytes, n_chips, hierarchy=mode,
+                               n_ici=n_ici,
+                               wire_bits_dcn=wire_bits_dcn)
+    comm = wire.ici / link_bytes_per_s + wire.dcn / dcn_bytes_per_s
     exposed = comm * (1.0 - overlap)
     return ScalingPoint(
         n_chips=n_chips, comm_time_s=comm, exposed_time_s=exposed,
-        efficiency=step_time_s / (step_time_s + exposed))
+        efficiency=step_time_s / (step_time_s + exposed),
+        hierarchy=mode, wire_bytes_ici=wire.ici,
+        wire_bytes_dcn=wire.dcn)
 
 
 def efficiency_curve(step_time_s: float, payload_bytes: float,
@@ -128,11 +223,20 @@ def efficiency_curve(step_time_s: float, payload_bytes: float,
                      link_bytes_per_s: float = V5E_ICI_BYTES_PER_S,
                      overlap_fraction: Optional[float] = None,
                      artifact=None,
-                     artifact_prefix: str = ""):
+                     artifact_prefix: str = "",
+                     hierarchy: Optional[str] = None,
+                     n_ici: Optional[int] = None,
+                     dcn_bytes_per_s: float =
+                     V5E_DCN_BYTES_PER_S_PER_HOST,
+                     wire_bits_dcn: int = 8):
     """One :class:`ScalingPoint` per chip count (docs/scaling.md
-    table); ``artifact=`` sources the measured overlap exactly as in
-    :func:`scaling_efficiency`."""
+    table); ``artifact=`` sources the measured overlap AND exchange
+    hierarchy exactly as in :func:`scaling_efficiency`, and ``n_ici``
+    makes every point a two-fabric ``(n/n_ici) × n_ici`` mesh."""
     return [scaling_efficiency(step_time_s, payload_bytes, n,
                                link_bytes_per_s, overlap_fraction,
-                               artifact, artifact_prefix)
+                               artifact, artifact_prefix,
+                               hierarchy=hierarchy, n_ici=n_ici,
+                               dcn_bytes_per_s=dcn_bytes_per_s,
+                               wire_bits_dcn=wire_bits_dcn)
             for n in chip_counts]

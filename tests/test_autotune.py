@@ -92,6 +92,67 @@ class TestParameterManagerLifecycle:
         assert cfg.fusion_threshold_bytes == 123456
 
 
+class TestPredictPath:
+    """ISSUE 7: ``predict=`` queries the static cost model to prune the
+    warm-up grid before any hardware measurement — the model ranks,
+    the measurement still decides."""
+
+    def _run_to_convergence(self, pm, cfg):
+        total = len(_WARMUP_GRID) + cfg.autotune_bayes_opt_max_samples + 1
+        steps = 0
+        while pm.active and steps < total * cfg.autotune_steps_per_sample + 10:
+            pm.record_bytes(1 << 20)
+            steps += 1
+        assert not pm.active
+
+    def test_prunes_warmup_grid_to_top_predictions(self):
+        from horovod_tpu.utils.autotune import MiB, _PREDICT_KEEP
+
+        cfg = Config(autotune=True, autotune_steps_per_sample=2,
+                     autotune_bayes_opt_max_samples=2)
+        # favor large fusion thresholds (fewer flushes): the two
+        # biggest grid points survive, in grid order
+        pm = ParameterManager(cfg, predict=lambda p: p[0])
+        assert len(pm._points) == _PREDICT_KEEP
+        assert pm._points == [(64 * MiB, 5.0), (128 * MiB, 10.0)]
+        self._run_to_convergence(pm, cfg)
+
+    def test_cost_model_predictor_end_to_end(self):
+        """The real predictor (analysis/cost_model.py) drives the
+        pruning and the manager still converges to an applied point."""
+        from horovod_tpu.analysis.cost_model import make_fusion_predictor
+
+        cfg = Config(autotune=True, autotune_steps_per_sample=2,
+                     autotune_bayes_opt_max_samples=2)
+        predict = make_fusion_predictor(
+            payload_bytes=64 << 20, n_leaves=300, world=8)
+        pm = ParameterManager(cfg, predict=predict)
+        # per-tensor flushing (threshold 0) is predicted hopeless for a
+        # 300-leaf payload — it must be pruned away
+        assert all(p[0] != 0 for p in pm._points)
+        self._run_to_convergence(pm, cfg)
+
+    def test_broken_predictor_falls_back_to_full_grid(self):
+        cfg = Config(autotune=True, autotune_steps_per_sample=2,
+                     autotune_bayes_opt_max_samples=2)
+
+        def boom(point):
+            raise RuntimeError("model unavailable")
+
+        pm = ParameterManager(cfg, predict=boom)
+        assert pm._points == list(_WARMUP_GRID)
+
+    def test_fixed_knobs_still_respected_under_predict(self):
+        cfg = Config(autotune=True, fusion_threshold_bytes=123456,
+                     fixed_knobs=frozenset({"fusion_threshold_bytes"}))
+        pm = ParameterManager(cfg, predict=lambda p: p[0])
+        for _ in range(200):
+            if not pm.active:
+                break
+            pm.record_bytes(1 << 20)
+        assert cfg.fusion_threshold_bytes == 123456
+
+
 class TestThroughputAutotuner:
     """Offline jit-knob tuner (bench.py --autotune): coordinate descent
     with memoization over the knobs that move measured throughput."""

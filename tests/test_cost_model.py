@@ -1,0 +1,260 @@
+"""Static HLO cost model (analysis/cost_model.py + the utils/hlo.py
+parser extensions): FLOP-counting fixtures for dot/convolution/fusion,
+buffer-lifetime memory accounting, per-level wire attribution, and the
+calibrated-roofline acceptance bar — predicted step time within 25% of
+measured on BENCH_r05 for both flagship models, held-out (calibrated
+on r01–r04 only)."""
+
+import glob
+import json
+from pathlib import Path
+
+import pytest
+
+from horovod_tpu.analysis import cost_model as CM
+from horovod_tpu.utils import hlo as H
+
+REPO = Path(__file__).resolve().parent.parent
+
+DOT_LINE = ("  %dot.1 = f32[6,1024,32000]{2,1,0} "
+            "dot(f32[6,1024,2048]{2,1,0} %x, f32[2048,32000]{1,0} %w), "
+            "lhs_contracting_dims={2}, rhs_contracting_dims={0}")
+CONV_LINE = ("  %conv = f32[128,112,112,64]{3,2,1,0} "
+             "convolution(f32[128,224,224,3]{3,2,1,0} %x, "
+             "f32[7,7,3,64]{3,2,1,0} %k), "
+             "window={size=7x7 stride=2x2 pad=3_3x3_3}, "
+             "dim_labels=b01f_01io->b01f")
+
+FUSION_MODULE = """\
+%fused_computation.1 (p0: f32[4,8], p1: f32[8,2]) -> f32[4,2] {
+  %p0 = f32[4,8]{1,0} parameter(0)
+  %p1 = f32[8,2]{1,0} parameter(1)
+  ROOT %d = f32[4,2]{1,0} dot(f32[4,8]{1,0} %p0, f32[8,2]{1,0} %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+
+ENTRY %main (a: f32[4,8], b: f32[8,2]) -> f32[4,2] {
+  %a = f32[4,8]{1,0} parameter(0)
+  %b = f32[8,2]{1,0} parameter(1)
+  ROOT %fusion = f32[4,2]{1,0} fusion(f32[4,8]{1,0} %a, f32[8,2]{1,0} %b), kind=kOutput, calls=%fused_computation.1
+}
+"""
+
+
+class TestFlopCounting:
+    def test_dot_flops(self):
+        """2 · |result| · K: batch dims ride the result product, K from
+        lhs_contracting_dims against the lhs operand type."""
+        [(name, kind, flops)] = H.op_flops(DOT_LINE)
+        assert (name, kind) == ("%dot.1", "dot")
+        assert flops == 2 * 6 * 1024 * 32000 * 2048
+        assert H.module_flops(DOT_LINE) == flops
+
+    def test_convolution_flops(self):
+        """2 · |result| · kernel-window (spatial × input features; the
+        o dim of dim_labels' kernel segment indexes outputs and is
+        excluded)."""
+        [(name, kind, flops)] = H.op_flops(CONV_LINE)
+        assert (name, kind) == ("%conv", "convolution")
+        assert flops == 2 * (128 * 112 * 112 * 64) * (7 * 7 * 3)
+
+    def test_fusion_body_counted_once(self):
+        """Fusion bodies are separate computations in the same dump:
+        the inner dot counts at its definition, the fusion() op line
+        itself contributes nothing — no double counting."""
+        assert H.module_flops(FUSION_MODULE) == 2 * 4 * 2 * 8
+
+    def test_untyped_operands_are_skipped_not_guessed(self):
+        bare = ("  %d = f32[4,2]{1,0} dot(%a, %b), "
+                "lhs_contracting_dims={1}, rhs_contracting_dims={0}")
+        assert H.op_flops(bare) == []
+
+    def test_elementwise_and_collectives_ignored(self):
+        text = "\n".join([
+            "  %add = f32[1024]{0} add(f32[1024]{0} %a, f32[1024]{0} %b)",
+            "  %ar = f32[1024]{0} all-reduce(%g), "
+            "replica_groups=[1,8]<=[8], to_apply=%sum",
+        ])
+        assert H.module_flops(text) == 0
+
+
+class TestBufferAccounting:
+    def test_result_bytes_tuple_and_async_variants(self):
+        """Tuple results sum their elements — including the
+        tuple-wrapped async-start variants PR 6 hardened the collective
+        parser against; for *memory* accounting the u32[] context
+        scalar is 4 real bytes, not payload noise."""
+        assert H.result_bytes("f32[104]{0}") == 416
+        assert H.result_bytes("(f32[104]{0}, f32[13]{0})") == 416 + 52
+        assert H.result_bytes("((f32[104]{0}, f32[13]{0}), u32[])") \
+            == 416 + 52 + 4
+        # the WIRE parser still strips the context scalar (PR 6)
+        line = ("  %rs = ((f32[104]{0}, f32[13]{0}), u32[]) "
+                "reduce-scatter-start(%x), replica_groups=[1,4]<=[8], "
+                "dimensions={0}, to_apply=%add")
+        [op] = H.collective_ops(line)
+        assert op.bytes == 52
+
+    def test_memory_high_water_linear_scan(self):
+        """a (128B) and b (64B) are live until the fusion line; the
+        fusion result (32B) allocates on the same line — peak = all
+        three."""
+        assert H.memory_high_water(FUSION_MODULE) == 128 + 64 + 32
+
+    def test_memory_high_water_frees_after_last_use(self):
+        text = """\
+ENTRY %main (p: f32[256]) -> f32[64] {
+  %p = f32[256]{0} parameter(0)
+  %t1 = f32[256]{0} negate(f32[256]{0} %p)
+  %t2 = f32[64]{0} slice(f32[256]{0} %t1), slice={[0:64]}
+  ROOT %out = f32[64]{0} negate(f32[64]{0} %t2)
+}
+"""
+        # p dies at %t1 (line idx 2): peak is p+t1 = 2048 at that line,
+        # then t1 (1024) + t2 (256) = 1280, then t2+out = 512
+        assert H.memory_high_water(text) == 1024 + 1024
+
+    def test_fusion_bodies_do_not_double_book(self):
+        """ENTRY-scope only: the fused computation's internal buffers
+        never materialize, so the estimate excludes them."""
+        live_names = {n for n, _, _, _ in
+                      H.buffer_liveness(FUSION_MODULE)}
+        assert live_names == {"%a", "%b", "%fusion"}
+
+    def test_no_entry_marker_falls_back_to_whole_text(self):
+        text = "  %p = f32[256]{0} parameter(0)"
+        assert H.memory_high_water(text) == 1024
+
+
+class TestWireAttribution:
+    RS_ICI = ("  %rs = f32[13]{0} reduce-scatter(%x), "
+              "replica_groups=[2,4]<=[8], dimensions={0}, to_apply=%add")
+    RS_DCN = ("  %rs2 = s8[13]{0} reduce-scatter(%y), "
+              "replica_groups=[4,2]<=[8]T(1,0), dimensions={0}, "
+              "to_apply=%add")
+
+    def test_levels_split_by_group_size(self):
+        ops = H.collective_ops(self.RS_ICI + "\n" + self.RS_DCN)
+        levels = CM.collective_wire_by_level(ops, n_dcn=2, n_ici=4)
+        # ici RS: group 4, result 52B -> (4-1)*52; dcn RS: group 2,
+        # result 13B (s8) -> (2-1)*13
+        assert levels["ici"] == pytest.approx(3 * 52)
+        assert levels["dcn"] == pytest.approx(1 * 13)
+
+    def test_single_slice_mesh_attributes_everything_to_ici(self):
+        ops = H.collective_ops(self.RS_DCN)
+        levels = CM.collective_wire_by_level(ops, n_dcn=1, n_ici=8)
+        assert levels["dcn"] == 0.0
+        assert levels["ici"] > 0.0
+
+    def test_module_cost_composes(self):
+        text = FUSION_MODULE + "\n" + self.RS_ICI
+        cost = CM.module_cost(text, n_dcn=2, n_ici=4)
+        assert cost.flops == 2 * 4 * 2 * 8
+        assert cost.wire_bytes["ici"] == pytest.approx(3 * 52)
+        assert cost.memory_high_water_bytes >= 128 + 64 + 32
+        assert cost.predicted_step_time_s() > 0
+
+
+class TestExchangeWireBytes:
+    B = 3.484e9     # flagship gradient payload
+
+    def test_flat_single_fabric_matches_ring_bound(self):
+        wb = CM.exchange_wire_bytes(self.B, n_dcn=1, n_ici=64)
+        assert wb.ici == pytest.approx(2 * 63 / 64 * self.B)
+        assert wb.dcn == 0.0
+
+    def test_two_level_int8_dcn_shrinks_the_cross_hop(self):
+        """The satellite's correction: a 16×4 v5e-64 two-level int8
+        exchange crosses DCN with B/n_ici at 1/4 width — 16× less than
+        the flat fp32 model claimed."""
+        flat = CM.exchange_wire_bytes(self.B, n_dcn=16, n_ici=4,
+                                      hierarchy="flat")
+        two = CM.exchange_wire_bytes(self.B, n_dcn=16, n_ici=4,
+                                     hierarchy="two_level")
+        assert two.ici == flat.ici          # intra phase identical
+        assert two.dcn == pytest.approx(flat.dcn / 16)
+        assert two.total < flat.total
+
+    def test_degenerate_extents_cost_nothing(self):
+        assert CM.exchange_wire_bytes(self.B, 1, 1).total == 0.0
+
+    def test_bad_hierarchy_rejected(self):
+        with pytest.raises(ValueError, match="hierarchy"):
+            CM.exchange_wire_bytes(self.B, 2, 4, hierarchy="auto")
+
+
+class TestCalibratedRoofline:
+    def _trajectory(self):
+        paths = sorted(glob.glob(str(REPO / "BENCH_r0*.json")))
+        assert len(paths) >= 5, "checked-in trajectory missing"
+        return paths
+
+    def test_rooflines_bind_on_the_right_ceiling(self):
+        """ResNet-50 is HBM-bound on v5e (~4,100 img/s ceiling, the
+        PERF_NOTES envelope), the 870.9M transformer compute-bound
+        (~36,300 tok/s) — a FLOPs-only model would be 4x off for
+        resnet."""
+        r = CM.roofline_rate(CM.resnet_workload())
+        assert 3800 < r < 4400
+        t = CM.roofline_rate(CM.transformer_workload(params=870.9e6))
+        assert 33000 < t < 40000
+
+    def test_acceptance_predicts_bench_r05_within_25pct(self):
+        """The ISSUE-7 acceptance bar, held-out: calibrate on r01–r04,
+        predict r05's measured rate AND step time for both models
+        within 25%."""
+        paths = self._trajectory()
+        cal = CM.calibrate(paths[:4])
+        with open(paths[4]) as f:
+            r05 = json.load(f)["parsed"]
+        workloads = CM.workloads_from_artifact(r05)
+        assert {w.family for w in workloads} == {"resnet",
+                                                 "transformer"}
+        for w in workloads:
+            measured_rate = float(r05[w.rate_field])
+            predicted_rate = CM.predict_rate(cal, w)
+            assert predicted_rate is not None
+            assert abs(predicted_rate - measured_rate) / measured_rate \
+                < 0.25, (w.family, predicted_rate, measured_rate)
+            measured_t = w.units_per_step / measured_rate
+            predicted_t = CM.predict_step_time_s(cal, w)
+            assert abs(predicted_t - measured_t) / measured_t < 0.25, \
+                (w.family, predicted_t, measured_t)
+
+    def test_calibration_is_deterministic(self):
+        paths = self._trajectory()
+        a, b = CM.calibrate(paths), CM.calibrate(paths)
+        assert a.efficiency == b.efficiency
+        assert a.samples == b.samples
+
+    def test_latest_artifact_wins(self):
+        arts = [{"metric": "resnet50_img_sec_per_chip", "value": 2000.0},
+                {"metric": "resnet50_img_sec_per_chip", "value": 3000.0}]
+        cal = CM.calibrate(arts)
+        w = CM.resnet_workload()
+        assert CM.predict_rate(cal, w) == pytest.approx(3000.0)
+        assert len(cal.samples["resnet"]) == 2
+
+    def test_unseen_family_predicts_none_never_guesses(self):
+        cal = CM.calibrate([])
+        assert CM.predict_rate(cal, CM.resnet_workload()) is None
+        assert CM.predict_step_time_s(cal, CM.resnet_workload()) is None
+
+    def test_multichip_stubs_contribute_nothing(self):
+        paths = sorted(glob.glob(str(REPO / "MULTICHIP_r0*.json")))
+        cal = CM.calibrate(paths)
+        assert cal.efficiency == {}
+
+
+class TestFusionPredictor:
+    def test_ranks_fewer_flushes_above_per_tensor(self):
+        predict = CM.make_fusion_predictor(
+            payload_bytes=64 << 20, n_leaves=200, world=8)
+        per_tensor = predict((0, 1.0))
+        fused = predict((64 << 20, 5.0))
+        assert fused > per_tensor
+
+    def test_cycle_time_is_a_latency_penalty(self):
+        predict = CM.make_fusion_predictor(
+            payload_bytes=64 << 20, n_leaves=200, world=8)
+        assert predict((64 << 20, 1.0)) > predict((64 << 20, 20.0))

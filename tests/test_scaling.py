@@ -117,6 +117,68 @@ class TestEfficiencyModel:
         assert S.resolve_overlap_fraction(None, {"other": 1}) == 0.0
         assert S.overlap_fraction_from_artifact({"other": 1}) is None
 
+    def test_two_level_int8_dcn_beats_flat_on_multislice(self):
+        """The hierarchy-aware satellite: on a 16×4 v5e-64 mesh the
+        two-level int8-DCN exchange crosses DCN with 16× fewer bytes
+        than the flat fp32 model claimed, and the modeled efficiency
+        reflects it."""
+        flat = S.scaling_efficiency(self.STEP, self.PAYLOAD, 64,
+                                    n_ici=4, hierarchy="flat")
+        two = S.scaling_efficiency(self.STEP, self.PAYLOAD, 64,
+                                   n_ici=4, hierarchy="two_level")
+        assert two.wire_bytes_ici == flat.wire_bytes_ici
+        assert two.wire_bytes_dcn == pytest.approx(
+            flat.wire_bytes_dcn / 16)
+        assert two.efficiency > flat.efficiency
+        assert (two.hierarchy, flat.hierarchy) == ("two_level", "flat")
+
+    def test_wire_bytes_route_through_cost_model(self):
+        from horovod_tpu.analysis import cost_model as CM
+
+        wb = S.exchange_wire_bytes(1e9, 64, hierarchy="two_level",
+                                   n_ici=4)
+        ref = CM.exchange_wire_bytes(1e9, n_dcn=16, n_ici=4,
+                                     hierarchy="two_level")
+        assert (wb.ici, wb.dcn) == (ref.ici, ref.dcn)
+        # the legacy flat helper is the cost model's single-fabric case
+        assert S.allreduce_wire_bytes(1e9, 64) == pytest.approx(
+            CM.exchange_wire_bytes(1e9, n_dcn=1, n_ici=64).ici)
+
+    def test_two_level_requires_a_mesh_split(self):
+        with pytest.raises(ValueError, match="n_ici"):
+            S.exchange_wire_bytes(1e9, 64, hierarchy="two_level")
+        with pytest.raises(ValueError, match="divisible"):
+            S.exchange_wire_bytes(1e9, 10, hierarchy="two_level",
+                                  n_ici=4)
+
+    def test_hierarchy_resolution_precedence(self):
+        """Same discipline as the overlap fraction: explicit > the
+        artifact's measured exchange_hierarchy > flat worst case."""
+        art = {"exchange_hierarchy": "two_level",
+               "resnet_exchange_hierarchy": "flat"}
+        assert S.resolve_exchange_hierarchy("flat", art) == "flat"
+        assert S.resolve_exchange_hierarchy(None, art) == "two_level"
+        assert S.resolve_exchange_hierarchy(
+            None, art, prefix="resnet_") == "flat"
+        assert S.resolve_exchange_hierarchy(None, None) == "flat"
+        assert S.resolve_exchange_hierarchy(None, {"x": 1}) == "flat"
+        with pytest.raises(ValueError, match="hierarchy"):
+            S.resolve_exchange_hierarchy("auto")
+        # artifact-driven two-level through the efficiency model
+        p = S.scaling_efficiency(self.STEP, self.PAYLOAD, 64,
+                                 artifact=art, n_ici=4)
+        assert p.hierarchy == "two_level"
+        explicit = S.scaling_efficiency(self.STEP, self.PAYLOAD, 64,
+                                        hierarchy="two_level", n_ici=4)
+        assert p.wire_bytes_dcn == explicit.wire_bytes_dcn
+
+    def test_curve_carries_hierarchy(self):
+        curve = S.efficiency_curve(self.STEP, self.PAYLOAD,
+                                   chip_counts=(8, 64), n_ici=4,
+                                   hierarchy="two_level")
+        assert all(p.hierarchy == "two_level" for p in curve)
+        assert curve[0].wire_bytes_dcn < curve[1].wire_bytes_dcn
+
     def test_efficiency_monotone_in_overlap_and_chips(self):
         curve = S.efficiency_curve(self.STEP, self.PAYLOAD,
                                    chip_counts=(2, 8, 64))
