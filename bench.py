@@ -795,9 +795,13 @@ def run_chaos(args, hvd):
 
     import numpy as np
 
-    from horovod_tpu import faults
+    from horovod_tpu import faults, telemetry
     from horovod_tpu.elastic.health import HealthMonitor
 
+    # the probe consumes the structured telemetry the health plane and
+    # the elastic state publish (hvd_elastic_* gauges) instead of
+    # re-deriving detect/recovery/steps_lost from timing locals
+    telemetry.enable()
     seed = args.chaos_seed
     k = args.chaos_crash_step
     every = args.chaos_checkpoint_every
@@ -819,7 +823,9 @@ def run_chaos(args, hvd):
     while not declared:              # silence from t = 3 on
         now[0] += 1.0
         mon.check()
-    detect_s = declared[0][2]
+    # the monitor published its verdict to the registry before the
+    # callback ran — read the detection latency from there
+    detect_s = telemetry.value("hvd_elastic_detect_seconds")
     log(f"bench[chaos]: hang declared dead after detect_s={detect_s:.1f} "
         f"(reason: {declared[0][3]}; worker process never exited)")
 
@@ -850,18 +856,19 @@ def run_chaos(args, hvd):
         finally:
             faults.clear_plan()
         state.wait()
-        completed = state._commit_count
-        t0 = time.perf_counter()
         cold = hvd.elastic.TpuState(
             params={"w": np.zeros((4,), np.float32)},
             checkpointer=ckpt, checkpoint_every=every)
         restored = cold.restore_from_checkpoint()
-        recovery_s = time.perf_counter() - t0
         if not restored:
             raise RuntimeError("chaos probe: no durable checkpoint to "
                                "recover from")
-        resumed_step = cold._commit_count
-        steps_lost = completed - resumed_step
+        # the restore published its own record: latency, restored step,
+        # and steps_lost diffed against the committed-step gauge the
+        # crashed loop left behind (elastic/state.py)
+        recovery_s = telemetry.value("hvd_elastic_restore_seconds")
+        resumed_step = int(telemetry.value("hvd_elastic_restored_step"))
+        steps_lost = int(telemetry.value("hvd_elastic_steps_lost"))
         while cold._commit_count < steps:
             cold.params = lr_step(cold.params, data[cold._commit_count])
             cold.commit()
@@ -973,6 +980,18 @@ def run_autotune(args, hvd):
                      else "tokens/sec/chip"),
             "vs_baseline": None, "best_point": best,
             "autotune_log": log_path}
+
+
+def telemetry_fields():
+    """The hvdtel fold (docs/metrics.md): final counters of the run's
+    registry under the ``metrics`` key — schema-checked by hvdci, and
+    deterministic for a seeded workload (gauges/durations stay in the
+    JSONL snapshot log, not here)."""
+    from horovod_tpu import telemetry
+
+    if not telemetry.enabled():
+        return {}
+    return {"metrics": telemetry.bench_metrics()}
 
 
 def artifact_metadata(hvd):
@@ -1152,14 +1171,22 @@ def main():
         jax.config.update("jax_platforms", args.platform)
 
     import horovod_tpu as hvd
+    from horovod_tpu import telemetry
 
     hvd.init()
+    # the bench IS the observability harness: collect unconditionally
+    # (exporters still follow the HOROVOD_METRICS_* knobs) and stamp
+    # the run context so logs/trace/metrics correlate
+    telemetry.enable()
+    telemetry.run_context().update()
     if args.chaos:
-        emit(dict(run_chaos(args, hvd), **artifact_metadata(hvd)),
+        emit(dict(run_chaos(args, hvd), **artifact_metadata(hvd),
+                  **telemetry_fields()),
              args.json_out)
         return
     if args.autotune:
-        emit(dict(run_autotune(args, hvd), **artifact_metadata(hvd)),
+        emit(dict(run_autotune(args, hvd), **artifact_metadata(hvd),
+                  **telemetry_fields()),
              args.json_out)
         return
     out = {}
@@ -1180,6 +1207,7 @@ def main():
                 "aot_disk_hits": stats.get("aot_disk_hits", 0),
                 "aot_disk_misses": stats.get("aot_disk_misses", 0)})
     out.update(artifact_metadata(hvd))
+    out.update(telemetry_fields())
     emit(out, args.json_out)
 
 

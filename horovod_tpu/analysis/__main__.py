@@ -10,6 +10,7 @@ Usage::
     python -m horovod_tpu.analysis --write-baseline ...  # accept findings
     python -m horovod_tpu.analysis perf-gate --candidate new.json
     python -m horovod_tpu.analysis ci                    # lint+artifacts+gate
+    python -m horovod_tpu.analysis metrics-check run.metrics.jsonl
 
 Exit codes: 0 clean, 1 findings, 2 usage/environment error.
 """
@@ -68,6 +69,43 @@ def _build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _metrics_check(argv: List[str]) -> int:
+    """Validate hvdtel metric artifacts (docs/metrics.md): a
+    ``HOROVOD_METRICS_LOG`` JSONL snapshot log, or a BENCH artifact's
+    embedded ``metrics`` block (``.json`` files)."""
+    from horovod_tpu.analysis import metrics_schema
+
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis metrics-check",
+        description="validate metrics snapshot logs / BENCH metrics "
+                    "blocks against the hvdtel schema")
+    p.add_argument("paths", nargs="+",
+                   help=".jsonl snapshot logs or BENCH .json artifacts")
+    args = p.parse_args(argv)
+    errors: List[str] = []
+    for path in args.paths:
+        try:
+            if path.endswith(".jsonl"):
+                errors.extend(f"{path}: {e}"
+                              for e in metrics_schema.validate_jsonl_path(
+                                  path))
+            else:
+                with open(path) as f:
+                    blob = json.load(f)
+                errors.extend(
+                    f"{path}: {e}" for e in
+                    metrics_schema.validate_artifact_metrics(blob))
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"metrics-check: cannot read {path}: {e}",
+                  file=sys.stderr)
+            return 2
+    for e in errors:
+        print(f"metrics-check: {e}")
+    print(f"metrics-check: {len(args.paths)} artifact(s), "
+          f"{len(errors)} error(s) — {'FAIL' if errors else 'ok'}")
+    return 1 if errors else 0
+
+
 def _list_rules() -> int:
     for rule in engine.default_rules():
         print(f"{rule.id}  [{rule.severity}]  {rule.name}")
@@ -90,6 +128,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         from horovod_tpu.analysis import ci
 
         return ci.main(argv[1:])
+    if argv and argv[0] == "metrics-check":
+        return _metrics_check(argv[1:])
     args = _build_parser().parse_args(argv)
     if args.list_rules:
         return _list_rules()

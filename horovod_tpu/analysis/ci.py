@@ -25,7 +25,7 @@ import sys
 import time
 from typing import List, Optional
 
-from horovod_tpu.analysis import engine, hlo_lint, perf_gate
+from horovod_tpu.analysis import engine, hlo_lint, metrics_schema, perf_gate
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -64,12 +64,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         lint = engine.Report(findings=[], suppressed=[], baselined=[])
 
     # 2 — artifact rule pack (HLO001-HLO004 over the checked-in runs)
+    # plus the hvdtel metrics-snapshot schema check: any embedded
+    # "metrics" block must parse against the telemetry contract
+    # (analysis/metrics_schema.py; legacy artifacts without one pass)
     artifacts = perf_gate.default_trajectory(root)
     art_findings = []
+    metrics_errors = []
     art_error = None
     for art in artifacts:
         try:
             art_findings.extend(hlo_lint.lint_artifact_path(art))
+            with open(art) as f:
+                blob = json.load(f)
+            metrics_errors.extend(
+                f"{os.path.basename(art)}: {e}"
+                for e in metrics_schema.validate_artifact_metrics(blob))
         except (OSError, json.JSONDecodeError) as e:
             art_error = f"cannot read {art}: {e}"
             break
@@ -86,12 +95,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     elapsed = time.perf_counter() - t0
     gate_findings = gate.findings if gate is not None else []
     rc = 2 if (art_error or gate_error) else (
-        1 if (lint.findings or art_findings or gate_findings) else 0)
+        1 if (lint.findings or art_findings or gate_findings
+              or metrics_errors) else 0)
 
     if args.json_out:
         print(json.dumps({
             "lint": dict(lint.as_json(), scope=scope),
             "artifact_findings": [f.as_json() for f in art_findings],
+            "metrics_schema_errors": metrics_errors,
             "perf_gate": gate.as_json() if gate is not None else None,
             "errors": [e for e in (art_error, gate_error) if e],
             "elapsed_s": round(elapsed, 3),
@@ -103,13 +114,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f.format())
     for f in art_findings:
         print(f.format())
+    for e in metrics_errors:
+        print(f"hvdci: metrics-schema: {e}")
     for f in gate_findings:
         print(f.format())
     for err in (art_error, gate_error):
         if err:
             print(f"hvdci: ERROR {err}", file=sys.stderr)
     print(f"hvdci: lint[{scope}] {len(lint.findings)} · "
-          f"artifacts[{len(artifacts)}] {len(art_findings)} · "
+          f"artifacts[{len(artifacts)}] "
+          f"{len(art_findings) + len(metrics_errors)} · "
           f"perf-gate {len(gate_findings)} finding(s) "
           f"in {elapsed:.2f}s — {'FAIL' if rc else 'ok'}")
     return rc

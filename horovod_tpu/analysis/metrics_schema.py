@@ -1,0 +1,160 @@
+"""Schema validation for hvdtel metric snapshots (docs/metrics.md).
+
+Two artifact shapes share one contract:
+
+* a **JSONL snapshot log** (``HOROVOD_METRICS_LOG``): one
+  ``schema_version``-stamped object per line, written by
+  ``telemetry.MetricsSnapshotWriter``;
+* the **BENCH-embedded block**: ``bench.py`` folds the final counters
+  into BENCH JSON under the ``"metrics"`` key.
+
+``hvdci`` (``analysis/ci.py``) validates the embedded block of every
+checked-in BENCH artifact, and ``python -m horovod_tpu.analysis
+metrics-check PATH`` validates either shape from the command line — so
+a telemetry schema change that would break a scraper or the perf-gate
+diff fails tier-1, not a dashboard at 3 a.m.
+
+Validators return a list of error strings (empty = valid) rather than
+raising: callers decide severity.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+SCHEMA_VERSION = 1
+SNAPSHOT_KIND = "hvdtel_snapshot"
+
+_NUM = (int, float)
+
+
+def _check_series_map(errors: List[str], obj, field: str) -> None:
+    if not isinstance(obj, dict):
+        errors.append(f"{field}: expected object, got "
+                      f"{type(obj).__name__}")
+        return
+    for k, v in obj.items():
+        if not isinstance(k, str):
+            errors.append(f"{field}: non-string series key {k!r}")
+        if not isinstance(v, _NUM) or isinstance(v, bool):
+            errors.append(f"{field}[{k!r}]: non-numeric value {v!r}")
+
+
+def _check_histograms(errors: List[str], obj) -> None:
+    if not isinstance(obj, dict):
+        errors.append(f"histograms: expected object, got "
+                      f"{type(obj).__name__}")
+        return
+    for key, h in obj.items():
+        if not isinstance(h, dict):
+            errors.append(f"histograms[{key!r}]: expected object")
+            continue
+        bounds = h.get("bounds")
+        counts = h.get("counts")
+        if not isinstance(bounds, list) or not isinstance(counts, list):
+            errors.append(f"histograms[{key!r}]: bounds/counts must be "
+                          f"arrays")
+            continue
+        if len(counts) != len(bounds) + 1:
+            errors.append(
+                f"histograms[{key!r}]: {len(counts)} counts for "
+                f"{len(bounds)} bounds (need bounds+1 — the overflow "
+                f"bucket)")
+        if list(bounds) != sorted(float(b) for b in bounds):
+            errors.append(f"histograms[{key!r}]: bounds not sorted")
+        if any((not isinstance(c, int)) or c < 0 for c in counts):
+            errors.append(f"histograms[{key!r}]: counts must be "
+                          f"non-negative integers")
+        count = h.get("count")
+        if isinstance(count, int) and sum(c for c in counts
+                                          if isinstance(c, int)) != count:
+            errors.append(
+                f"histograms[{key!r}]: count {count} != sum of bucket "
+                f"counts — a merge or a torn write")
+
+
+def validate_snapshot(obj: Dict) -> List[str]:
+    """One JSONL snapshot record (the ``MetricsSnapshotWriter`` line)."""
+    errors: List[str] = []
+    if not isinstance(obj, dict):
+        return [f"snapshot: expected object, got {type(obj).__name__}"]
+    sv = obj.get("schema_version")
+    if sv != SCHEMA_VERSION:
+        errors.append(f"schema_version: expected {SCHEMA_VERSION}, "
+                      f"got {sv!r}")
+    if obj.get("kind") != SNAPSHOT_KIND:
+        errors.append(f"kind: expected {SNAPSHOT_KIND!r}, "
+                      f"got {obj.get('kind')!r}")
+    for field in ("run_id",):
+        if not isinstance(obj.get(field), str):
+            errors.append(f"{field}: expected string")
+    for field in ("generation", "step"):
+        if not isinstance(obj.get(field), int):
+            errors.append(f"{field}: expected integer")
+    _check_series_map(errors, obj.get("counters", {}), "counters")
+    _check_series_map(errors, obj.get("gauges", {}), "gauges")
+    _check_histograms(errors, obj.get("histograms", {}))
+    return errors
+
+
+def validate_bench_metrics(obj: Dict) -> List[str]:
+    """The ``"metrics"`` block bench.py embeds in BENCH JSON: schema
+    stamp + final counters (the deterministic slice)."""
+    errors: List[str] = []
+    if not isinstance(obj, dict):
+        return [f"metrics: expected object, got {type(obj).__name__}"]
+    if obj.get("schema_version") != SCHEMA_VERSION:
+        errors.append(f"metrics.schema_version: expected "
+                      f"{SCHEMA_VERSION}, got {obj.get('schema_version')!r}")
+    _check_series_map(errors, obj.get("counters", {}), "metrics.counters")
+    return errors
+
+
+def validate_jsonl_path(path: str) -> List[str]:
+    """Every line of a snapshot log; line numbers prefixed."""
+    errors: List[str] = []
+    n = 0
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            n += 1
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"line {i}: not JSON ({e})")
+                continue
+            errors.extend(f"line {i}: {e}"
+                          for e in validate_snapshot(obj))
+    if not n:
+        errors.append("empty snapshot log")
+    return errors
+
+
+def validate_artifact_metrics(artifact: Dict) -> List[str]:
+    """The hvdci hook: validate a BENCH artifact's embedded metrics
+    block when present (legacy artifacts without one pass trivially).
+    Handles the MULTICHIP ``parsed`` wrapper the way hlo_lint does."""
+    if "parsed" in artifact and isinstance(artifact["parsed"], dict):
+        artifact = artifact["parsed"]
+    block = artifact.get("metrics")
+    if block is None:
+        return []
+    return validate_bench_metrics(block)
+
+
+def counters_delta(a: Optional[Dict], b: Optional[Dict]
+                   ) -> Dict[str, float]:
+    """Per-series counter difference between two metrics blocks (b − a)
+    — the diff seam ``perf_gate``/operators use to compare runs (e.g.
+    retry or writer-error counts that should stay flat)."""
+    ca = (a or {}).get("counters", {}) or {}
+    cb = (b or {}).get("counters", {}) or {}
+    out: Dict[str, float] = {}
+    for k in sorted(set(ca) | set(cb)):
+        d = float(cb.get(k, 0.0)) - float(ca.get(k, 0.0))
+        if d:
+            out[k] = d
+    return out

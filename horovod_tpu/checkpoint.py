@@ -53,9 +53,23 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
-from horovod_tpu import faults
+from horovod_tpu import faults, telemetry
 from horovod_tpu import functions as F
 from horovod_tpu.utils import logging as hvd_logging
+
+# save-plane telemetry (docs/metrics.md): dispatch counts, the blocking
+# D2H-cut stall, the background write duration, and sticky writer errors
+_TEL_SAVES = telemetry.counter(
+    "hvd_checkpoint_saves_total", "checkpoint saves dispatched")
+_TEL_STALL = telemetry.histogram(
+    "hvd_checkpoint_stall_seconds",
+    "train-loop blocking time of a save (the D2H consistent cut)")
+_TEL_WRITE = telemetry.histogram(
+    "hvd_checkpoint_write_seconds",
+    "end-to-end background write duration (pickle+fsync+rename)")
+_TEL_ERRORS = telemetry.counter(
+    "hvd_checkpoint_writer_errors_total",
+    "checkpoint writer-thread failures (sticky until clear_error)")
 
 
 def _is_root() -> bool:
@@ -225,10 +239,12 @@ class Checkpointer:
                 faults.inject("checkpoint.write")   # chaos hook
                 fn()
             except BaseException as e:  # noqa: BLE001 — surfaced at wait()
+                _TEL_ERRORS.inc()
                 with self._error_lock:
                     self._writer_error = e
             finally:
                 self.last_write_s = time.perf_counter() - t0
+                _TEL_WRITE.observe(self.last_write_s)
 
         if not self._async:
             run()
@@ -259,6 +275,8 @@ class Checkpointer:
         t0 = time.perf_counter()
         host_state = _host_copy(state)    # the consistent cut
         self.last_stall_s = time.perf_counter() - t0
+        _TEL_SAVES.inc()
+        _TEL_STALL.observe(self.last_stall_s)
 
         if self._manager is not None:
             def write():
@@ -301,6 +319,8 @@ class Checkpointer:
         t0 = time.perf_counter()
         host_state = _host_copy(shard_state)
         self.last_stall_s = time.perf_counter() - t0
+        _TEL_SAVES.inc()
+        _TEL_STALL.observe(self.last_stall_s)
 
         def write():
             path = os.path.join(self._dir, f"step_{step}")

@@ -47,7 +47,7 @@ import weakref
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterable, Optional
 
-from horovod_tpu import faults
+from horovod_tpu import faults, telemetry
 from horovod_tpu.runtime.config import _env_int
 
 _LIVE: "weakref.WeakSet[PrefetchIterator]" = weakref.WeakSet()
@@ -135,6 +135,20 @@ class PrefetchIterator:
         self.stall_s = 0.0
         self.stall_samples: list = []
         self.batches = 0
+        # telemetry (docs/metrics.md): queue depth is the gauge the
+        # serving plane's autoscaling story scrapes; stall time is the
+        # input plane's contract number
+        self._tel_batches = telemetry.counter(
+            "hvd_input_batches_total",
+            "batches delivered by the input pipeline").labels(
+                pipeline=name)
+        self._tel_stall = telemetry.histogram(
+            "hvd_input_stall_seconds",
+            "time next() blocked waiting for a batch").labels(
+                pipeline=name)
+        self._tel_depth = telemetry.gauge(
+            "hvd_input_queue_depth",
+            "prefetch queue occupancy at delivery").labels(pipeline=name)
         self._pool = ThreadPoolExecutor(
             max_workers=self._threads,
             thread_name_prefix=f"{_THREAD_PREFIX}-{name}")
@@ -205,6 +219,9 @@ class PrefetchIterator:
         self.stall_s += dt
         self.stall_samples.append(dt)
         self.batches += 1
+        self._tel_batches.inc()
+        self._tel_stall.observe(dt)
+        self._tel_depth.set(self._queue.qsize())
         return batch
 
     def close(self) -> None:

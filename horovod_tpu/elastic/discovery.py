@@ -27,8 +27,16 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-from horovod_tpu import faults
+from horovod_tpu import faults, telemetry
 from horovod_tpu.utils import logging as hvd_logging
+
+# quarantine/readmit transitions as events (docs/metrics.md) — today's
+# log lines, scrapeable: a flapping host shows as a climbing
+# `quarantined` count with matching `probation` readmissions
+_TEL_QUARANTINE = telemetry.counter(
+    "hvd_quarantine_events_total",
+    "host quarantine state transitions (event=quarantined|probation|"
+    "cleared)")
 
 
 class HostUpdateResult:
@@ -185,6 +193,7 @@ class HostQuarantine:
                            self.max_s)
         rec["state"] = _QUARANTINED
         rec["until"] = now + cooldown
+        _TEL_QUARANTINE.inc(event="quarantined")
         return cooldown
 
     def is_excluded(self, host: str) -> bool:
@@ -199,6 +208,7 @@ class HostQuarantine:
                 return True
             rec["state"] = _PROBATION
             rec["until"] = now + self.probation_s
+            _TEL_QUARANTINE.inc(event="probation")
             hvd_logging.info(
                 "elastic: quarantine cooldown for host %s expired — "
                 "readmitting on probation (%.0fs, %d prior failure(s))",
@@ -207,6 +217,7 @@ class HostQuarantine:
         # probation: available; survival past the window clears the record
         if now >= rec["until"]:
             del self._hosts[host]
+            _TEL_QUARANTINE.inc(event="cleared")
             hvd_logging.info(
                 "elastic: host %s survived probation — record cleared",
                 host)

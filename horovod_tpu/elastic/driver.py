@@ -17,7 +17,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
-from horovod_tpu import faults
+from horovod_tpu import faults, telemetry
 from horovod_tpu.elastic.discovery import HostManager, HostUpdateResult
 from horovod_tpu.elastic.health import HealthMonitor
 from horovod_tpu.elastic.registration import WorkerStateRegistry
@@ -94,6 +94,15 @@ class ElasticDriver:
         self._health = HealthMonitor.from_env(self._on_worker_dead)
         self.last_detect_s: Optional[float] = None
         self.last_detect_reason: Optional[str] = None
+        # structured per-generation recovery record (docs/metrics.md):
+        # what the recovery_s/detect_s log lines said, as data — appended
+        # when a generation reaches fully-READY, mirrored into the
+        # registry as generation-labeled gauges
+        self._generation_history: List[dict] = []
+        self._step_at_detect: Optional[int] = None
+        # per-worker counter snapshots off the heartbeat piggyback; the
+        # driver's Prometheus endpoint serves them worker-labeled
+        self._worker_metrics = telemetry.worker_store()
         self._worker_fn_takes_abort = True
         self._coordinator_addr = ""
         # Driver-hosted per-generation coordination services.  Old
@@ -141,6 +150,18 @@ class ElasticDriver:
     def health_monitor(self) -> HealthMonitor:
         return self._health
 
+    @property
+    def generation_history(self) -> List[dict]:
+        """Per-generation recovery records (newest last): generation,
+        worker count, ``recovery_s`` (assignment → all-READY),
+        ``detect_s``/``detect_reason`` when a health-plane verdict
+        triggered the generation, ``step_at_detect`` (the pre-failure
+        training peak the monitor saw) and best-effort ``steps_lost``
+        (peak minus the highest step reported by the new generation at
+        ready time; None until a worker reports)."""
+        with self._lock:
+            return [dict(e) for e in self._generation_history]
+
     def _handle(self, req):
         if isinstance(req, RegisterWorkerRequest):
             with self._lock:
@@ -149,6 +170,12 @@ class ElasticDriver:
         if isinstance(req, HeartbeatRequest):
             self._health.record_heartbeat(req.host, req.local_rank,
                                           getattr(req, "step", -1))
+            metrics = getattr(req, "metrics", None)
+            if metrics:
+                # rank-registry aggregation rides the beat the way the
+                # step counter does — no extra RPC (docs/metrics.md)
+                self._worker_metrics.update(
+                    f"{req.host}:{req.local_rank}", metrics)
             return AckResponse()
         if isinstance(req, WorkerReadyRequest):
             self._registry.record_ready(req.host, req.local_rank)
@@ -205,6 +232,9 @@ class ElasticDriver:
         if not all(self._registry.get_state(h, lr) in (READY, SUCCESS)
                    for (h, lr) in keys):
             return
+        # read the post-recovery training peak BEFORE taking our lock
+        # (the monitor has its own lock; keep the acquisition one-way)
+        step_now = self._health.max_step()
         with self._lock:
             if gen != self._generation \
                     or self._generation_ready_logged >= gen:
@@ -215,7 +245,41 @@ class ElasticDriver:
             recovery_s = time.monotonic() - started
             self.last_recovery_s = recovery_s
             detect_s = self.last_detect_s
+            detect_reason = self.last_detect_reason
+            step_at_detect = self._step_at_detect
             self.last_detect_s = None        # consumed by this generation
+            self._step_at_detect = None
+            entry = {
+                "generation": gen,
+                "workers": len(keys),
+                "recovery_s": round(recovery_s, 4),
+                "detect_s": None if detect_s is None
+                else round(detect_s, 4),
+                "detect_reason": detect_reason if detect_s is not None
+                else None,
+                "step_at_detect": step_at_detect,
+                "steps_lost": (max(step_at_detect - step_now, 0)
+                               if step_at_detect is not None
+                               and step_now >= 0 else None),
+            }
+            self._generation_history.append(entry)
+        # registry mirror of the history entry (generation-labeled so a
+        # scraper keeps every generation, not just the last)
+        g = str(gen)
+        telemetry.counter("hvd_elastic_generations_ready_total",
+                          "generations that reached fully-READY").inc()
+        telemetry.gauge("hvd_elastic_recovery_seconds",
+                        "assignment → all-READY latency").set(
+                            recovery_s, generation=g)
+        if detect_s is not None:
+            telemetry.gauge("hvd_elastic_generation_detect_seconds",
+                            "failure-detection latency that triggered "
+                            "the generation").set(detect_s, generation=g)
+        if entry["steps_lost"] is not None:
+            telemetry.gauge("hvd_elastic_generation_steps_lost",
+                            "training steps lost across the generation "
+                            "change (best effort)").set(
+                                entry["steps_lost"], generation=g)
         detect = "" if detect_s is None else f" detect_s={detect_s:.1f}"
         hvd_logging.info(
             "elastic: generation %d fully ready — %d worker(s) in "
@@ -229,11 +293,17 @@ class ElasticDriver:
         abort event kills the tree)."""
         if self._shutdown.is_set():
             return    # completed/stopped job: silence is expected
+        # the pre-failure training peak, for the generation_history
+        # steps_lost estimate (monitor lock first, ours second — the
+        # same one-way order _check_generation_ready uses)
+        step_at_detect = self._health.max_step()
         # the monitor thread calls this; _check_generation_ready reads
         # and consumes last_detect_s under the lock
         with self._lock:
             self.last_detect_s = detect_s
             self.last_detect_reason = reason
+            self._step_at_detect = step_at_detect \
+                if step_at_detect >= 0 else None
         hvd_logging.warning(
             "elastic: worker %s:%d declared dead (%s) — detect_s=%.2f; "
             "regenerating without waiting for process exit",
@@ -395,10 +465,19 @@ class ElasticDriver:
                                  for s in assignments}
             self._registry.purge_unassigned(set(self._assignments))
             self._health.purge(set(self._assignments))
+            self._worker_metrics.purge(
+                {f"{h}:{lr}" for (h, lr) in self._assignments})
             self._coordinator_addr = self._new_coordinator_addr(assignments)
             self._generation += 1
             self._generation_started = time.monotonic()
             self._regen_requests.clear()
+            telemetry.gauge("hvd_elastic_generation",
+                            "current elastic world generation").set(
+                                self._generation)
+            telemetry.gauge("hvd_elastic_world_size",
+                            "assigned workers in the current "
+                            "generation").set(len(self._assignments))
+            telemetry.run_context().advance(generation=self._generation)
             return self._assignments
 
     def _new_coordinator_addr(self, assignments: List[SlotInfo]) -> str:

@@ -38,13 +38,31 @@ import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
-from horovod_tpu import faults
+from horovod_tpu import faults, telemetry
 from horovod_tpu.utils import logging as hvd_logging
 from horovod_tpu.utils.stall import ProgressWatchdog
 
 DEFAULT_INTERVAL_S = 2.0
 DEFAULT_SUSPECT_MISSES = 3
 DEFAULT_DEAD_MULTIPLE = 10     # dead_s default = interval * this
+
+# health-plane telemetry (docs/metrics.md): what used to exist only as
+# log lines.  Heartbeat age + progress stall are the precursors
+# (scrapeable while a worker degrades); detect_s and the death counter
+# record the verdicts the driver acts on.
+_TEL_BEAT_AGE = telemetry.gauge(
+    "hvd_worker_heartbeat_age_seconds",
+    "max seconds since any monitored worker's last heartbeat")
+_TEL_WORKERS = telemetry.gauge(
+    "hvd_workers_monitored", "workers currently heartbeating")
+_TEL_SUSPECT = telemetry.counter(
+    "hvd_elastic_worker_suspect_total", "suspect declarations")
+_TEL_DEATHS = telemetry.counter(
+    "hvd_elastic_worker_deaths_total",
+    "health-plane death/hang declarations")
+_TEL_DETECT = telemetry.gauge(
+    "hvd_elastic_detect_seconds",
+    "silence/stagnation span of the most recent death declaration")
 
 
 def heartbeat_interval_s() -> float:
@@ -55,10 +73,12 @@ def heartbeat_interval_s() -> float:
 class _WorkerHealth:
     __slots__ = ("last_beat", "suspect", "progress")
 
-    def __init__(self, now: float, clock):
+    def __init__(self, now: float, clock, name: str = ""):
         self.last_beat = now
         self.suspect = False
-        self.progress = ProgressWatchdog(clock=clock)
+        # named: the per-worker progress watchdog publishes its
+        # stagnation gauge, the scrapeable hung-worker precursor
+        self.progress = ProgressWatchdog(clock=clock, name=name or None)
 
 
 class HealthMonitor:
@@ -133,7 +153,8 @@ class HealthMonitor:
         with self._lock:
             w = self._workers.get((host, local_rank))
             if w is None:
-                w = _WorkerHealth(now, self._clock)
+                w = _WorkerHealth(now, self._clock,
+                                  name=f"{host}:{local_rank}")
                 self._workers[(host, local_rank)] = w
             else:
                 if w.suspect:
@@ -176,9 +197,12 @@ class HealthMonitor:
         if now is None:
             now = self._clock()
         dead = []
+        max_age = 0.0
         with self._lock:
+            _TEL_WORKERS.set(len(self._workers))
             for key, w in list(self._workers.items()):
                 age = now - w.last_beat
+                max_age = max(max_age, age)
                 if age >= self.dead_s:
                     # detect_s: silence span from the last sign of life
                     # to this declaration
@@ -195,11 +219,18 @@ class HealthMonitor:
                 if not w.suspect and \
                         age >= self.interval_s * self.suspect_misses:
                     w.suspect = True
+                    _TEL_SUSPECT.inc()
                     hvd_logging.warning(
                         "elastic: worker %s:%d is suspect — %.0f missed "
                         "heartbeat(s) (%.1fs silent; declared dead at "
                         "%.1fs)", key[0], key[1],
                         age / self.interval_s, age, self.dead_s)
+        _TEL_BEAT_AGE.set(max_age)
         for (host, local_rank), detect_s, reason in dead:
+            # verdict telemetry BEFORE the callback: bench.py --chaos
+            # and the driver both read detect_s from the registry
+            _TEL_DETECT.set(detect_s)
+            _TEL_DEATHS.inc(reason="hung" if "hung" in reason
+                            else "missed_heartbeats")
             self._on_dead(host, local_rank, detect_s, reason)
         return [k for k, _, _ in dead]

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import copy
 import queue
+import time
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -189,6 +190,16 @@ class TpuState(ObjectState):
         from horovod_tpu.elastic import worker as elastic_worker
 
         elastic_worker.report_step(self._commit_count)
+        from horovod_tpu import telemetry
+
+        telemetry.counter("hvd_elastic_commits_total",
+                          "elastic state commits").inc()
+        # gauge (not counter): overwritten per commit, so a crash leaves
+        # the last durable-loop value for restore's steps_lost diff
+        telemetry.gauge("hvd_elastic_steps_committed",
+                        "highest committed elastic step").set(
+                            self._commit_count)
+        telemetry.run_context().advance(step=self._commit_count)
         if self._checkpointer is not None and \
                 self._commit_count % self._checkpoint_every == 0:
             # the leaves are already host numpy arrays, so the
@@ -209,6 +220,7 @@ class TpuState(ObjectState):
         checkpointer has nothing."""
         if self._checkpointer is None:
             return False
+        t0 = time.perf_counter()
         if step is None:
             # resolve once (collective when multi-process) so the step is
             # known here, not just inside restore(): the commit counter
@@ -229,6 +241,24 @@ class TpuState(ObjectState):
 
         elastic_worker.report_step(self._commit_count)
         self.restore()
+        # recovery telemetry (docs/metrics.md): restore latency, the
+        # restored step, and steps_lost diffed against the last
+        # committed-step gauge — the structured record bench.py --chaos
+        # reads instead of re-deriving these from timing locals
+        from horovod_tpu import telemetry
+
+        if telemetry.enabled():
+            committed = telemetry.value("hvd_elastic_steps_committed")
+            telemetry.gauge("hvd_elastic_restore_seconds",
+                            "durable-checkpoint restore latency").set(
+                                time.perf_counter() - t0)
+            telemetry.gauge("hvd_elastic_restored_step",
+                            "step the state restored to").set(
+                                self._commit_count)
+            telemetry.gauge(
+                "hvd_elastic_steps_lost",
+                "committed-but-not-durable steps lost by the restore"
+            ).set(max(int(committed) - self._commit_count, 0))
         return True
 
     def restore(self) -> None:

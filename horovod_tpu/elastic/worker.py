@@ -70,6 +70,7 @@ class HeartbeatSender:
         self._stop.set()
 
     def _run(self) -> None:
+        from horovod_tpu import telemetry
         from horovod_tpu.runner.network import notify_heartbeat
 
         while not self._stop.wait(self.interval_s):
@@ -78,9 +79,14 @@ class HeartbeatSender:
                 # the process stays alive — exactly the failure mode the
                 # driver-side HealthMonitor exists to catch
                 faults.inject("worker.heartbeat")
+                # metrics piggyback: this rank's counter snapshot rides
+                # the beat the way the step counter does, so the driver
+                # aggregates rank registries with no extra RPC or thread
+                metrics = telemetry.counters_snapshot() \
+                    if telemetry.enabled() else None
                 notify_heartbeat(self._driver_addr, self._key,
                                  self._host, self._local_rank,
-                                 current_step())
+                                 current_step(), metrics=metrics)
             except OSError as e:
                 hvd_logging.debug("elastic: heartbeat send failed: %s", e)
 

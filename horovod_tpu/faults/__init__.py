@@ -30,6 +30,7 @@ Sites currently instrumented (grep ``faults.inject`` for ground truth):
 ``stall.watch``             each stall-inspector poll pass
 ``timeline.write``          timeline writer thread, once per event
 ``probe.connect``           NIC-probe task → driver connect scan
+``telemetry.export``        metrics snapshot writer, once per export pass
 ==========================  =================================================
 
 (Coverage is enforced statically: hvdlint rule HVD006 fails on any

@@ -201,6 +201,15 @@ class FaultPlan:
     def _fire(self, spec: FaultSpec, site: str, hit: int) -> Any:
         hvd_logging.warning("faults: firing %s at %s (hit %d)",
                             spec.action, site, hit)
+        # telemetry is imported lazily: telemetry.export imports this
+        # package for its chaos hook, and _fire only runs under an
+        # active plan — never on the production no-op path
+        from horovod_tpu import telemetry
+
+        telemetry.counter(
+            "hvd_faults_injected_total",
+            "chaos faults fired by the active plan").inc(
+                site=site, action=spec.action)
         if spec.action == "crash":
             code = int(spec.arg) if spec.arg is not None \
                 else _DEFAULT_CRASH_CODE

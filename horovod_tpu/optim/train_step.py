@@ -22,6 +22,7 @@ Design notes for the MXU/HBM (see repo guidance):
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Callable, Optional, Sequence, Union
 
@@ -31,6 +32,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from horovod_tpu import telemetry
 from horovod_tpu.ops import collectives as C
 from horovod_tpu.ops.collectives import Average, ReduceOp, Sum
 from horovod_tpu.runtime import state
@@ -365,6 +367,20 @@ class DistributedTrainStep:
         self._compile_cache = _cc
         self._persistent_root = _cc.resolve_dir()
         self._last_cache_hit: Optional[bool] = None
+        # telemetry handles (docs/metrics.md): cached here so the
+        # per-call cost is one enabled-branch when metrics are off
+        self._tel_steps = telemetry.counter(
+            "hvd_steps_total", "optimizer steps executed")
+        self._tel_step_seconds = telemetry.histogram(
+            "hvd_step_seconds",
+            "host wall time per train-step dispatch call")
+        self._tel_cache_hits = telemetry.counter(
+            "hvd_compile_cache_hits_total",
+            "in-memory executable-cache hits")
+        self._tel_cache_misses = telemetry.counter(
+            "hvd_compile_cache_misses_total",
+            "in-memory executable-cache misses")
+        self._tel_wire_done = False
 
     _COMPILED_CACHE_MAX = 16
 
@@ -519,9 +535,49 @@ class DistributedTrainStep:
         return self._step.lower(params, opt_state, batch).compile(
             compiler_options=self._compiler_options).as_text()
 
+    def _record_step_telemetry(self, params, t0: float) -> None:
+        """Per-call telemetry: step count/duration, the run-context step
+        for log/trace correlation, and (once) the cost-model wire bytes
+        of the configured exchange per fabric level."""
+        self._tel_step_seconds.observe(time.perf_counter() - t0)
+        self._tel_steps.inc(self._steps_per_call)
+        telemetry.run_context().advance_step(self._steps_per_call)
+        if self._tel_wire_done or not self._shard_opt:
+            return
+        self._tel_wire_done = True
+        try:
+            from horovod_tpu.analysis.cost_model import exchange_wire_bytes
+
+            payload = sum(
+                int(np.size(l)) * getattr(getattr(l, "dtype", None),
+                                          "itemsize", 4)
+                for l in jax.tree_util.tree_leaves(params))
+            extents = [self._mesh.shape[a] for a in self._data_axes]
+            n_ici = extents[-1]
+            n_dcn = 1
+            for e in extents[:-1]:
+                n_dcn *= e
+            hierarchy = self._hierarchy \
+                if self._hierarchy in ("flat", "two_level") else "flat"
+            wire = exchange_wire_bytes(float(payload), n_dcn=n_dcn,
+                                       n_ici=n_ici, hierarchy=hierarchy)
+            g = telemetry.gauge(
+                "hvd_exchange_wire_bytes",
+                "modeled per-step gradient-exchange bytes per fabric "
+                "level (analysis/cost_model.py)")
+            g.set(wire.ici, level="ici")
+            g.set(wire.dcn, level="dcn")
+        except Exception:  # noqa: BLE001 — observability must not sink a step
+            pass
+
     def __call__(self, params, opt_state, batch):
+        tel_on = telemetry.enabled()
+        t0 = time.perf_counter() if tel_on else 0.0
         if self._compiler_options is None and self._persistent_root is None:
-            return self._step(params, opt_state, batch)
+            out = self._step(params, opt_state, batch)
+            if tel_on:
+                self._record_step_telemetry(params, t0)
+            return out
         # AOT path, for two reasons that share the machinery: per-compile
         # XLA options need lower-once-compile-with-options, and the
         # warm-start store needs the explicit compile to intercept.  The
@@ -540,6 +596,7 @@ class DistributedTrainStep:
         st = state.global_state() if state.is_initialized() else None
         compiled = self._compiled_cache.pop(key, None)
         if compiled is None:
+            self._tel_cache_misses.inc()
             if st is not None:
                 st.cache_stats["misses"] += 1
             compiled, hit = self._compile_cache.aot_compile(
@@ -550,12 +607,17 @@ class DistributedTrainStep:
                 capacity=self._compiled_cache_max)
             self._last_cache_hit = \
                 hit if self._persistent_root is not None else None
-        elif st is not None:
-            st.cache_stats["hits"] += 1
+        else:
+            self._tel_cache_hits.inc()
+            if st is not None:
+                st.cache_stats["hits"] += 1
         self._compiled_cache[key] = compiled     # reinsert = most recent
         while len(self._compiled_cache) > self._compiled_cache_max:
             self._compiled_cache.pop(next(iter(self._compiled_cache)))
-        return compiled(params, opt_state, batch)
+        out = compiled(params, opt_state, batch)
+        if tel_on:
+            self._record_step_telemetry(params, t0)
+        return out
 
 
 def join_step(grads, has_data, axis: AxisSpec = GLOBAL_AXES):

@@ -114,12 +114,18 @@ class WorkerReadyRequest:
 class HeartbeatRequest:
     """Worker → driver: periodic liveness beat, piggybacking the
     training step counter so the driver's progress watchdog can tell a
-    hung-but-alive rank from a healthy one (``elastic/health.py``)."""
+    hung-but-alive rank from a healthy one (``elastic/health.py``) and,
+    when telemetry is enabled, the rank's counter snapshot so the
+    driver aggregates per-worker metrics with no extra RPC
+    (docs/metrics.md; the driver reads ``metrics`` via ``getattr`` so
+    old workers interoperate)."""
 
-    def __init__(self, host: str, local_rank: int, step: int = -1):
+    def __init__(self, host: str, local_rank: int, step: int = -1,
+                 metrics: Optional[dict] = None):
         self.host = host
         self.local_rank = local_rank
         self.step = step
+        self.metrics = metrics
 
 
 class BasicService:
@@ -250,9 +256,10 @@ def notify_worker_ready(driver_addr: str, key: Optional[str],
 
 
 def notify_heartbeat(driver_addr: str, key: Optional[str],
-                     host: str, local_rank: int, step: int = -1) -> None:
+                     host: str, local_rank: int, step: int = -1,
+                     metrics: Optional[dict] = None) -> None:
     """Worker-side: one liveness beat to the elastic driver (short
     timeout — a slow beat must not back the sender thread up)."""
     dhost, port = driver_addr.rsplit(":", 1)
     BasicClient((dhost, int(port)), key, timeout_s=5.0).request(
-        HeartbeatRequest(host, local_rank, step))
+        HeartbeatRequest(host, local_rank, step, metrics=metrics))

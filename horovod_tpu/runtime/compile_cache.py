@@ -124,10 +124,15 @@ def stats() -> dict:
 
 
 def _bump(hit: bool) -> None:
+    from horovod_tpu import telemetry
     from horovod_tpu.runtime import state as rt_state
 
     with _lock:
         _stats["aot_disk_hits" if hit else "aot_disk_misses"] += 1
+    telemetry.counter(
+        "hvd_aot_disk_hits_total" if hit else "hvd_aot_disk_misses_total",
+        "persistent AOT executable store hits" if hit
+        else "persistent AOT executable store misses").inc()
     if rt_state.is_initialized():
         cs = rt_state.global_state().cache_stats
         cs["aot_disk_hits" if hit else "aot_disk_misses"] = \

@@ -48,6 +48,9 @@ KNOWN_KNOBS = frozenset({
     "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES",
     "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE",
     "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE",
+    # -- telemetry plane (horovod_tpu/telemetry, docs/metrics.md)
+    "HOROVOD_METRICS", "HOROVOD_METRICS_PORT", "HOROVOD_METRICS_LOG",
+    "HOROVOD_METRICS_INTERVAL_S", "HOROVOD_RUN_ID",
     # -- timeline / stall inspector / logging
     "HOROVOD_TIMELINE", "HOROVOD_TIMELINE_MARK_CYCLES",
     "HOROVOD_TIMELINE_PYTHON", "HOROVOD_STALL_CHECK_DISABLE",
@@ -173,6 +176,15 @@ class Config:
     autotune_gaussian_process_noise: float = 0.8
     autotune_steps_per_sample: int = 10
 
+    # -- telemetry plane (horovod_tpu/telemetry, docs/metrics.md):
+    # metrics_enabled None = auto (on iff an exporter is configured);
+    # port 0 = no Prometheus endpoint; log None = no JSONL snapshots
+    metrics_enabled: Optional[bool] = None
+    metrics_port: int = 0
+    metrics_log: Optional[str] = None
+    metrics_interval_s: float = 10.0
+    run_id: Optional[str] = None
+
     # -- timeline (reference operations.cc:417-424)
     timeline_filename: Optional[str] = None
     timeline_mark_cycles: bool = False
@@ -264,6 +276,14 @@ class Config:
                 "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", 0.8),
             autotune_steps_per_sample=_env_int(
                 "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", 10),
+            metrics_enabled=(None if os.environ.get("HOROVOD_METRICS")
+                             in (None, "") else
+                             _env_bool("HOROVOD_METRICS", False)),
+            metrics_port=_env_int("HOROVOD_METRICS_PORT", 0),
+            metrics_log=os.environ.get("HOROVOD_METRICS_LOG"),
+            metrics_interval_s=_env_float("HOROVOD_METRICS_INTERVAL_S",
+                                          10.0),
+            run_id=os.environ.get("HOROVOD_RUN_ID"),
             timeline_filename=os.environ.get("HOROVOD_TIMELINE"),
             timeline_mark_cycles=_env_bool("HOROVOD_TIMELINE_MARK_CYCLES", False),
             stall_check_enabled=not _env_bool("HOROVOD_STALL_CHECK_DISABLE", False),

@@ -38,6 +38,14 @@ from horovod_tpu.utils import logging as hvd_logging
 DEFAULT_RETRY_ON: Tuple[Type[BaseException], ...] = (OSError, TimeoutError)
 
 
+def _tel_counter(name: str, help: str):
+    # lazy import: retry is reached from config/bootstrap paths where
+    # the telemetry package may not be loaded yet
+    from horovod_tpu import telemetry
+
+    return telemetry.counter(name, help)
+
+
 class RetryPolicy:
     def __init__(self,
                  max_attempts: Optional[int] = None,
@@ -82,6 +90,10 @@ class RetryPolicy:
                 return fn(*args, **kwargs)
             except self.retry_on as e:  # noqa: PERF203 — the point
                 last = e
+                _tel_counter(
+                    "hvd_retry_attempts_total",
+                    "failed attempts under a retry policy").inc(
+                        policy=self.name)
                 if attempt + 1 >= self.max_attempts:
                     break
                 delay = self.backoff_s(attempt)
@@ -91,13 +103,25 @@ class RetryPolicy:
                     hvd_logging.warning(
                         "%s: deadline %.1fs exhausted after %d attempt(s): "
                         "%s", self.name, self.deadline_s, attempt + 1, e)
+                    _tel_counter(
+                        "hvd_retry_exhausted_total",
+                        "retry policies giving up (attempts or "
+                        "deadline)").inc(policy=self.name)
                     raise
                 hvd_logging.warning(
                     "%s: attempt %d/%d failed (%s: %s) — retrying in "
                     "%.2fs", self.name, attempt + 1, self.max_attempts,
                     type(e).__name__, e, delay)
+                _tel_counter(
+                    "hvd_retry_backoff_seconds_total",
+                    "cumulative backoff sleep per policy").inc(
+                        delay, policy=self.name)
                 self._sleep(delay)
         assert last is not None
+        _tel_counter(
+            "hvd_retry_exhausted_total",
+            "retry policies giving up (attempts or deadline)").inc(
+                policy=self.name)
         raise last
 
 

@@ -76,6 +76,9 @@ class GlobalState:
                             "aot_disk_hits": 0, "aot_disk_misses": 0}
         # warm-start cache root resolved at initialize() (None = disabled)
         self.compile_cache_dir = None
+        # telemetry exporters started at initialize() (None = metrics off;
+        # the registry itself is process-global, horovod_tpu/telemetry)
+        self.telemetry = None
 
     # -- bootstrap ---------------------------------------------------------
 
@@ -176,6 +179,13 @@ class GlobalState:
                     "compile cache: %s (%d AOT entr%s)",
                     self.compile_cache_dir, n, "y" if n == 1 else "ies")
 
+        # telemetry plane BEFORE timeline/stall: both render registered
+        # gauges (timeline counter rows) and count through the registry
+        from horovod_tpu import telemetry
+
+        self.telemetry = telemetry.start_from_config(
+            cfg, process_rank=self.process_rank)
+
         if cfg.timeline_filename:
             self.timeline = _make_timeline(cfg, self.process_rank
                                            if self.process_count > 1 else 0)
@@ -215,6 +225,11 @@ class GlobalState:
                     aggregate_after_close(fname, origin)
             if self.stall_inspector is not None:
                 self.stall_inspector.stop()
+            if self.telemetry is not None:
+                # final JSONL snapshot + endpoint teardown; the registry
+                # itself survives (elastic resets re-init around it)
+                self.telemetry.shutdown()
+                self.telemetry = None
             self.shut_down = True
             self.initialization_done = False
 

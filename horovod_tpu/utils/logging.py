@@ -59,8 +59,19 @@ class _Formatter(logging.Formatter):
         if not self._hide_time:
             ts = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(record.created))
             prefix += f" {ts}.{int(record.msecs):03d}"
-        prefix += f" rank {rank}]" if rank >= 0 else "]"
-        return f"{prefix} {record.getMessage()}"
+        if rank >= 0:
+            prefix += f" rank {rank}"
+        # run-context correlation (docs/metrics.md): once a run context
+        # is explicitly set (metrics-enabled init, bench), log lines
+        # carry the same (generation, step) the trace and the metric
+        # snapshots stamp — greppable from either side
+        try:
+            from horovod_tpu.telemetry.context import run_context
+
+            prefix += run_context().log_suffix()
+        except Exception:
+            pass
+        return f"{prefix}] {record.getMessage()}"
 
 
 def get_logger() -> logging.Logger:

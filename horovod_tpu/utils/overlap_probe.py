@@ -270,6 +270,27 @@ def measure_overlap(loss_fn: Callable,
     saved = t_bwd + t_exc - t_fsd
     denom = min(t_bwd, t_exc)
     frac = saved / denom if denom > 0 else 0.0
+    # registry mirror of the probe's headline numbers (docs/metrics.md):
+    # measured per-level exchange time and wire bytes, next to the
+    # static model the train step publishes
+    from horovod_tpu import telemetry
+
+    if telemetry.enabled():
+        tg = telemetry.gauge("hvd_exchange_time_seconds",
+                             "measured gradient-exchange time per level")
+        tg.set(t_exc, level="total")
+        if t_intra is not None:
+            tg.set(t_intra, level="ici")
+            tg.set(t_cross, level="dcn")
+        telemetry.gauge("hvd_overlap_fraction",
+                        "measured comm/compute overlap fraction").set(
+                            float(np.clip(frac, 0.0, 1.0)))
+        if wire_ici is not None:
+            wg = telemetry.gauge(
+                "hvd_exchange_measured_wire_bytes",
+                "per-level wire bytes of the compiled exchange")
+            wg.set(wire_ici, level="ici")
+            wg.set(wire_dcn, level="dcn")
     return OverlapReport(
         backward_s=t_bwd, exchange_s=t_exc, fused_s=t_fsd,
         overlap_fraction=float(np.clip(frac, 0.0, 1.0)),

@@ -32,10 +32,24 @@ import threading
 import time
 from typing import Dict, Optional
 
-from horovod_tpu import faults
+from horovod_tpu import faults, telemetry
 from horovod_tpu.utils import logging as hvd_logging
 
 _STATUS_DIR = "hvdstall/status"
+
+# hung-worker precursors, scrapeable BEFORE the health plane fires
+# (docs/metrics.md): pending-op count and oldest age climb while a
+# collective wedges; the warning counter records that the inspector
+# spoke; the abort counter that it pulled the shutdown lever
+_TEL_PENDING = telemetry.gauge(
+    "hvd_stall_pending_ops", "eager collectives dispatched, not complete")
+_TEL_OLDEST = telemetry.gauge(
+    "hvd_stall_oldest_age_seconds", "age of the oldest pending collective")
+_TEL_WARNINGS = telemetry.counter(
+    "hvd_stall_warnings_total", "stall warnings emitted")
+_TEL_ABORTS = telemetry.counter(
+    "hvd_stall_aborts_total",
+    "stall-shutdown aborts (HOROVOD_STALL_SHUTDOWN_TIME_SECONDS)")
 
 
 class ProgressWatchdog:
@@ -48,10 +62,17 @@ class ProgressWatchdog:
     :meth:`stalled_for` and what stagnation threshold means trouble.
     ``clock`` is injectable for deterministic tests."""
 
-    def __init__(self, clock=time.monotonic):
+    def __init__(self, clock=time.monotonic, name: Optional[str] = None):
         self._clock = clock
         self._value: Optional[int] = None
         self._since: Optional[float] = None
+        # named watchdogs publish their stagnation as a labeled gauge —
+        # the hung-worker precursor the health plane acts on later
+        # (docs/metrics.md); unnamed ones stay pure bookkeeping
+        self._tel_stall = None if name is None else telemetry.gauge(
+            "hvd_progress_stall_seconds",
+            "seconds since a watched progress counter last advanced"
+        ).labels(watchdog=name)
 
     def update(self, value: int, now: Optional[float] = None) -> None:
         """Record the counter's current value; only an *advance*
@@ -63,6 +84,8 @@ class ProgressWatchdog:
         if self._value is None or value > self._value:
             self._value = value
             self._since = now
+            if self._tel_stall is not None:
+                self._tel_stall.set(0.0)
 
     @property
     def value(self) -> Optional[int]:
@@ -76,7 +99,10 @@ class ProgressWatchdog:
             return 0.0
         if now is None:
             now = self._clock()
-        return max(now - self._since, 0.0)
+        stalled = max(now - self._since, 0.0)
+        if self._tel_stall is not None:
+            self._tel_stall.set(stalled)
+        return stalled
 
 
 class StallInspector:
@@ -212,9 +238,12 @@ class StallInspector:
             faults.inject("stall.watch")
             now = time.monotonic()
             stalled, fatal, publish_due = [], [], []
+            oldest = 0.0
             with self._lock:
+                n_pending = len(self._pending)
                 for name, t0 in self._pending.items():
                     age = now - t0
+                    oldest = max(oldest, age)
                     if age > self._warning_time_s / 2.0:
                         publish_due.append(name)
                     if age > self._warning_time_s and name not in self._warned:
@@ -222,6 +251,10 @@ class StallInspector:
                         self._warned.add(name)
                     if self._shutdown_time_s > 0 and age > self._shutdown_time_s:
                         fatal.append((name, age))
+            _TEL_PENDING.set(n_pending)
+            _TEL_OLDEST.set(oldest)
+            if stalled:
+                _TEL_WARNINGS.inc(len(stalled))
             # _published non-empty with nothing due means the stall
             # cleared: republish the (empty) set so peers stop blaming us
             cluster = self._cluster() \
@@ -245,6 +278,7 @@ class StallInspector:
                 hvd_logging.error(
                     "Collective(s) stalled beyond "
                     "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS; aborting process.")
+                _TEL_ABORTS.inc()
                 import os
 
                 os._exit(1)

@@ -94,18 +94,34 @@ class TraceAnnotationBridge:
         self._open.clear()
 
 
+_TICK = object()    # writer-loop sentinel: periodic flush, no event
+
+
 class Timeline:
     """Asynchronous Chrome-trace writer (reference ``TimelineWriter``).
 
     Events are pushed onto a thread-safe queue and serialized by a
     dedicated writer thread, mirroring the SPSC design in
     ``timeline.h:47-75`` without stalling collective dispatch.
+
+    The writer flushes on a time/event-count bound
+    (``flush_interval_s``/``flush_events``), so a crashed worker leaves
+    at most one flush window of events in the libc buffer and the file
+    on disk stays *truncated-valid*: :func:`load_trace` recovers every
+    complete event from a file whose tail (and closing ``]``) never got
+    written.  On each flush tick the writer additionally renders every
+    registered telemetry gauge as a Chrome counter row (``"ph": "C"``)
+    — queue depth, heartbeat age and friends appear as tracks under the
+    collective spans (docs/metrics.md, docs/timeline.md).
     """
 
-    def __init__(self, filename: str, mark_cycles: bool = False):
+    def __init__(self, filename: str, mark_cycles: bool = False,
+                 flush_interval_s: float = 5.0, flush_events: int = 128):
         self.filename = filename
         self._filename = filename
         self._mark_cycles = mark_cycles
+        self._flush_interval_s = max(float(flush_interval_s), 0.05)
+        self._flush_events = max(int(flush_events), 1)
         self._queue: "queue.Queue" = queue.Queue()
         self._start_ns = time.monotonic_ns()
         # wall-clock at the monotonic origin: event wall time =
@@ -121,6 +137,14 @@ class Timeline:
         self._writer = threading.Thread(target=self._write_loop, daemon=True,
                                         name="hvd_tpu_timeline_writer")
         self._writer.start()
+        # correlation stamp: when a run context was explicitly set
+        # (bench/elastic runs), the trace opens with it so spans, metric
+        # snapshots and logs share the (run_id, generation) key
+        from horovod_tpu.telemetry import context as tel_context
+
+        ctx = tel_context.run_context()
+        if ctx.explicit:
+            self.instant("run_context", args=ctx.as_dict())
 
     # -- event API (mirrors Timeline::ActivityStart/End, MarkCycleStart) ----
 
@@ -152,17 +176,67 @@ class Timeline:
     # -- writer thread ------------------------------------------------------
 
     def _write_loop(self) -> None:
+        unflushed = 0
+        last_flush = time.monotonic()
         while True:
-            ev = self._queue.get()
+            try:
+                ev = self._queue.get(timeout=self._flush_interval_s)
+            except queue.Empty:
+                ev = _TICK
             if ev is None:
+                self._file.flush()
                 return
+            if ev is _TICK:
+                # idle flush: push buffered events to disk so a later
+                # crash cannot lose them, and sample the gauges
+                self._emit_gauge_counters()
+                self._file.flush()
+                unflushed = 0
+                last_flush = time.monotonic()
+                continue
             # chaos hook: a raise/delay models a failing trace sink —
             # tracing must degrade without stalling the training loop
             faults.inject("timeline.write")
-            if not self._first:
-                self._file.write(",\n")
-            self._first = False
-            json.dump(ev, self._file)
+            self._write_event(ev)
+            unflushed += 1
+            now = time.monotonic()
+            if unflushed >= self._flush_events or \
+                    now - last_flush >= self._flush_interval_s:
+                self._emit_gauge_counters()
+                self._file.flush()
+                unflushed = 0
+                last_flush = now
+
+    def _write_event(self, ev: dict) -> None:
+        if not self._first:
+            self._file.write(",\n")
+        self._first = False
+        json.dump(ev, self._file)
+
+    def _emit_gauge_counters(self) -> None:
+        """Chrome counter rows (``"ph":"C"``) from the telemetry
+        registry's gauges, one event per gauge name with the label sets
+        as counter series — written inline by the writer thread (never
+        queued, so a full queue can't starve the metrics track)."""
+        try:
+            from horovod_tpu import telemetry
+
+            if not telemetry.enabled():
+                return
+            samples = telemetry.default_registry().gauge_samples()
+        except Exception:      # noqa: BLE001 — metrics must not kill tracing
+            return
+        if not samples:
+            return
+        ts = self._ts_us()
+        by_name: dict = {}
+        for name, labels, value in samples:
+            series = ",".join(f"{k}={v}" for k, v in
+                              sorted(labels.items())) or "value"
+            by_name.setdefault(name, {})[series] = value
+        for name, args in sorted(by_name.items()):
+            self._write_event({"ph": "C", "name": name, "pid": self._pid,
+                               "tid": "metrics", "ts": ts, "args": args})
 
     def close(self) -> None:
         if self._closed:
@@ -173,6 +247,39 @@ class Timeline:
         self._writer.join(timeout=5)
         self._file.write("\n]\n")
         self._file.close()
+
+
+def load_trace(filename: str) -> list:
+    """Parse a Chrome-trace file, tolerating a truncated tail.
+
+    A cleanly-closed trace is plain JSON.  A crashed worker's trace is
+    missing the closing ``]`` and may end mid-event; since the writer
+    emits one event per line joined by ``",\\n"``, every *complete*
+    event is still recoverable — exactly what the periodic writer flush
+    guarantees survived to disk (the reference loses the buffered tail
+    entirely).  Chrome's own loader applies the same tolerance; this is
+    the programmatic counterpart the aggregation and tests use."""
+    with open(filename) as f:
+        text = f.read()
+    try:
+        return json.loads(text)
+    except ValueError:
+        pass
+    events = []
+    body = text.lstrip()
+    if body.startswith("["):
+        body = body[1:]
+    for part in body.split(",\n"):
+        part = part.strip()
+        if part.endswith("]"):
+            part = part[:-1].rstrip()
+        if not part:
+            continue
+        try:
+            events.append(json.loads(part))
+        except ValueError:
+            break          # the incomplete tail event — the crash point
+    return events
 
 
 def merge_traces(blobs) -> list:
@@ -237,8 +344,7 @@ def aggregate_after_close(filename: str, wall_origin_us) -> None:
         wall_origin_us = time.time_ns() / 1e3
     if me != 0:
         try:
-            with open(filename) as f:
-                events = json.load(f)
+            events = load_trace(filename)
         except Exception:
             events = []
         client.key_value_set_bytes(
@@ -270,8 +376,7 @@ def aggregate_after_close(filename: str, wall_origin_us) -> None:
 
 def _load_events(filename: str) -> list:
     try:
-        with open(filename) as f:
-            return json.load(f)
+        return load_trace(filename)
     except Exception:
         return []
 

@@ -7,9 +7,10 @@ import time
 import jax.numpy as jnp
 import pytest
 
+from horovod_tpu import faults
 from horovod_tpu.utils import logging as hvd_logging
 from horovod_tpu.utils.stall import StallInspector
-from horovod_tpu.utils.timeline import Timeline
+from horovod_tpu.utils.timeline import Timeline, load_trace
 
 
 class TestPythonTimeline:
@@ -24,6 +25,53 @@ class TestPythonTimeline:
         assert [e["ph"] for e in events] == ["B", "E", "i"]
         assert events[0]["name"] == "XLA_ALLREDUCE"
         assert events[0]["tid"] == "grad/dense0"
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_writer_death_leaves_truncated_valid_trace(self, tmp_path):
+        """Satellite contract (docs/timeline.md): the periodic flush
+        bounds what a crashed worker loses, and the file it leaves —
+        no closing ``]``, possibly mid-event — parses via load_trace.
+        The writer is killed mid-run with a timeline.write chaos fault
+        (an uncaught raise ends the thread exactly like a crash would,
+        with the file unclosed)."""
+        path = tmp_path / "tl.json"
+        tl = Timeline(str(path), flush_interval_s=0.05, flush_events=1)
+        for i in range(5):
+            tl.start_activity(f"t{i}", "QUEUE")
+            tl.end_activity(f"t{i}")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                len(load_trace(str(path))) < 10:
+            time.sleep(0.02)
+        assert len(load_trace(str(path))) >= 10, "flush never happened"
+        # kill the writer on the 11th event
+        faults.set_plan(faults.FaultPlan().add("timeline.write", "raise"))
+        try:
+            tl.start_activity("doomed", "QUEUE")
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and tl._writer.is_alive():
+                time.sleep(0.02)
+        finally:
+            faults.clear_plan()
+        assert not tl._writer.is_alive()
+        # the on-disk trace is truncated (not valid JSON) but every
+        # complete event is recoverable
+        raw = path.read_text()
+        with pytest.raises(ValueError):
+            json.loads(raw)
+        events = load_trace(str(path))
+        assert len(events) >= 10
+        assert all(e["ph"] in ("B", "E") for e in events)
+        tl.close()      # cleanup path still works with a dead writer
+        assert len(load_trace(str(path))) == len(events)
+
+    def test_load_trace_tolerates_partial_tail_event(self, tmp_path):
+        path = tmp_path / "tl.json"
+        path.write_text('[\n{"ph": "B", "name": "QUEUE", "tid": "a"},\n'
+                        '{"ph": "E", "tid": "a"},\n{"ph": "B", "na')
+        events = load_trace(str(path))
+        assert [e["ph"] for e in events] == ["B", "E"]
 
     def test_eager_collectives_recorded(self, tmp_path, hvd_runtime):
         """A named eager collective leaves B/E events on the runtime
