@@ -159,15 +159,22 @@ def run_overlap_probe(args, loss_fn, params, batch, prefix, label):
     describe the schedule that actually ships."""
     if args.no_overlap_probe:
         return {}
+    from horovod_tpu.ops.pallas_kernels import resolve_fused_collectives
     from horovod_tpu.utils.overlap_probe import measure_overlap
 
     bucket = args.overlap_bucket_bytes if args.overlap_bucket_bytes \
         is not None else args.exchange_bucket_bytes
-    try:
-        rep = measure_overlap(
+    main_mode = getattr(args, "fused_collectives", "auto")
+    main_on = resolve_fused_collectives(main_mode)
+
+    def probe(fused_mode):
+        return measure_overlap(
             loss_fn, params, batch,
             bucket_bytes=bucket, hierarchy=args.hierarchy,
-            iters=3, warmup=1)
+            fused_collectives=fused_mode, iters=3, warmup=1)
+
+    try:
+        rep = probe("on" if main_on else "off")
     except Exception as e:  # noqa: BLE001 — probe must not sink the bench
         log(f"bench[{label}]: overlap probe failed ({e}); "
             f"omitting overlap fields")
@@ -176,13 +183,31 @@ def run_overlap_probe(args, loss_fn, params, batch, prefix, label):
         f" (intra {rep.exchange_intra_s * 1e3:.2f}ms / cross "
         f"{rep.exchange_cross_s * 1e3:.2f}ms, rs scopes "
         f"{list(rep.rs_scopes)})")
-    log(f"bench[{label}]: overlap probe [{rep.hierarchy}] "
+    log(f"bench[{label}]: overlap probe [{rep.hierarchy}/"
+        f"fused={rep.fused_collectives}] "
         f"bwd {rep.backward_s * 1e3:.2f}ms "
         f"exch {rep.exchange_s * 1e3:.2f}ms{level} "
         f"fused {rep.fused_s * 1e3:.2f}ms "
         f"-> overlap {rep.overlap_fraction:.2f} "
+        f"tail {rep.tail_exchange_s * 1e3:.2f}ms "
         f"({rep.payload_bytes / 1e6:.1f} MB payload, world {rep.world})")
-    return rep.as_bench_fields(prefix)
+    fields = rep.as_bench_fields(prefix)
+    # the OTHER final-bucket schedule, as a control: the artifact then
+    # carries tail_exchange_s/overlap_fraction for BOTH paths (the
+    # acceptance quantity of docs/fused_kernels.md — the fused tail
+    # must shrink relative to its own run's unfused control)
+    alt_prefix = prefix + ("unfused_" if main_on else "fused_")
+    try:
+        alt = probe("off" if main_on else "on")
+        fields.update(alt.as_bench_fields(alt_prefix))
+        log(f"bench[{label}]: overlap probe control "
+            f"[fused={alt.fused_collectives}] tail "
+            f"{alt.tail_exchange_s * 1e3:.2f}ms vs "
+            f"{rep.tail_exchange_s * 1e3:.2f}ms main")
+    except Exception as e:  # noqa: BLE001
+        log(f"bench[{label}]: fused-control probe failed ({e}); "
+            f"omitting {alt_prefix}* fields")
+    return fields
 
 
 def _rand_images(rng, n, hw):
@@ -361,7 +386,9 @@ def exchange_step_kwargs(args):
         return {}
     return {"mode": "shard_map", "shard_optimizer_states": True,
             "exchange_bucket_bytes": args.exchange_bucket_bytes,
-            "hierarchy": args.hierarchy}
+            "hierarchy": args.hierarchy,
+            "fused_collectives": getattr(args, "fused_collectives",
+                                         "auto")}
 
 
 def exchange_report_fields(args, step):
@@ -370,7 +397,8 @@ def exchange_report_fields(args, step):
     if not getattr(args, "shard_optimizer_states", False):
         return {}
     return {"exchange_hierarchy": step.exchange_hierarchy,
-            "exchange_bucket_bytes": args.exchange_bucket_bytes}
+            "exchange_bucket_bytes": args.exchange_bucket_bytes,
+            "step_fused_collectives": step.fused_collectives}
 
 
 def run_resnet(args, hvd):
@@ -940,6 +968,10 @@ def run_autotune(args, hvd):
             "exchange_bucket_bytes": [0, 1 * MiB, 4 * MiB,
                                       16 * MiB, 64 * MiB],
             "hierarchy": ["flat", "two_level"],
+            # the tile-fused final-bucket schedule rides the same
+            # coordinate descent (docs/fused_kernels.md); the cost
+            # model below prunes this axis without hardware
+            "fused_collectives": ["off", "on"],
         }
 
     def apply_exchange_point(a, point):
@@ -947,6 +979,30 @@ def run_autotune(args, hvd):
             a.exchange_bucket_bytes = \
                 point["exchange_bucket_bytes"] or None
             a.hierarchy = point["hierarchy"]
+            a.fused_collectives = point["fused_collectives"]
+
+    def exchange_predictor():
+        """Static exchange-schedule scorer for the autotuner's prune
+        pass (analysis/cost_model.py): ranks the hierarchy/fused axes
+        by predicted exposed wire seconds; axes the model cannot price
+        score identically and stay fully measured."""
+        if not exchange_axes:
+            return None
+        from horovod_tpu.analysis.cost_model import (
+            score_exchange_schedule,
+        )
+        from horovod_tpu.runtime import state as rt_state
+
+        if model == "transformer":
+            d, layers, v = args.tf_d_model, args.tf_layers, 32_000
+            payload = 4.0 * (12 * layers * d * d + v * d)
+        else:
+            payload = 4.0 * 25.6e6          # ResNet-50 fp32 grads
+        shape = list(rt_state.global_state().mesh.shape.values())
+        n_dcn = shape[0] if len(shape) == 2 else 1
+        n_ici = shape[-1]
+        return lambda point: score_exchange_schedule(
+            point, payload, n_dcn=n_dcn, n_ici=n_ici)
 
     if model == "transformer":
         axes = {"steps_per_call": [1, 5, 10, 20, 40],
@@ -973,7 +1029,8 @@ def run_autotune(args, hvd):
                          f"not {model}")
 
     log_path = args.autotune_log or f"autotune_{model}.csv"
-    tuner = ThroughputAutotuner(measure, axes, log_path=log_path)
+    tuner = ThroughputAutotuner(measure, axes, log_path=log_path,
+                                predict=exchange_predictor())
     best, rate = tuner.run()
     return {"metric": f"autotune_{model}", "value": round(rate, 1),
             "unit": ("img/sec/chip" if model == "resnet"
@@ -1087,6 +1144,16 @@ def main():
                         "reverse-layer-order buckets (the "
                         "exchange_bucket_bytes knob); default: one "
                         "monolithic bucket")
+    p.add_argument("--fused-collectives", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="tile-fused final-bucket exchange "
+                        "(HOROVOD_FUSED_COLLECTIVES): the last "
+                        "bucket's wire splits into independent "
+                        "sub-collectives the scheduler overlaps with "
+                        "the shard-update math; auto = TPU only "
+                        "(docs/fused_kernels.md).  The overlap probe "
+                        "reports tail_exchange_s for both paths "
+                        "either way")
     p.add_argument("--hierarchy", default="auto",
                    choices=["auto", "flat", "two_level"],
                    help="exchange topology: two_level reduce-scatters "

@@ -128,6 +128,63 @@ def exchange_time_s(wire: WireBytes, hw: HardwareModel = V5E) -> float:
     return wire.ici / hw.ici_bytes_per_s + wire.dcn / hw.dcn_bytes_per_s
 
 
+#: Tile count of the tile-fused exchange schedule — mirrors
+#: ``ops.collectives.FUSED_TAIL_TILES`` (this module stays stdlib-only,
+#: so the constant is duplicated by value; docs/fused_kernels.md).
+FUSED_TILE_COUNT = 4
+
+
+def fused_tail_exchange_s(wire_s: float, compute_s: float,
+                          n_tiles: int = FUSED_TILE_COUNT) -> float:
+    """Overlap-aware roofline of the tile-fused exchange
+    (docs/fused_kernels.md): with the wire split into ``n_tiles``
+    sub-exchanges interleaved with per-tile compute, tile *k*'s
+    transfer hides under tile *k+1*'s work — only the FIRST tile's
+    share (``wire/n_tiles``, nothing precedes it) plus whatever wire
+    exceeds the available compute stays exposed.  ``n_tiles <= 1`` is
+    the unfused serial tail: the whole ``wire_s`` exposed.  This is
+    the ceiling the autotuner uses to prune the
+    ``fused_collectives`` axis without hardware
+    (:func:`score_exchange_schedule`)."""
+    wire_s = max(0.0, float(wire_s))
+    if n_tiles <= 1 or wire_s == 0.0:
+        return wire_s
+    startup = wire_s / n_tiles
+    return startup + max(0.0, wire_s - max(0.0, float(compute_s)))
+
+
+def score_exchange_schedule(point: Dict,
+                            payload_bytes: float,
+                            n_dcn: int = 1,
+                            n_ici: int = 1,
+                            compute_s: float = 0.0,
+                            hw: HardwareModel = V5E,
+                            n_tiles: int = FUSED_TILE_COUNT
+                            ) -> Optional[float]:
+    """Rank one autotune sample point by its predicted *exposed*
+    exchange seconds (negated — higher is better, matching the
+    measured-rate objective).  ``point`` is a bench-autotuner sample
+    (``{"hierarchy": ..., "fused_collectives": ..., ...}``); knobs the
+    exchange model does not price (steps_per_call, flash_block, bucket
+    cap) leave the score unchanged, so per-axis scans of those knobs
+    see constant scores and stay fully measured.  Returns ``None``
+    when the point carries no exchange knob at all — the caller then
+    skips pruning entirely (the ParameterManager ``predict=``
+    contract: a predictor that cannot rank must not narrow the
+    grid)."""
+    hierarchy = point.get("hierarchy")
+    fused = point.get("fused_collectives")
+    if hierarchy is None and fused is None:
+        return None
+    hierarchy = hierarchy if hierarchy in ("flat", "two_level") else "flat"
+    wire = exchange_wire_bytes(float(payload_bytes), n_dcn=n_dcn,
+                               n_ici=n_ici, hierarchy=hierarchy)
+    serial = exchange_time_s(wire, hw)
+    if fused == "on":
+        return -fused_tail_exchange_s(serial, compute_s, n_tiles)
+    return -serial
+
+
 def _op_wire_bytes(op: H.CollectiveOp, world: int) -> float:
     """Per-chip wire bytes of one compiled collective from its result
     size: RS results are per-shard (input = bytes·g), AR/AG results are

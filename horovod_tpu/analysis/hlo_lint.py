@@ -25,6 +25,11 @@ HLO003     two-level exchange without a low-precision (s8/u8/fp8) DCN
            hop — the cross-slice phase is paying full-width wire bytes
 HLO004     artifact structure: hierarchy says two_level but the scope
            set isn't two distinct scopes (or flat with >1 scope)
+HLO005     serial exchange tail: the final RS/AG start..done pair has
+           no compute scheduled between it (HLO text), or an artifact
+           claims ``fused_collectives=on`` yet still reports a serial
+           tail — the exposure the tile-fused exchange exists to
+           remove (docs/fused_kernels.md)
 =========  ==============================================================
 """
 
@@ -125,6 +130,19 @@ def lint_hlo_text(text: str,
                 "HLO004",
                 f"hierarchy=flat but reduce-scatter runs {len(distinct)} "
                 f"distinct scopes {rs_scopes} — expected one"))
+
+    # HLO005 — serial exchange tail: the module's FINAL async RS/AG
+    # pair has no compute op scheduled inside its start..done window,
+    # i.e. the last bucket's exchange sits fully exposed on the step's
+    # critical path (the tile-fused exchange removes exactly this;
+    # synchronous dumps with no async pairs are not judged)
+    if H.serial_tail_collectives(text):
+        findings.append(HloFinding(
+            "HLO005",
+            "serial exchange tail: the final reduce-scatter/all-gather "
+            "start..done pair has no compute scheduled between it — "
+            "the last bucket's wire is fully exposed (enable "
+            "fused_collectives, docs/fused_kernels.md)"))
     return findings
 
 
@@ -176,6 +194,19 @@ def lint_artifact(artifact: Dict) -> List[HloFinding]:
                 "HLO004",
                 f"[{label}] overlap_fraction={frac} out of [0, 1] — "
                 f"corrupt probe output"))
+        # HLO005 — a run that claims the fused tail is ON must not
+        # still report a serial final RS/AG pair in its probe scan
+        # (legacy artifacts without the fields pass vacuously; with
+        # fused off a serial tail is the expected unfused schedule)
+        serial = artifact.get(
+            f"{prefix}exchange_serial_tail_collectives")
+        fused = artifact.get(f"{prefix}fused_collectives")
+        if fused == "on" and serial:
+            findings.append(HloFinding(
+                "HLO005",
+                f"[{label}] fused_collectives=on but the probe still "
+                f"found {serial} serial final RS/AG pair(s) — the "
+                f"tile-fused exchange is not reaching the wire"))
     return findings
 
 

@@ -58,6 +58,11 @@ class TransformerConfig:
     sp_axis: str = AXIS_SP
     tp_axis: str = AXIS_TP
     remat: bool = False
+    # tile-fused matmul⊗collective kernels at the tp boundaries
+    # (HOROVOD_FUSED_COLLECTIVES, docs/fused_kernels.md) — consumed by
+    # :func:`fused_tp_apply`, the explicit shard_map execution mode;
+    # the GSPMD modules below ignore it (XLA owns their collectives)
+    fused_collectives: str = "auto"     # auto | on | off
 
     @property
     def head_dim(self) -> int:
@@ -238,3 +243,166 @@ def lm_loss(variables, model: TransformerLM, tokens: jax.Array,
 
     return optax.softmax_cross_entropy_with_integer_labels(
         logits, tokens[:, 1:]).mean()
+
+
+# ---------------------------------------------------------------------------
+# tile-fused sequence-parallel execution mode (docs/fused_kernels.md)
+# ---------------------------------------------------------------------------
+
+def _rms(x, scale, epsilon=1e-6):
+    """RMSNorm as a function of the unboxed ``scale`` param — the exact
+    math of :class:`RMSNorm` (per-token, so it runs on token shards)."""
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt(
+        jnp.mean(x32 * x32, axis=-1, keepdims=True) + epsilon)
+    return (y * scale).astype(x.dtype)
+
+
+def fused_tp_apply(variables, cfg: TransformerConfig, tokens: jax.Array,
+                   positions: Optional[jax.Array] = None,
+                   fused: Optional[bool] = None,
+                   interpret: bool = False) -> jax.Array:
+    """TransformerLM forward with tile-fused collectives at every
+    tensor-parallel boundary — the explicit shard_map twin of
+    ``TransformerLM.apply``.
+
+    Run inside ``shard_map`` over ``cfg.tp_axis`` with *unboxed*
+    replicated variables (``flax.core.meta.unbox``); returns the same
+    logits as the GSPMD ``apply``.  Where the annotated modules close
+    each block with one boundary-wide psum, this path restructures to
+    Megatron-SP: activations stay **token-sharded** between blocks
+    (RMSNorm and residuals are per-token), each column boundary gathers
+    tokens *inside* the matmul
+    (:func:`~horovod_tpu.parallel.tensor_parallel.column_parallel_dense_ag`)
+    and each row boundary reduce-scatters them back
+    (:func:`~horovod_tpu.parallel.tensor_parallel.row_parallel_dense_rs`)
+    — tile k's wire hides under tile k+1's MXU compute, so no serial
+    full-width collective survives at any parallelism boundary (the
+    HLO guard pins ring permutes, zero all-reduces).  The one
+    remaining gather is the final-logits all-gather after ``ln_f``.
+
+    Shape contract: ``seq % tp``, ``num_heads % tp`` and
+    ``d_ff % tp`` must be 0.  ``fused=None`` resolves
+    ``cfg.fused_collectives`` (``"auto"`` = TPU only); ``fused=False``
+    keeps the same SP structure with unfused boundary collectives —
+    the numerics-pinning baseline.
+    """
+    from jax import lax
+
+    from horovod_tpu.ops.pallas_kernels import resolve_fused_collectives
+    from horovod_tpu.parallel.tensor_parallel import (
+        column_parallel_dense_ag,
+        row_parallel_dense_rs,
+    )
+
+    if cfg.attention_impl not in ("dense", "flash"):
+        raise ValueError(
+            f"fused_tp_apply supports attention_impl dense|flash, got "
+            f"{cfg.attention_impl!r} (ring/ulysses already own their "
+            f"sequence axis)")
+    if fused is None:
+        fused = resolve_fused_collectives(cfg.fused_collectives)
+    params = variables.get("params", variables)
+    axis = cfg.tp_axis
+    w = int(jax.lax.axis_size(axis))
+    me = lax.axis_index(axis)
+    b, t = tokens.shape
+    d, heads = cfg.d_model, cfg.num_heads
+    if t % w or heads % w or cfg.d_ff % w:
+        raise ValueError(
+            f"fused_tp_apply needs seq ({t}), num_heads ({heads}) and "
+            f"d_ff ({cfg.d_ff}) divisible by the {axis!r} extent {w}")
+    t_loc, d_loc, f_loc = t // w, d // w, cfg.d_ff // w
+    h_loc, hd = heads // w, cfg.head_dim
+    if positions is None:
+        positions = jnp.arange(t)
+
+    def col_shard(kernel, width):
+        return lax.dynamic_slice_in_dim(kernel, me * width, width, axis=1)
+
+    def row_shard(kernel, width):
+        return lax.dynamic_slice_in_dim(kernel, me * width, width, axis=0)
+
+    def to_rank_major(full):
+        """(b, t, f) natural tokens → (w·b·t_loc, f) rank-major rows —
+        the layout matmul_reducescatter scatters over."""
+        f = full.shape[-1]
+        return full.reshape(b, w, t_loc, f).transpose(1, 0, 2, 3) \
+            .reshape(w * b * t_loc, f)
+
+    def from_gathered(rows, f):
+        """(w·b·t_loc, f) rank-major gather output → (b, t, f) natural."""
+        return rows.reshape(w, b, t_loc, f).transpose(1, 0, 2, 3) \
+            .reshape(b, t, f)
+
+    def shard2d(x_shard):
+        return x_shard.reshape(b * t_loc, x_shard.shape[-1])
+
+    emb = params["embed"]["embedding"]
+    x = jnp.take(emb.astype(cfg.dtype), tokens, axis=0)   # (b, t, d)
+    # token-shard the residual stream: rank r owns tokens
+    # [r·t_loc, (r+1)·t_loc) of every batch row
+    x_shard = lax.dynamic_slice_in_dim(x, me * t_loc, t_loc, axis=1)
+
+    for i in range(cfg.num_layers):
+        layer = params[f"layer_{i}"]
+        # -- attention: AG⊗qkv-matmul → core → proj-matmul⊗RS
+        h = _rms(x_shard, layer["ln1"]["scale"])
+        qkv_k = layer["attn"]["qkv"]["kernel"].astype(cfg.dtype)
+        # per-matrix column shards: a contiguous slice of the fused
+        # (d, 3d) kernel would span only one of q/k/v at tp > 3
+        wq, wk, wv = (qkv_k[:, j * d:(j + 1) * d] for j in range(3))
+        wqkv = jnp.concatenate(
+            [col_shard(m, d_loc) for m in (wq, wk, wv)], axis=1)
+        qkv = column_parallel_dense_ag(
+            shard2d(h).astype(cfg.dtype), wqkv, axis=axis, fused=fused,
+            interpret=interpret)
+        q, k, v = jnp.split(from_gathered(qkv, 3 * d_loc), 3, axis=-1)
+        shape = (b, t, h_loc, hd)
+        q, k, v = (a.reshape(shape) for a in (q, k, v))
+        q = rotary_embedding(q, positions)
+        k = rotary_embedding(k, positions)
+        if cfg.attention_impl == "flash":
+            from horovod_tpu.ops.pallas_kernels import flash_attention
+
+            o = flash_attention(q, k, v, causal=cfg.causal,
+                                block_q=cfg.flash_block,
+                                block_k=cfg.flash_block)
+        else:
+            o = reference_attention(q, k, v, causal=cfg.causal)
+        o = o.reshape(b, t, h_loc * hd)
+        proj_k = layer["attn"]["proj"]["kernel"].astype(cfg.dtype)
+        y = row_parallel_dense_rs(
+            to_rank_major(o).astype(cfg.dtype),
+            row_shard(proj_k, d_loc), axis=axis, fused=fused,
+            interpret=interpret)
+        x_shard = x_shard + y.reshape(b, t_loc, d)
+
+        # -- MLP: AG⊗wi-matmul → gelu → wo-matmul⊗RS.  The activation
+        # stays rank-major between the two boundaries — gelu is
+        # elementwise, so no natural-order round trip is needed
+        h = _rms(x_shard, layer["ln2"]["scale"])
+        wi = col_shard(layer["mlp"]["wi"]["kernel"].astype(cfg.dtype),
+                       f_loc)
+        wo = row_shard(layer["mlp"]["wo"]["kernel"].astype(cfg.dtype),
+                       f_loc)
+        hh = column_parallel_dense_ag(
+            shard2d(h).astype(cfg.dtype), wi, axis=axis, fused=fused,
+            interpret=interpret)
+        hh = nn.gelu(hh)
+        y = row_parallel_dense_rs(hh.astype(cfg.dtype), wo, axis=axis,
+                                  fused=fused, interpret=interpret)
+        x_shard = x_shard + y.reshape(b, t_loc, d)
+
+    x_shard = _rms(x_shard, params["ln_f"]["scale"])
+    # the one boundary-wide gather left: reassemble tokens for the tied
+    # logits head (rank-major chunks → natural order)
+    full = lax.all_gather(x_shard, axis, tiled=False)    # (w, b, t_loc, d)
+    x = full.transpose(1, 0, 2, 3).reshape(b, t, d)
+    # tied head, exactly flax Embed.attend's promotion: both operands
+    # to cfg.dtype (promote_dtype(dtype=self.dtype)) before the dot
+    query = x.astype(jnp.float32)
+    if cfg.dtype is not None:
+        query = query.astype(cfg.dtype)
+        emb = emb.astype(cfg.dtype)
+    return jnp.dot(query, emb.T)

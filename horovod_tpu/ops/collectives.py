@@ -181,10 +181,44 @@ def grouped_allreduce(xs: Sequence[jax.Array],
     return out
 
 
+#: Valid wire codecs for the quantized (DCN) exchange hop
+#: (``HOROVOD_EXCHANGE_WIRE_DTYPE``): shared-scale int8 (exact int32
+#: accumulation, the PR 2 codec) or fp8 e4m3 (floating wire — graceful
+#: within-segment dynamic range at a coarser 3-bit mantissa; EQuARX's
+#: low-precision-wire argument, arXiv:2506.17615).
+WIRE_DTYPES = ("int8", "fp8_e4m3")
+
+#: absmax quantization targets per wire codec: int8 clips at ±127,
+#: e4m3's largest finite is ±448
+_WIRE_QMAX = {"int8": 127.0, "fp8_e4m3": 448.0}
+
+
+def _resolve_wire_dtype(wire_dtype: Optional[str]) -> str:
+    """Wire codec resolution: explicit argument > runtime config
+    (``HOROVOD_EXCHANGE_WIRE_DTYPE``) > int8 default."""
+    if wire_dtype is None:
+        from horovod_tpu.runtime import state as _rt
+
+        if _rt.is_initialized():
+            wire_dtype = getattr(_rt.global_state().config,
+                                 "exchange_wire_dtype", "int8")
+        else:
+            import os
+
+            wire_dtype = os.environ.get(
+                "HOROVOD_EXCHANGE_WIRE_DTYPE", "int8").lower() or "int8"
+    if wire_dtype not in WIRE_DTYPES:
+        raise ValueError(
+            f"exchange wire dtype must be one of {WIRE_DTYPES}, got "
+            f"{wire_dtype!r}")
+    return wire_dtype
+
+
 def quantized_allreduce(x: jax.Array, axis: AxisSpec = GLOBAL_AXES,
                         op: ReduceOp = Average,
                         bits: int = 8,
-                        segments: Sequence[int] = ()) -> jax.Array:
+                        segments: Sequence[int] = (),
+                        wire_dtype: Optional[str] = None) -> jax.Array:
     """Average/sum with an int8-quantized wire (EQuARX-style, arXiv
     2506.17615): agree on a shared scale via one ``pmax``, quantize to
     int8, accumulate the psum in int32 (no overflow, exact integer
@@ -197,28 +231,44 @@ def quantized_allreduce(x: jax.Array, axis: AxisSpec = GLOBAL_AXES,
     so a small-magnitude gradient fused next to a large one is not
     rounded to zero — the quantization error is bounded per tensor, and
     the wire still carries a single fused int8 psum.
+
+    ``wire_dtype`` selects the codec (default: the runtime's
+    ``HOROVOD_EXCHANGE_WIRE_DTYPE``): ``"int8"`` keeps the exact-int32
+    accumulation above; ``"fp8_e4m3"`` casts the absmax-scaled values
+    to e4m3 on the wire and accumulates in fp32 — a coarser 3-bit
+    mantissa, but each element keeps ~2 decimal digits of *relative*
+    precision instead of sharing one absolute step across the segment.
     """
     if bits != 8:
         raise ValueError("only 8-bit quantization is supported")
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError("quantized_allreduce supports Sum/Average")
+    wire = _resolve_wire_dtype(wire_dtype)
     x32 = x.astype(jnp.float32)
-    scale = _shared_wire_scale(x32, segments, axis)
-    q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
-    total = lax.psum(q.astype(jnp.int32), axis)
-    y = total.astype(jnp.float32) * scale
+    scale = _shared_wire_scale(x32, segments, axis, qmax=_WIRE_QMAX[wire])
+    if wire == "fp8_e4m3":
+        q8 = jnp.clip(x32 / scale, -448.0, 448.0) \
+            .astype(jnp.float8_e4m3fn)
+        total = lax.psum(q8.astype(jnp.float32), axis)
+        y = total * scale
+    else:
+        q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
+        total = lax.psum(q.astype(jnp.int32), axis)
+        y = total.astype(jnp.float32) * scale
     if op == ReduceOp.AVERAGE:
         y = y / axis_size(axis)
     return y.astype(x.dtype)
 
 
 def _shared_wire_scale(x32: jax.Array, segments: Sequence[int],
-                       axis: AxisSpec) -> jax.Array:
-    """Shared int8 quantization scale(s) for a (fused) flat buffer —
+                       axis: AxisSpec, qmax: float = 127.0) -> jax.Array:
+    """Shared quantization scale(s) for a (fused) flat buffer —
     the codec core of :func:`quantized_allreduce`, reused by
     :func:`quantized_reducescatter`.  One ``pmax`` agrees on the
     per-segment absmax across shards; returns a scalar (no segments)
-    or a per-element scale vector (one scale per fused tensor)."""
+    or a per-element scale vector (one scale per fused tensor).
+    ``qmax`` is the codec's largest representable magnitude (127 for
+    int8, 448 for fp8 e4m3)."""
     if segments and len(segments) > 1:
         if x32.ndim != 1 or sum(segments) != x32.shape[0]:
             raise ValueError("segments must partition a flat buffer")
@@ -226,22 +276,25 @@ def _shared_wire_scale(x32: jax.Array, segments: Sequence[int],
         local_amax = jnp.stack(
             [jnp.max(jnp.abs(x32[bounds[i]:bounds[i + 1]]))
              for i in range(len(segments))])
-        scales = lax.pmax(local_amax, axis) / 127.0
+        scales = lax.pmax(local_amax, axis) / qmax
         scales = jnp.maximum(scales, 1e-30)
         return jnp.repeat(scales, np.asarray(segments),
                           total_repeat_length=x32.shape[0])
     local_amax = jnp.max(jnp.abs(x32))
-    scale = lax.pmax(local_amax, axis) / 127.0
+    scale = lax.pmax(local_amax, axis) / qmax
     return jnp.maximum(scale, 1e-30)
 
 
 def quantized_reducescatter(x: jax.Array, axis: AxisSpec = GLOBAL_AXES,
                             op: ReduceOp = Average,
                             bits: int = 8,
-                            segments: Sequence[int] = ()) -> jax.Array:
-    """Reduce-scatter with the int8 wire of :func:`quantized_allreduce`
-    (same shared-scale codec: one ``pmax`` agrees the scale, int8 on
-    the wire, exact int32 accumulation).  ``x`` must be flat with
+                            segments: Sequence[int] = (),
+                            wire_dtype: Optional[str] = None) -> jax.Array:
+    """Reduce-scatter with the low-precision wire of
+    :func:`quantized_allreduce` (same shared-scale codec: one ``pmax``
+    agrees the scale; int8 wire with exact int32 accumulation, or the
+    fp8 e4m3 wire with fp32 accumulation per ``wire_dtype`` /
+    ``HOROVOD_EXCHANGE_WIRE_DTYPE``).  ``x`` must be flat with
     length divisible by the axis world size; each shard receives its
     dequantized 1/world slice.  With ``segments``, per-tensor scales
     are used and this shard dequantizes with the scale entries of its
@@ -250,21 +303,28 @@ def quantized_reducescatter(x: jax.Array, axis: AxisSpec = GLOBAL_AXES,
         raise ValueError("only 8-bit quantization is supported")
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError("quantized_reducescatter supports Sum/Average")
+    wire = _resolve_wire_dtype(wire_dtype)
     world = axis_size(axis)
     if x.ndim != 1 or x.shape[0] % world:
         raise ValueError(
             f"quantized_reducescatter needs a flat buffer divisible by "
             f"world size {world}, got shape {x.shape}")
     x32 = x.astype(jnp.float32)
-    scale = _shared_wire_scale(x32, segments, axis)
-    q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
+    scale = _shared_wire_scale(x32, segments, axis, qmax=_WIRE_QMAX[wire])
     ax = axis if isinstance(axis, str) else tuple(axis)
-    total = lax.psum_scatter(q.astype(jnp.int32), ax, tiled=True)
+    if wire == "fp8_e4m3":
+        q8 = jnp.clip(x32 / scale, -448.0, 448.0) \
+            .astype(jnp.float8_e4m3fn)
+        total = lax.psum_scatter(q8.astype(jnp.float32), ax, tiled=True)
+    else:
+        q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
+        total = lax.psum_scatter(q.astype(jnp.int32), ax, tiled=True) \
+            .astype(jnp.float32)
     shard = x.shape[0] // world
     if scale.ndim:          # per-segment scales: this shard's slice
         scale = lax.dynamic_slice(scale, (axis_index(axis) * shard,),
                                   (shard,))
-    y = total.astype(jnp.float32) * scale
+    y = total * scale
     if op == ReduceOp.AVERAGE:
         y = y / world
     return y.astype(x.dtype)
@@ -360,6 +420,52 @@ def local_fusion_shards(leaves: Sequence[jax.Array], spec: FusionSpec,
     return out
 
 
+#: Tile count of the tile-granular final-bucket exchange
+#: (``fused_tail``, docs/fused_kernels.md): the last bucket's wire is
+#: split into this many independent sub-collectives so the scheduler
+#: can overlap tile k's exchange with the shard-update math consuming
+#: tile k-1 — the serial tail the bucketed overlap cannot hide.
+FUSED_TAIL_TILES = 4
+
+
+def _count_fused_tail() -> None:
+    from horovod_tpu import telemetry
+
+    telemetry.counter(
+        "hvd_pallas_fused_launches_total",
+        "tile-fused matmul-collective kernel constructions per kernel"
+    ).inc(kernel="tail_reducescatter")
+
+
+def _tiled_psum_scatter(flat: jax.Array, ax, world: int,
+                        tiles: int = FUSED_TAIL_TILES) -> jax.Array:
+    """Tile-granular ``psum_scatter`` of one fused flat buffer: the
+    per-rank shard splits into ``tiles`` segments, each exchanged by
+    its own independent collective, and the reduced shard is their
+    concatenation — numerically identical to the monolithic scatter
+    (same summation structure per element), but the compiler is free
+    to start tile k+1's wire while tile k's output is already being
+    consumed.  This is the ZeRO final-bucket form of the tile-fused
+    exchange (the matmul⊗collective kernels in
+    :mod:`~horovod_tpu.ops.pallas_kernels` are the tensor-parallel
+    form)."""
+    shard = flat.shape[0] // world
+    tiles = max(1, min(int(tiles), shard if shard else 1))
+    if tiles == 1 or world == 1:
+        return lax.psum_scatter(flat, ax, tiled=True)
+    _count_fused_tail()
+    x = flat.reshape(world, shard)
+    outs = []
+    for t in range(tiles):
+        lo = t * shard // tiles
+        hi = (t + 1) * shard // tiles
+        if hi == lo:
+            continue
+        seg = x[:, lo:hi].reshape(-1)
+        outs.append(lax.psum_scatter(seg, ax, tiled=True))
+    return jnp.concatenate(outs)
+
+
 def grouped_reducescatter(xs: Sequence[jax.Array],
                           op: ReduceOp = Sum,
                           axis: AxisSpec = GLOBAL_AXES,
@@ -367,7 +473,8 @@ def grouped_reducescatter(xs: Sequence[jax.Array],
                           postscale_factor: Optional[float] = None,
                           quantized_bits: Optional[int] = None,
                           bucket_bytes: Optional[int] = None,
-                          spec: Optional[FusionSpec] = None):
+                          spec: Optional[FusionSpec] = None,
+                          fused_tail: bool = False):
     """Fused reduce-scatter of many tensors — the first half of the
     ZeRO-style rewrite of :func:`grouped_allreduce` (reduce-scatter →
     shard-local math → allgather), with the same fusion machinery:
@@ -382,7 +489,14 @@ def grouped_reducescatter(xs: Sequence[jax.Array],
     the exchange into reverse-layer-order buckets so XLA can overlap
     each bucket's collective with the rest of backward (see
     :func:`horovod_tpu.ops.bucketing.plan_buckets`); ``None`` keeps
-    the monolithic single-bucket exchange.
+    the monolithic single-bucket exchange.  ``fused_tail=True`` splits
+    the LAST group's wire into :data:`FUSED_TAIL_TILES` independent
+    sub-collectives (:func:`_tiled_psum_scatter`) — the tile-granular
+    form of the final-bucket exchange, which no remaining backward
+    work can hide (docs/fused_kernels.md); numerics are identical,
+    only the schedule changes.  The quantized wire keeps its
+    monolithic shared-scale collective (the codec scale is agreed per
+    buffer).
 
     Degenerate 1-shard worlds reduce to plain identity semantics: the
     "shard" is the whole (padded) buffer and ``psum_scatter`` over a
@@ -398,9 +512,10 @@ def grouped_reducescatter(xs: Sequence[jax.Array],
             f"spec was planned for world {spec.world}, axis has {world}")
     ax = axis if isinstance(axis, str) else tuple(axis)
     shards: Dict[str, jax.Array] = {}
-    for g in spec.groups:
+    for gi, g in enumerate(spec.groups):
         flat = _group_flat(g, xs, prescale_factor)
         floating = jnp.issubdtype(flat.dtype, jnp.floating)
+        tail = fused_tail and gi == len(spec.groups) - 1
         if quantized_bits is not None and floating:
             # pad rides the last segment: zeros never raise its absmax
             segs = list(g.sizes)
@@ -408,6 +523,14 @@ def grouped_reducescatter(xs: Sequence[jax.Array],
             red = quantized_reducescatter(flat, axis=axis, op=op,
                                           bits=quantized_bits,
                                           segments=tuple(segs))
+        elif tail:
+            red = _tiled_psum_scatter(flat, ax, world)
+            if op == ReduceOp.AVERAGE and floating:
+                red = _scale(red, 1.0 / world)
+            elif op == ReduceOp.AVERAGE:
+                raise ValueError(
+                    "op=Average requires floating dtypes, got "
+                    f"{g.dtype}")
         else:
             red = lax.psum_scatter(flat, ax, tiled=True)
             if op == ReduceOp.AVERAGE and floating:
@@ -444,7 +567,8 @@ def hierarchical_reducescatter(xs: Sequence[jax.Array],
                                postscale_factor: Optional[float] = None,
                                quantized_bits: Optional[int] = None,
                                bucket_bytes: Optional[int] = None,
-                               spec: Optional[FusionSpec] = None):
+                               spec: Optional[FusionSpec] = None,
+                               fused_tail: bool = False):
     """Topology-aware two-level reduce-scatter — the reduce phase of the
     hierarchical exchange (reference ``NCCLHierarchicalAllreduce``,
     ``nccl_operations.cc:191-341``: NCCL inside the node, MPI across).
@@ -487,7 +611,7 @@ def hierarchical_reducescatter(xs: Sequence[jax.Array],
             f"spec was planned for world {spec.world}, mesh "
             f"({outer_axis},{inner_axis}) has {world}")
     shards: Dict[str, jax.Array] = {}
-    for g in spec.groups:
+    for gi, g in enumerate(spec.groups):
         flat = _group_flat(g, xs, prescale_factor)
         floating = jnp.issubdtype(flat.dtype, jnp.floating)
         if op == ReduceOp.AVERAGE and not floating:
@@ -495,8 +619,14 @@ def hierarchical_reducescatter(xs: Sequence[jax.Array],
                 f"op=Average requires floating dtypes, got {g.dtype}")
         # phase 1 — intra-slice (ICI): full-precision reduce-scatter;
         # g.padded is a multiple of world = n_inner * n_outer, so the
-        # surviving block length is still divisible by n_outer
-        block = lax.psum_scatter(flat, inner_axis, tiled=True)
+        # surviving block length is still divisible by n_outer.  With
+        # fused_tail, the LAST group's intra phase goes tile-granular
+        # (the DCN phase already rides the 1/n_inner shard and stays
+        # monolithic so the codec scale agreement is unchanged)
+        if fused_tail and gi == len(spec.groups) - 1:
+            block = _tiled_psum_scatter(flat, inner_axis, n_inner)
+        else:
+            block = lax.psum_scatter(flat, inner_axis, tiled=True)
         # phase 2 — cross-slice (DCN) on the 1/n_inner block
         if quantized_bits is not None and floating:
             red = quantized_reducescatter(block, axis=outer_axis,

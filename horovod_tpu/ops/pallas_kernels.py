@@ -1,6 +1,6 @@
 """Pallas TPU kernels for the hot ops.
 
-Two kernels, mirroring where the reference spends native effort:
+Kernels, mirroring where the reference spends native effort:
 
 * :func:`fused_scale` — the fusion-buffer scale kernel (reference
   ``ops/cuda/cuda_kernels.cu`` ``scale_buffer_k``/``ScaleBufferCudaImpl``):
@@ -11,8 +11,16 @@ Two kernels, mirroring where the reference spends native effort:
   of :mod:`~horovod_tpu.models.transformer`): Q blocks stream against
   K/V blocks held in VMEM with the online-softmax recurrence, never
   materializing the (T, T) score matrix in HBM.
+* :func:`matmul_reducescatter` / :func:`allgather_matmul` — tile-fused
+  matmul ⊗ collective ops (arXiv:2305.06942, docs/fused_kernels.md):
+  the matmul at a tensor-parallel boundary decomposes into per-rank
+  tiles streamed around a ``ppermute`` ring, so the exchange of tile
+  *k* overlaps the MXU compute of tile *k+1* inside one op and the
+  full-width serial collective at the boundary disappears from the
+  schedule.  Each tile's dot runs the blocked Pallas matmul kernel on
+  TPU (:func:`pallas_matmul`).
 
-Both degrade gracefully: off-TPU (or for shapes that don't meet the
+All degrade gracefully: off-TPU (or for shapes that don't meet the
 tiling contract) they fall back to the identical jnp formulation, and
 tests run the kernels in interpreter mode.
 """
@@ -610,3 +618,213 @@ def fused_conv_bn_relu(a, w, gamma, beta, mean, var,
 
     _run.defvjp(_fwd, _bwd)
     return _run(a, w, gamma, beta, mean, var)
+
+
+# ---------------------------------------------------------------------------
+# tile-fused matmul ⊗ collective kernels
+# ---------------------------------------------------------------------------
+#
+# Bucketed async RS/AG overlap (PR 1-2) hides the gradient exchange
+# behind backward compute — except at the boundaries where no compute
+# remains: the LAST bucket's exchange, and the collective every
+# tensor-parallel matmul pays at the row/column boundary.  These ops
+# close that tail the way "Optimizing Distributed ML Communication with
+# Fused Computation-Collective Operations" (arXiv:2305.06942) does:
+# decompose the matmul along the sharded dimension into one tile per
+# rank and stream the tiles around a ppermute ring, so the wire
+# transfer of tile k runs concurrently with the MXU compute of tile
+# k+1 *inside one op* — the serial full-width collective disappears
+# from the schedule (the HLO guard pins exactly this: ring
+# collective-permutes, no boundary-wide reduce-scatter/all-gather).
+# Each tile's dot runs the blocked Pallas matmul on TPU; off-TPU the
+# tile dot is the identical jnp formulation, so the ring is still the
+# compiled structure tier-1 asserts on the CPU mesh.
+
+#: Valid values of the ``fused_collectives`` knob
+#: (``HOROVOD_FUSED_COLLECTIVES``, docs/fused_kernels.md).
+FUSED_COLLECTIVES_MODES = ("auto", "on", "off")
+
+
+def resolve_fused_collectives(mode: str = "auto") -> bool:
+    """Resolve the ``fused_collectives="auto"|"on"|"off"`` knob.
+
+    ``"auto"`` enables the tile-fused path exactly when a TPU backend
+    is present — the ring's per-hop latency is what the ICI fabric
+    hides; on the CPU twin the fused path is opt-in (``"on"``) so the
+    structural tests and probes can exercise it deliberately.
+    """
+    if mode not in FUSED_COLLECTIVES_MODES:
+        raise ValueError(
+            f"fused_collectives must be one of {FUSED_COLLECTIVES_MODES},"
+            f" got {mode!r}")
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    return _on_tpu()
+
+
+def _count_fused_launch(kernel: str) -> None:
+    """hvd_pallas_fused_launches_total{kernel}: one count per fused-path
+    construction (trace time — the in-graph op then runs every step;
+    docs/metrics.md notes the trace-time semantics)."""
+    from horovod_tpu import telemetry
+
+    telemetry.counter(
+        "hvd_pallas_fused_launches_total",
+        "tile-fused matmul-collective kernel constructions per kernel"
+    ).inc(kernel=kernel)
+
+
+def _fit_mm_block(dim: int, candidates) -> Optional[int]:
+    for c in candidates:
+        if c <= dim and dim % c == 0:
+            return c
+    return None
+
+
+def _mm_kernel(x_ref, w_ref, o_ref):
+    # bf16 inputs ride the MXU at full rate with fp32 accumulation via
+    # preferred_element_type (same stance as the flash kernels)
+    o_ref[...] = jnp.dot(x_ref[...], w_ref[...],
+                         preferred_element_type=jnp.float32
+                         ).astype(o_ref.dtype)
+
+
+def pallas_matmul(x: jax.Array, w: jax.Array,
+                  out_dtype=None,
+                  interpret: bool = False) -> jax.Array:
+    """``x @ w`` as a blocked Pallas kernel (fp32 MXU accumulation).
+
+    Tiling contract: ``x`` is ``(m, k)``, ``w`` ``(k, n)`` with
+    ``m % 8 == 0`` and ``k, n % 128 == 0`` (fp32 sublane/lane tiles);
+    anything else — or no TPU and not interpret mode — falls back to
+    the identical ``jnp.dot`` formulation.  This is the per-tile
+    compute of the fused collective ops below.
+    """
+    out_dtype = jnp.dtype(out_dtype or jnp.result_type(x.dtype, w.dtype))
+    m, k = x.shape
+    k2, n = w.shape
+    assert k == k2, (x.shape, w.shape)
+    bm = _fit_mm_block(m, (512, 256, 128, 64, 32, 16, 8))
+    bn = _fit_mm_block(n, (512, 256, 128))
+    usable = (interpret or _on_tpu()) and bm is not None \
+        and bn is not None and k % 128 == 0
+    if not usable:
+        return jnp.dot(x, w, preferred_element_type=jnp.float32
+                       ).astype(out_dtype)
+    return pl.pallas_call(
+        _mm_kernel,
+        grid=(m // bm, n // bn),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((k, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        interpret=interpret,
+    )(x, w)
+
+
+def matmul_reducescatter(x: jax.Array, w: jax.Array, axis: str,
+                         fused: bool = True,
+                         interpret: bool = False) -> jax.Array:
+    """Fused ``psum_scatter(x @ w)`` over mesh axis ``axis`` — the
+    row-parallel boundary op.
+
+    ``x`` is ``(m, k)`` with ``m`` divisible by the axis size, ``w``
+    this rank's ``(k, n)`` contraction shard; returns the reduced
+    ``(m/world, n)`` row block this rank owns (identical semantics to
+    ``lax.psum_scatter(x @ w, axis, scatter_dimension=0, tiled=True)``,
+    row blocks rank-major).
+
+    Fused schedule: the output rows split into one tile per rank; each
+    ring step computes ONE tile's partial product (Pallas matmul on
+    TPU) while the accumulated partial for the previous tile crosses
+    the wire via ``ppermute`` — after ``world-1`` hops every rank holds
+    its fully-reduced tile without any boundary-wide collective.  The
+    partials accumulate in fp32 regardless of input dtype.
+    ``fused=False`` (or a size-1 axis) keeps the unfused formulation.
+    """
+    from jax import lax
+
+    if x.ndim != 2 or w.ndim != 2:
+        raise ValueError(
+            f"matmul_reducescatter takes 2-D operands, got {x.shape} @ "
+            f"{w.shape} (flatten leading dims first)")
+    world = int(lax.axis_size(axis))
+    m = x.shape[0]
+    if m % world:
+        raise ValueError(
+            f"matmul_reducescatter rows {m} not divisible by axis "
+            f"{axis!r} size {world}")
+    out_dtype = jnp.result_type(x.dtype, w.dtype)
+    if not fused or world == 1:
+        y = pallas_matmul(x, w, interpret=interpret)
+        if world == 1:
+            return y
+        return lax.psum_scatter(y, axis, scatter_dimension=0, tiled=True)
+    _count_fused_launch("matmul_reducescatter")
+    me = lax.axis_index(axis)
+    tiles = x.reshape(world, m // world, x.shape[1])
+    perm = [(i, (i + 1) % world) for i in range(world)]
+    # start at tile (me-1) so that after world-1 send-right hops each
+    # rank ends holding its OWN fully-reduced tile (ownership matches
+    # psum_scatter's rank-major row blocks)
+    idx0 = (me + world - 1) % world
+    acc = pallas_matmul(jnp.take(tiles, idx0, axis=0), w,
+                        out_dtype=jnp.float32, interpret=interpret)
+    for s in range(1, world):
+        # the ppermute and the tile matmul are data-independent: the
+        # scheduler overlaps tile k's wire hop with tile k+1's compute
+        acc = lax.ppermute(acc, axis, perm)
+        idx = (me + world - 1 - s) % world
+        acc = acc + pallas_matmul(jnp.take(tiles, idx, axis=0), w,
+                                  out_dtype=jnp.float32,
+                                  interpret=interpret)
+    return acc.astype(out_dtype)
+
+
+def allgather_matmul(x: jax.Array, w: jax.Array, axis: str,
+                     fused: bool = True,
+                     interpret: bool = False) -> jax.Array:
+    """Fused ``all_gather(x) @ w`` over mesh axis ``axis`` — the
+    column-parallel boundary op.
+
+    ``x`` is this rank's ``(m_local, k)`` row shard (rank-major),
+    ``w`` the ``(k, n)`` kernel (typically a column shard); returns the
+    full ``(world·m_local, n)`` product, identical to
+    ``jnp.dot(lax.all_gather(x, axis, tiled=True), w)``.
+
+    Fused schedule: each ring step multiplies the row shard currently
+    held (Pallas matmul on TPU) while the next shard arrives via
+    ``ppermute`` — the gather never materializes as a boundary-wide
+    all-gather and the wire hides under the MXU.
+    """
+    from jax import lax
+
+    if x.ndim != 2 or w.ndim != 2:
+        raise ValueError(
+            f"allgather_matmul takes 2-D operands, got {x.shape} @ "
+            f"{w.shape} (flatten leading dims first)")
+    world = int(lax.axis_size(axis))
+    if not fused or world == 1:
+        y = lax.all_gather(x, axis, tiled=True) if world > 1 else x
+        return pallas_matmul(y, w, interpret=interpret)
+    _count_fused_launch("allgather_matmul")
+    me = lax.axis_index(axis)
+    m_local = x.shape[0]
+    out_dtype = jnp.result_type(x.dtype, w.dtype)
+    out = jnp.zeros((world * m_local, w.shape[1]), out_dtype)
+    cur = x
+    # send left = receive from the right neighbor: after s hops this
+    # rank holds shard (me + s) % world
+    perm = [(i, (i - 1) % world) for i in range(world)]
+    for s in range(world):
+        src = (me + s) % world
+        part = pallas_matmul(cur, w, out_dtype=out_dtype,
+                             interpret=interpret)
+        out = lax.dynamic_update_slice(out, part, (src * m_local, 0))
+        if s < world - 1:
+            cur = lax.ppermute(cur, axis, perm)
+    return out

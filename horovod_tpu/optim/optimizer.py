@@ -262,7 +262,8 @@ def sharded_distributed_update(optimizer: optax.GradientTransformation,
                                quantized_bits: Optional[int] = None,
                                bucket_bytes: Optional[int] = None,
                                world: Optional[int] = None,
-                               hierarchy: str = "auto"
+                               hierarchy: str = "auto",
+                               fused_collectives: str = "auto"
                                ) -> optax.GradientTransformation:
     """ZeRO-style sharded rewrite of ``chain(distributed_gradients,
     optimizer)``: reduce-scatter the gradients, run ``optimizer`` on
@@ -300,6 +301,16 @@ def sharded_distributed_update(optimizer: optax.GradientTransformation,
       reverse-layer order for earlier overlap (arXiv:2305.06942's
       fused compute-collective argument).
 
+    ``fused_collectives`` (``"auto"|"on"|"off"``,
+    ``HOROVOD_FUSED_COLLECTIVES``) enables the tile-granular
+    final-bucket exchange: the LAST bucket — whose wire no remaining
+    backward work can hide — splits into independent sub-collectives
+    the scheduler overlaps with the shard-update math
+    (:func:`horovod_tpu.ops.collectives._tiled_psum_scatter`,
+    docs/fused_kernels.md).  Numerics are identical; ``"auto"``
+    resolves on only on TPU
+    (:func:`horovod_tpu.ops.pallas_kernels.resolve_fused_collectives`).
+
     ``params`` passed to ``update`` are sliced to matching shards, so
     parameter-coupled rules (weight decay) see co-located values.
     State caveat (shared with the delta-Adasum form): each rank's
@@ -319,6 +330,9 @@ def sharded_distributed_update(optimizer: optax.GradientTransformation,
         raise ValueError(
             "hierarchy='two_level' needs a 2-axis (dp_outer, dp_inner) "
             f"axis spec, got {axes_names}")
+    from horovod_tpu.ops.pallas_kernels import resolve_fused_collectives
+
+    fused_tail = resolve_fused_collectives(fused_collectives)
 
     def _spec(leaves):
         # ``world`` pins the shard sizing when init runs outside any
@@ -348,7 +362,8 @@ def sharded_distributed_update(optimizer: optax.GradientTransformation,
                 prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor,
                 quantized_bits=quantized_bits,
-                bucket_bytes=bucket_bytes)
+                bucket_bytes=bucket_bytes,
+                fused_tail=fused_tail)
             # shard ownership is row-major over (inner, outer) — the
             # param slices and the reassembly must use that linearization
             own_axes = C.exchange_index_axes(outer, inner_ax)
@@ -358,7 +373,8 @@ def sharded_distributed_update(optimizer: optax.GradientTransformation,
                 prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor,
                 quantized_bits=quantized_bits,
-                bucket_bytes=bucket_bytes)
+                bucket_bytes=bucket_bytes,
+                fused_tail=fused_tail)
             own_axes = axis
         p_shards = None
         if params is not None:
@@ -387,7 +403,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          gradient_predivide_factor: float = 1.0,
                          shard_optimizer_states: bool = False,
                          exchange_bucket_bytes: Optional[int] = None,
-                         hierarchy: str = "auto"
+                         hierarchy: str = "auto",
+                         fused_collectives: str = "auto"
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so each update uses cross-replica-reduced
     gradients (reference ``DistributedOptimizer`` factory,
@@ -424,6 +441,10 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         raise ValueError(
             "hierarchy selects the sharded exchange topology; pass "
             "shard_optimizer_states=True to enable it")
+    if fused_collectives != "auto" and not shard_optimizer_states:
+        raise ValueError(
+            "fused_collectives schedules the sharded exchange's final "
+            "bucket; pass shard_optimizer_states=True to enable it")
     if shard_optimizer_states:
         if mode != "shard_map":
             raise ValueError(
@@ -464,7 +485,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
             postscale_factor=postscale_factor,
             quantized_bits=qbits,
             bucket_bytes=exchange_bucket_bytes,
-            hierarchy=hierarchy)
+            hierarchy=hierarchy,
+            fused_collectives=fused_collectives)
         if backward_passes_per_step > 1:
             return optax.MultiSteps(
                 chained, every_k_schedule=backward_passes_per_step)

@@ -81,7 +81,8 @@ class DistributedTrainStep:
                  fsdp_min_weight_size: Optional[int] = None,
                  shard_optimizer_states: bool = False,
                  exchange_bucket_bytes: Optional[int] = None,
-                 hierarchy: str = "auto"):
+                 hierarchy: str = "auto",
+                 fused_collectives: str = "auto"):
         """``steps_per_call > 1`` scans that many optimizer steps inside
         the one compiled program (the Keras ``steps_per_execution``
         knob): one dispatch amortizes per-call host/launch overhead —
@@ -121,6 +122,16 @@ class DistributedTrainStep:
         (the synthetic-bench pattern) — donation invalidates the
         caller's arrays after the call.
 
+        ``fused_collectives`` (``"auto"|"on"|"off"``,
+        ``HOROVOD_FUSED_COLLECTIVES``) schedules the sharded
+        exchange's FINAL bucket tile-granularly — the one exchange no
+        remaining backward work can hide — as independent
+        sub-collectives the scheduler overlaps with the shard-update
+        math (docs/fused_kernels.md).  ``"auto"`` enables on TPU only;
+        numerics are identical either way, and the resolved mode is an
+        AOT-key field so a warm start never serves a fused executable
+        to an unfused config.
+
         ``hierarchy`` picks the sharded exchange's topology:
         ``"auto"`` (default) resolves against the data-axes
         factorization — the two-level ICI-then-DCN exchange whenever
@@ -158,6 +169,11 @@ class DistributedTrainStep:
             raise ValueError(
                 "hierarchy selects the sharded exchange topology; pass "
                 "shard_optimizer_states=True to enable it")
+        elif fused_collectives != "auto":
+            raise ValueError(
+                "fused_collectives schedules the sharded exchange's "
+                "final bucket; pass shard_optimizer_states=True to "
+                "enable it")
         if shard_optimizer_states and state.is_initialized():
             # env-contract defaults (HOROVOD_EXCHANGE_*): explicit
             # arguments rule; unset knobs fall back to runtime config
@@ -166,7 +182,20 @@ class DistributedTrainStep:
                 exchange_bucket_bytes = cfg.exchange_bucket_bytes
             if hierarchy == "auto" and cfg.exchange_hierarchy:
                 hierarchy = cfg.exchange_hierarchy
+            if fused_collectives == "auto" and \
+                    getattr(cfg, "fused_collectives", "auto") != "auto":
+                fused_collectives = cfg.fused_collectives
         self._hierarchy = hierarchy
+        # the mode the compiled exchange will actually run ("auto" made
+        # static against the platform) — an AOT-key field and the value
+        # bench.py emits as fused_collectives
+        from horovod_tpu.ops.pallas_kernels import (
+            resolve_fused_collectives,
+        )
+
+        self._fused_collectives = (
+            "on" if shard_optimizer_states and
+            resolve_fused_collectives(fused_collectives) else "off")
         self._shard_opt = shard_optimizer_states
         if fsdp_axis is not None and mode != "pjit":
             raise ValueError(
@@ -296,7 +325,8 @@ class DistributedTrainStep:
                     quantized_bits=qbits,
                     bucket_bytes=exchange_bucket_bytes,
                     world=world,
-                    hierarchy=hierarchy)
+                    hierarchy=hierarchy,
+                    fused_collectives=self._fused_collectives)
                 from horovod_tpu.runtime.topology import resolve_hierarchy
 
                 # the mode the compiled step will actually run (the
@@ -405,6 +435,13 @@ class DistributedTrainStep:
         return self._hierarchy
 
     @property
+    def fused_collectives(self) -> str:
+        """The resolved final-bucket schedule: ``"on"`` when the
+        sharded exchange runs the tile-granular fused tail, ``"off"``
+        otherwise (docs/fused_kernels.md)."""
+        return self._fused_collectives
+
+    @property
     def compile_cache_hit(self) -> Optional[bool]:
         """Whether this step's most recent XLA compile was served from
         the persistent AOT store (``True``), compiled fresh and
@@ -421,6 +458,7 @@ class DistributedTrainStep:
             "mesh_shape": tuple(sorted(self._mesh.shape.items())),
             "mode": self._mode,
             "hierarchy": self._hierarchy,
+            "fused_collectives": self._fused_collectives,
             "shard_optimizer_states": self._shard_opt,
             "data_axes": self._data_axes,
             "fsdp_axis": self._fsdp_axis,

@@ -204,3 +204,59 @@ def row_parallel_dense(x: jax.Array, kernel: jax.Array,
     ``(in_local, out)`` slice; output is replicated over ``axis``."""
     y = lax.psum(jnp.dot(x, kernel), axis)
     return y + bias if bias is not None else y
+
+
+# ---------------------------------------------------------------------------
+# tile-fused sequence-parallel boundary layers (docs/fused_kernels.md)
+# ---------------------------------------------------------------------------
+#
+# The classic column→row pairing above closes each block with one
+# boundary-wide psum — a serial collective no compute hides.  The
+# Megatron-SP restructuring replaces it with a reduce-scatter over
+# tokens at the row boundary and an all-gather over tokens at the next
+# column boundary, and the tile-fused kernels
+# (ops/pallas_kernels.matmul_reducescatter / allgather_matmul) overlap
+# each boundary's wire with the matmul itself — tile k's exchange rides
+# under tile k+1's MXU compute, so no full-width serial collective
+# remains at either boundary (the HLO guard pins ring permutes, zero
+# all-reduces).  Token layout contract: rows are RANK-MAJOR flattened
+# tokens — the gather concatenates rank chunks along dim 0 and the
+# scatter hands rank r rows [r·m/world, (r+1)·m/world); callers holding
+# (batch, seq, d) natural layout transpose chunks accordingly
+# (models/transformer.fused_tp_apply shows the idiom).
+
+def column_parallel_dense_ag(x: jax.Array, kernel: jax.Array,
+                             bias: Optional[jax.Array] = None,
+                             axis: str = AXIS_TP,
+                             fused: bool = True,
+                             interpret: bool = False) -> jax.Array:
+    """Column-parallel Dense over a token-sharded input: gathers the
+    ``(m_local, in)`` rank-major row shard across ``axis`` *inside* the
+    matmul (:func:`~horovod_tpu.ops.pallas_kernels.allgather_matmul`)
+    and applies this rank's ``(in, out_local)`` column shard; returns
+    the full-token ``(world·m_local, out_local)`` activation."""
+    from horovod_tpu.ops.pallas_kernels import allgather_matmul
+
+    y = allgather_matmul(x, kernel, axis, fused=fused,
+                         interpret=interpret)
+    return y + bias if bias is not None else y
+
+
+def row_parallel_dense_rs(x: jax.Array, kernel: jax.Array,
+                          bias: Optional[jax.Array] = None,
+                          axis: str = AXIS_TP,
+                          fused: bool = True,
+                          interpret: bool = False) -> jax.Array:
+    """Row-parallel Dense closed by a tile-fused reduce-scatter over
+    tokens: ``x`` is the full-token feature-sharded ``(m, in_local)``
+    activation (rows rank-major), ``kernel`` this rank's
+    ``(in_local, out)`` row slice; returns this rank's reduced
+    ``(m/world, out)`` token block
+    (:func:`~horovod_tpu.ops.pallas_kernels.matmul_reducescatter`).
+    The bias (full ``(out,)``) is added after the reduction, on the
+    owned token block only."""
+    from horovod_tpu.ops.pallas_kernels import matmul_reducescatter
+
+    y = matmul_reducescatter(x, kernel, axis, fused=fused,
+                             interpret=interpret)
+    return y + bias if bias is not None else y

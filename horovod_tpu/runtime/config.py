@@ -36,6 +36,7 @@ KNOWN_KNOBS = frozenset({
     "HOROVOD_CYCLE_TIME", "HOROVOD_CACHE_CAPACITY",
     "HOROVOD_HIERARCHICAL_ALLREDUCE", "HOROVOD_HIERARCHICAL_ALLGATHER",
     "HOROVOD_EXCHANGE_BUCKET_BYTES", "HOROVOD_EXCHANGE_HIERARCHY",
+    "HOROVOD_EXCHANGE_WIRE_DTYPE", "HOROVOD_FUSED_COLLECTIVES",
     "HOROVOD_ADASUM_NUM_CHUNKS", "HOROVOD_DEBUG_SPARSE",
     "HOROVOD_TPU_MESH_SHAPE",
     # -- warm-start compile cache
@@ -167,6 +168,15 @@ class Config:
     # train step; "auto" consults the mesh factorization at build time
     exchange_bucket_bytes: Optional[int] = None
     exchange_hierarchy: str = "auto"
+    # low-precision wire codec dtype for the quantized (DCN) exchange
+    # hop: "int8" (shared-scale s8, the PR 2 codec) or "fp8_e4m3"
+    # (e4m3 floating wire — coarser mantissa, no shared-scale clipping
+    # of outlier segments); docs/overlap.md
+    exchange_wire_dtype: str = "int8"
+    # tile-fused matmul⊗collective kernels (docs/fused_kernels.md):
+    # "auto" enables on TPU only, "on"/"off" force; a new autotune
+    # axis next to bucket bytes + hierarchy
+    fused_collectives: str = "auto"
 
     # -- autotune (reference parameter_manager.h:58-78)
     autotune: bool = False
@@ -226,6 +236,8 @@ class Config:
         mark("HOROVOD_HIERARCHICAL_ALLGATHER", "hierarchical_allgather")
         mark("HOROVOD_EXCHANGE_BUCKET_BYTES", "exchange_bucket_bytes")
         mark("HOROVOD_EXCHANGE_HIERARCHY", "exchange_hierarchy")
+        mark("HOROVOD_EXCHANGE_WIRE_DTYPE", "exchange_wire_dtype")
+        mark("HOROVOD_FUSED_COLLECTIVES", "fused_collectives")
 
         def opt_int(name: str) -> Optional[int]:
             v = os.environ.get(name)
@@ -267,6 +279,10 @@ class Config:
             exchange_bucket_bytes=opt_int("HOROVOD_EXCHANGE_BUCKET_BYTES"),
             exchange_hierarchy=_env_str(
                 "HOROVOD_EXCHANGE_HIERARCHY", "auto").lower(),
+            exchange_wire_dtype=_env_str(
+                "HOROVOD_EXCHANGE_WIRE_DTYPE", "int8").lower(),
+            fused_collectives=_env_str(
+                "HOROVOD_FUSED_COLLECTIVES", "auto").lower(),
             autotune=_env_bool("HOROVOD_AUTOTUNE", False),
             autotune_log=os.environ.get("HOROVOD_AUTOTUNE_LOG"),
             autotune_warmup_samples=_env_int("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3),

@@ -38,21 +38,60 @@ class ThroughputAutotuner:
     returns units/sec; each unique point is measured once (memoized).
     ``seed`` picks the starting point (default: middle of each axis —
     a deliberately un-tuned cold start).
+
+    ``predict`` (optional) is a static scorer — higher is better, the
+    cost-model contract of
+    :func:`horovod_tpu.analysis.cost_model.score_exchange_schedule` —
+    used to PRUNE each axis scan to the ``prune_to`` most promising
+    candidates (the current value always stays) before paying a
+    compile+measure per point.  A predictor that returns ``None`` for
+    any candidate, scores every candidate identically, or raises,
+    leaves that axis fully measured — the measurement, never the
+    model, picks the winner.
     """
 
     def __init__(self, measure: Callable[[Dict], float],
                  axes: Dict[str, List],
                  seed: Optional[Dict] = None,
                  log_path: Optional[str] = None,
-                 max_rounds: int = 3):
+                 max_rounds: int = 3,
+                 predict: Optional[Callable[[Dict], Optional[float]]]
+                 = None,
+                 prune_to: int = 2):
         self._measure = measure
         self._axes = {k: list(v) for k, v in axes.items()}
         self._seed = dict(seed) if seed else \
             {k: v[len(v) // 2] for k, v in self._axes.items()}
         self._log_path = log_path
         self._max_rounds = max_rounds
+        self._predict = predict
+        self._prune_to = max(1, int(prune_to))
         self._cache: Dict[Tuple, float] = {}
         self._rows: List[dict] = []
+
+    def _candidates(self, current: Dict, knob: str,
+                    values: List) -> List:
+        """The axis candidates to actually measure: cost-model-pruned
+        to the top ``prune_to`` (+ the current value) when the
+        predictor can rank them, the full axis otherwise."""
+        if self._predict is None or len(values) <= self._prune_to:
+            return values
+        try:
+            preds = [self._predict(dict(current, **{knob: v}))
+                     for v in values]
+        except Exception:   # noqa: BLE001 — broken predictor = no prune
+            return values
+        if any(p is None for p in preds) or len(set(preds)) <= 1:
+            return values
+        ranked = [v for _, v in sorted(zip(preds, range(len(values))),
+                                       key=lambda t: -t[0])]
+        keep = [values[i] for i in ranked[: self._prune_to]]
+        if current[knob] not in keep:
+            keep.append(current[knob])
+        hvd_logging.info(
+            "autotune: cost model pruned %s axis %s -> %s", knob,
+            values, keep)
+        return keep
 
     def _key(self, point: Dict) -> Tuple:
         return tuple(point[k] for k in self._axes)
@@ -78,7 +117,8 @@ class ThroughputAutotuner:
             moved = False
             for knob, values in self._axes.items():
                 scored = [(self._score(dict(current, **{knob: v})), v)
-                          for v in values]
+                          for v in self._candidates(current, knob,
+                                                    values)]
                 best_rate, best_v = max(scored)
                 if best_v != current[knob]:
                     current[knob] = best_v

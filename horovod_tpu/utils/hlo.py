@@ -360,3 +360,47 @@ def scopes_by_kind(ops: List[CollectiveOp]) -> dict:
         out.setdefault(o.kind, set()).add(o.group_size)
     return {k: tuple(sorted(v, key=lambda s: (s is None, s)))
             for k, v in out.items()}
+
+
+# opcodes that count as "compute scheduled between start and done" for
+# the serial-tail scan: matmul-class ops, fused elementwise bodies and
+# loops all give the async collective something to hide under
+_COMPUTE_OPS = ("dot", "convolution", "fusion", "while")
+
+
+def serial_tail_collectives(hlo_text: str,
+                            kinds=("reduce-scatter",
+                                   "all-gather")) -> int:
+    """1 if the module's FINAL async RS/AG pair is a *serial tail* —
+    no compute op scheduled between its ``-start`` and ``-done`` — else
+    0.  This is the exposure the tile-fused exchange exists to remove
+    (HLO005, docs/fused_kernels.md): the last bucket's collective with
+    nothing left to hide under.  Synchronous backends (no -start/-done
+    pairs, e.g. this image's CPU XLA) return 0 — a sync schedule has no
+    window to judge."""
+    lines = entry_computation(hlo_text).splitlines()
+    last = None
+    for i, ln in enumerate(lines):
+        m = _ANY_OP_RE.match(ln)
+        if m is None:
+            continue
+        opcode = m.group(3)
+        for k in kinds:
+            if opcode == f"{k}-start":
+                last = (i, k)
+    if last is None:
+        return 0
+    i, kind = last
+    done = None
+    for j in range(i + 1, len(lines)):
+        m = _ANY_OP_RE.match(lines[j])
+        if m is not None and m.group(3) == f"{kind}-done":
+            done = j
+            break
+    if done is None:
+        return 0
+    for ln in lines[i + 1:done]:
+        m = _ANY_OP_RE.match(ln)
+        if m is not None and m.group(3) in _COMPUTE_OPS:
+            return 0
+    return 1

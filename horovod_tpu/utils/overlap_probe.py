@@ -69,6 +69,18 @@ class OverlapReport:
     world: int
     payload_bytes: int
     hierarchy: str = "flat"
+    # exchange time left exposed past backward — the serial tail the
+    # tile-fused final-bucket exchange attacks (docs/fused_kernels.md):
+    # max(0, fused_s - backward_s); 0 = the wire hid completely
+    tail_exchange_s: float = 0.0
+    # the final-bucket schedule this probe ran: "on" = tile-granular
+    # fused tail, "off" = monolithic last collective
+    fused_collectives: str = "off"
+    # HLO scan of the exchange program: 1 if its final async RS/AG pair
+    # has no compute scheduled between start and done (the serial tail
+    # HLO005 flags); 0 when overlapped or when the backend issues
+    # synchronously (no async pairs to judge)
+    serial_tail_collectives: Optional[int] = None
     # two-level only: the intra-slice (ICI) share of the exchange time
     # and the cross-slice (DCN) remainder — measured, not modeled
     exchange_intra_s: Optional[float] = None
@@ -91,8 +103,13 @@ class OverlapReport:
             f"{prefix}overlap_backward_s": round(self.backward_s, 6),
             f"{prefix}overlap_exchange_s": round(self.exchange_s, 6),
             f"{prefix}overlap_fused_s": round(self.fused_s, 6),
+            f"{prefix}tail_exchange_s": round(self.tail_exchange_s, 6),
             f"{prefix}exchange_hierarchy": self.hierarchy,
+            f"{prefix}fused_collectives": self.fused_collectives,
         }
+        if self.serial_tail_collectives is not None:
+            fields[f"{prefix}exchange_serial_tail_collectives"] = \
+                int(self.serial_tail_collectives)
         if self.exchange_intra_s is not None:
             fields[f"{prefix}overlap_exchange_intra_s"] = \
                 round(self.exchange_intra_s, 6)
@@ -135,6 +152,7 @@ def measure_overlap(loss_fn: Callable,
                     op: ReduceOp = Average,
                     bucket_bytes: Optional[int] = None,
                     hierarchy: str = "auto",
+                    fused_collectives: str = "off",
                     iters: int = 5,
                     warmup: int = 2) -> OverlapReport:
     """Measure backward/exchange/fused timings for ``loss_fn`` over the
@@ -154,7 +172,15 @@ def measure_overlap(loss_fn: Callable,
     (distinct reduce-scatter/all-gather scopes, count of gradient-sized
     all-reduces), parsed from its optimized HLO.  The structure fields
     are what the HLO guard tests pin; the bench JSON carries them so a
-    silent topology regression is visible in the run artifact too."""
+    silent topology regression is visible in the run artifact too.
+
+    ``fused_collectives`` selects the final-bucket schedule the probed
+    exchange runs (``"on"`` = the tile-granular fused tail,
+    docs/fused_kernels.md); the report's ``tail_exchange_s`` — exchange
+    time left exposed past backward — is the quantity the fused path
+    exists to shrink, and ``bench.py`` emits both paths' numbers."""
+    from horovod_tpu.ops.pallas_kernels import resolve_fused_collectives
+
     mesh = mesh or state.global_state().mesh
     axes = (axis,) if isinstance(axis, str) else tuple(axis)
     world = 1
@@ -162,6 +188,7 @@ def measure_overlap(loss_fn: Callable,
         world *= mesh.shape[a]
     mode = resolve_hierarchy(hierarchy,
                              [mesh.shape[a] for a in axes])
+    fused_tail = resolve_fused_collectives(fused_collectives)
 
     shard_map = jax.shard_map
     in_p = (P(), P(axes))
@@ -181,13 +208,14 @@ def measure_overlap(loss_fn: Callable,
             outer, inner = axes
             shards, spec = C.hierarchical_reducescatter(
                 leaves, op=op, outer_axis=outer, inner_axis=inner,
-                bucket_bytes=bucket_bytes)
+                bucket_bytes=bucket_bytes, fused_tail=fused_tail)
             out = C.hierarchical_allgather(shards, spec,
                                            outer_axis=outer,
                                            inner_axis=inner)
         else:
             shards, spec = C.grouped_reducescatter(
-                leaves, op=op, axis=axes, bucket_bytes=bucket_bytes)
+                leaves, op=op, axis=axes, bucket_bytes=bucket_bytes,
+                fused_tail=fused_tail)
             out = C.grouped_allgather(shards, spec, axis=axes)
         return jax.tree_util.tree_unflatten(treedef, out)
 
@@ -232,8 +260,18 @@ def measure_overlap(loss_fn: Callable,
     ag_scopes: tuple = ()
     grad_ars = 0
     wire_ici = wire_dcn = None
+    serial_tail = None
     payload = sum(x.size * x.dtype.itemsize
                   for x in jax.tree_util.tree_leaves(grads))
+    try:
+        # the serial-tail scan runs on the FUSED program — that is
+        # where backward compute exists to hide the exchange under; an
+        # exchange-only module has nothing between start and done by
+        # construction
+        serial_tail = H.serial_tail_collectives(
+            fsd.lower(params, batch).compile().as_text())
+    except Exception:      # noqa: BLE001 — structure report is advisory
+        pass
     try:
         ops = H.collective_ops(
             exc.lower(grads).compile().as_text())
@@ -270,6 +308,9 @@ def measure_overlap(loss_fn: Callable,
     saved = t_bwd + t_exc - t_fsd
     denom = min(t_bwd, t_exc)
     frac = saved / denom if denom > 0 else 0.0
+    # the serial tail in time units: whatever the fused program costs
+    # beyond backward alone is exchange the schedule failed to hide
+    tail_s = max(0.0, t_fsd - t_bwd)
     # registry mirror of the probe's headline numbers (docs/metrics.md):
     # measured per-level exchange time and wire bytes, next to the
     # static model the train step publishes
@@ -285,6 +326,10 @@ def measure_overlap(loss_fn: Callable,
         telemetry.gauge("hvd_overlap_fraction",
                         "measured comm/compute overlap fraction").set(
                             float(np.clip(frac, 0.0, 1.0)))
+        telemetry.gauge(
+            "hvd_tail_exchange_seconds",
+            "exchange time left exposed past backward compute").set(
+                tail_s, fused="on" if fused_tail else "off")
         if wire_ici is not None:
             wg = telemetry.gauge(
                 "hvd_exchange_measured_wire_bytes",
@@ -296,6 +341,9 @@ def measure_overlap(loss_fn: Callable,
         overlap_fraction=float(np.clip(frac, 0.0, 1.0)),
         world=world, payload_bytes=int(payload),
         hierarchy=mode,
+        tail_exchange_s=tail_s,
+        fused_collectives="on" if fused_tail else "off",
+        serial_tail_collectives=serial_tail,
         exchange_intra_s=t_intra, exchange_cross_s=t_cross,
         rs_scopes=rs_scopes, ag_scopes=ag_scopes,
         grad_sized_allreduces=grad_ars,

@@ -820,3 +820,81 @@ class TestHloLint:
         fields = rep.as_bench_fields(prefix="transformer_")
         assert fields["transformer_exchange_grad_sized_allreduces"] == 0
         assert hlo_lint.lint_artifact(fields) == []
+
+
+class TestSerialTailRule:
+    """HLO005 (ISSUE 9): a serial exchange tail — the final RS/AG
+    start..done pair with no compute scheduled between — must be
+    flagged in HLO dumps, and an artifact claiming fused_collectives=on
+    must not still report one."""
+
+    SERIAL = "\n".join([
+        "ENTRY %main () -> f32[13] {",
+        "  %p = f32[104]{0} parameter(0)",
+        "  %rs = (f32[104]{0}, f32[13]{0}) reduce-scatter-start(%p), "
+        "replica_groups=[1,8]<=[8], dimensions={0}, to_apply=%add",
+        "  %rsd = f32[13]{0} reduce-scatter-done(%rs)",
+        "  ROOT %r = f32[13]{0} copy(%rsd)",
+        "}",
+    ])
+
+    def test_serial_tail_fires(self):
+        findings = hlo_lint.lint_hlo_text(self.SERIAL)
+        assert any(f.rule == "HLO005" for f in findings), findings
+
+    def test_overlapped_tail_clean(self):
+        overlapped = self.SERIAL.replace(
+            "  %rsd = ",
+            "  %d = f32[16,16]{1,0} dot(%a, %b), "
+            "lhs_contracting_dims={1}, rhs_contracting_dims={0}\n"
+            "  %rsd = ")
+        assert [f for f in hlo_lint.lint_hlo_text(overlapped)
+                if f.rule == "HLO005"] == []
+
+    def test_synchronous_module_not_judged(self):
+        sync = ("  %rs = f32[13]{0} reduce-scatter(%p), "
+                "replica_groups=[1,8]<=[8], dimensions={0}, "
+                "to_apply=%add")
+        assert [f for f in hlo_lint.lint_hlo_text(sync)
+                if f.rule == "HLO005"] == []
+
+    def test_non_final_serial_pair_not_flagged(self):
+        """Only the FINAL pair is the tail; an early serial pair has
+        later compute to hide under and stays HLO005-clean."""
+        from horovod_tpu.utils import hlo as H
+
+        early = self.SERIAL.replace(
+            "  ROOT %r = f32[13]{0} copy(%rsd)",
+            "  %ag = (f32[13]{0}, f32[104]{0}) all-gather-start(%rsd), "
+            "replica_groups=[1,8]<=[8], dimensions={0}\n"
+            "  %d = f32[16,16]{1,0} dot(%a, %b), "
+            "lhs_contracting_dims={1}, rhs_contracting_dims={0}\n"
+            "  %agd = f32[104]{0} all-gather-done(%ag)\n"
+            "  ROOT %r = f32[104]{0} copy(%agd)")
+        assert H.serial_tail_collectives(early) == 0
+
+    def test_artifact_fused_on_with_serial_tail_fires(self):
+        art = {"overlap_fraction": 0.5,
+               "fused_collectives": "on",
+               "exchange_serial_tail_collectives": 1}
+        assert any(f.rule == "HLO005"
+                   for f in hlo_lint.lint_artifact(art))
+
+    def test_artifact_fused_off_serial_tail_expected(self):
+        art = {"overlap_fraction": 0.5,
+               "fused_collectives": "off",
+               "exchange_serial_tail_collectives": 1}
+        assert [f for f in hlo_lint.lint_artifact(art)
+                if f.rule == "HLO005"] == []
+
+    def test_legacy_artifact_without_fields_passes(self):
+        assert [f for f in hlo_lint.lint_artifact(
+            {"overlap_fraction": 0.5})
+            if f.rule == "HLO005"] == []
+
+    def test_prefixed_artifact_fields(self):
+        art = {"fused_overlap_fraction": 0.5,
+               "fused_fused_collectives": "on",
+               "fused_exchange_serial_tail_collectives": 2}
+        assert any(f.rule == "HLO005"
+                   for f in hlo_lint.lint_artifact(art))

@@ -243,3 +243,75 @@ class TestThroughputAutotuner:
         best, rate = tuner.run()
         assert best == {"steps_per_call": 5} and rate == 3.0
         assert len(calls) == 3
+
+
+class TestThroughputAutotunerPrune:
+    """Cost-model pruning of the offline autotuner's axis scans
+    (ISSUE 9): the predictor narrows rankable axes, never the ones it
+    cannot price, and a broken predictor falls back to full measure."""
+
+    def _tuner(self, predict, axes=None, measured=None):
+        from horovod_tpu.utils.bench_autotune import ThroughputAutotuner
+
+        measured = measured if measured is not None else []
+
+        def measure(point):
+            measured.append(dict(point))
+            # ground truth: "c" is the best value on the fused axis
+            return {"a": 1.0, "b": 2.0, "c": 3.0}[point["knob"]]
+
+        return ThroughputAutotuner(
+            measure, axes or {"knob": ["a", "b", "c"]},
+            predict=predict, prune_to=2, max_rounds=1), measured
+
+    def test_predictor_prunes_axis(self):
+        def predict(point):
+            return {"a": 0.0, "b": 5.0, "c": 9.0}[point["knob"]]
+
+        tuner, measured = self._tuner(predict)
+        best, rate = tuner.run()
+        assert best == {"knob": "c"} and rate == 3.0
+        # "a" (worst predicted) was pruned; "b" (the seed) and "c"
+        # were measured
+        knobs = {m["knob"] for m in measured}
+        assert "a" not in knobs and {"b", "c"} <= knobs
+
+    def test_none_prediction_measures_everything(self):
+        tuner, measured = self._tuner(lambda point: None)
+        best, _ = tuner.run()
+        assert best == {"knob": "c"}
+        assert {m["knob"] for m in measured} == {"a", "b", "c"}
+
+    def test_constant_prediction_measures_everything(self):
+        tuner, measured = self._tuner(lambda point: 1.0)
+        tuner.run()
+        assert {m["knob"] for m in measured} == {"a", "b", "c"}
+
+    def test_broken_predictor_measures_everything(self):
+        def predict(point):
+            raise RuntimeError("boom")
+
+        tuner, measured = self._tuner(predict)
+        best, _ = tuner.run()
+        assert best == {"knob": "c"}
+        assert {m["knob"] for m in measured} == {"a", "b", "c"}
+
+    def test_current_value_always_kept(self):
+        """Pruning must never drop the incumbent: seed 'a' stays in the
+        scan even when predicted worst."""
+        def predict(point):
+            return {"a": 0.0, "b": 5.0, "c": 9.0}[point["knob"]]
+
+        from horovod_tpu.utils.bench_autotune import ThroughputAutotuner
+
+        measured = []
+
+        def measure(point):
+            measured.append(dict(point))
+            return {"a": 10.0, "b": 2.0, "c": 3.0}[point["knob"]]
+
+        tuner = ThroughputAutotuner(
+            measure, {"knob": ["a", "b", "c"]}, seed={"knob": "a"},
+            predict=predict, prune_to=2, max_rounds=1)
+        best, rate = tuner.run()
+        assert best == {"knob": "a"} and rate == 10.0

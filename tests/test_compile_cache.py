@@ -218,3 +218,42 @@ class TestTrainStepIntegration:
             assert step.compile_cache_hit is None
         finally:
             hvd.shutdown()
+
+
+class TestFusedCollectivesKey:
+    """ISSUE 9 satellite: the fused-collectives knob is an AOT-key
+    field — a warm start must never serve a fused executable to an
+    unfused config (or vice versa)."""
+
+    def test_key_differs_on_fused_field(self):
+        base = compile_cache.executable_key(
+            "module @m {}", {"fused_collectives": "off"})
+        assert compile_cache.executable_key(
+            "module @m {}", {"fused_collectives": "on"}) != base
+
+    def test_step_extras_carry_resolved_mode(self, cache_dir):
+        import optax
+
+        def loss_fn(params, batch):
+            return jnp.sum((batch @ params) ** 2)
+
+        def build(fused):
+            return hvd.DistributedTrainStep(
+                loss_fn, optax.sgd(0.1), mode="shard_map",
+                shard_optimizer_states=True, hierarchy="flat",
+                fused_collectives=fused)
+
+        on, off = build("on"), build("off")
+        assert on._aot_extras()["fused_collectives"] == "on"
+        assert off._aot_extras()["fused_collectives"] == "off"
+        # "auto" resolves off on this CPU twin and keys like "off"
+        auto = build("auto")
+        assert auto._aot_extras()["fused_collectives"] == "off"
+        k_on = compile_cache.executable_key("module @m {}",
+                                            on._aot_extras())
+        k_off = compile_cache.executable_key("module @m {}",
+                                             off._aot_extras())
+        k_auto = compile_cache.executable_key("module @m {}",
+                                              auto._aot_extras())
+        assert k_on != k_off
+        assert k_auto == k_off

@@ -258,3 +258,64 @@ class TestFusionPredictor:
         predict = CM.make_fusion_predictor(
             payload_bytes=64 << 20, n_leaves=200, world=8)
         assert predict((64 << 20, 1.0)) > predict((64 << 20, 20.0))
+
+
+class TestFusedExchangeCeiling:
+    """Overlap-aware roofline for the tile-fused exchange (ISSUE 9):
+    the model the autotuner prunes the fused_collectives axis with."""
+
+    def test_unfused_exposes_full_wire(self):
+        assert CM.fused_tail_exchange_s(0.010, 0.5,
+                                                n_tiles=1) == 0.010
+
+    def test_compute_bound_leaves_first_tile_exposed(self):
+        # plenty of compute: only the first tile's share stays exposed
+        got = CM.fused_tail_exchange_s(0.008, 1.0, n_tiles=4)
+        assert abs(got - 0.002) < 1e-12
+
+    def test_wire_bound_exposes_excess(self):
+        # wire exceeds compute: excess + first-tile share exposed
+        got = CM.fused_tail_exchange_s(0.010, 0.004, n_tiles=4)
+        assert abs(got - (0.010 / 4 + 0.006)) < 1e-12
+
+    def test_monotone_in_tiles(self):
+        vals = [CM.fused_tail_exchange_s(0.01, 1.0, n_tiles=t)
+                for t in (1, 2, 4, 8)]
+        assert vals == sorted(vals, reverse=True)
+        assert all(v >= 0 for v in vals)
+
+    def test_zero_wire(self):
+        assert CM.fused_tail_exchange_s(0.0, 1.0) == 0.0
+
+
+class TestScoreExchangeSchedule:
+    def test_none_without_exchange_knobs(self):
+        assert CM.score_exchange_schedule(
+            {"steps_per_call": 10}, 1e8) is None
+
+    def test_fused_scores_at_least_unfused(self):
+        on = CM.score_exchange_schedule(
+            {"hierarchy": "flat", "fused_collectives": "on"},
+            1e9, n_dcn=2, n_ici=4, compute_s=1.0)
+        off = CM.score_exchange_schedule(
+            {"hierarchy": "flat", "fused_collectives": "off"},
+            1e9, n_dcn=2, n_ici=4, compute_s=1.0)
+        assert on > off            # less exposed wire = higher score
+
+    def test_two_level_beats_flat_on_factored_mesh(self):
+        two = CM.score_exchange_schedule(
+            {"hierarchy": "two_level", "fused_collectives": "off"},
+            1e9, n_dcn=2, n_ici=4)
+        flat = CM.score_exchange_schedule(
+            {"hierarchy": "flat", "fused_collectives": "off"},
+            1e9, n_dcn=2, n_ici=4)
+        assert two > flat          # 1/n_ici int8 DCN hop wins
+
+    def test_non_exchange_axis_scores_constant(self):
+        a = CM.score_exchange_schedule(
+            {"hierarchy": "flat", "fused_collectives": "off",
+             "steps_per_call": 1}, 1e8, n_dcn=2, n_ici=4)
+        b = CM.score_exchange_schedule(
+            {"hierarchy": "flat", "fused_collectives": "off",
+             "steps_per_call": 40}, 1e8, n_dcn=2, n_ici=4)
+        assert a == b

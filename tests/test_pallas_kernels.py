@@ -286,3 +286,260 @@ class TestFusedConvBnReluBwd:
             "y": jnp.asarray(rng.randint(0, 10, (16,)), jnp.int32)})
         params, opt, loss = step(params, opt, batch)
         assert np.isfinite(float(loss))
+
+
+class TestNonTileShapeParity:
+    """Interpreter-mode parity of the EXISTING kernels at
+    non-tile-multiple shapes (odd trailing dims, seq lengths off the
+    block grid) vs their jnp fallbacks — the shapes the happy-path
+    tests above never touch (ISSUE 9 satellite)."""
+
+    @pytest.mark.parametrize("shape", [(1000,), (3, 77), (5, 130),
+                                       (7, 13, 11), (1,)])
+    def test_fused_scale_odd_shapes(self, shape):
+        x = jax.random.normal(jax.random.PRNGKey(0), shape, jnp.float32)
+        out = fused_scale(x, 1.7, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x) * 1.7,
+                                   rtol=1e-6)
+
+    @pytest.mark.parametrize("shape", [(130,), (3, 77)])
+    def test_fused_scale_odd_shapes_with_cast(self, shape):
+        x = jax.random.normal(jax.random.PRNGKey(1), shape, jnp.float32)
+        out = fused_scale(x, 0.3, out_dtype=jnp.bfloat16, interpret=True)
+        assert out.dtype == jnp.bfloat16 and out.shape == shape
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(x) * 0.3, rtol=1e-2,
+                                   atol=1e-2)
+
+    @pytest.mark.parametrize("t", [
+        24,    # < one tile, multiple of 8: single whole-seq block
+        48,    # not a multiple of the requested 32 block, still 8k
+        136,   # > 128 but no 128-multiple divisor: dense fallback
+        30,    # ragged (not even 8k): dense fallback
+    ])
+    def test_flash_attention_off_grid_seq_parity(self, t):
+        ks = jax.random.split(jax.random.PRNGKey(5), 3)
+        shape = (2, t, 2, 16)
+        q, k, v = (jax.random.normal(kk, shape, jnp.float32) for kk in ks)
+        out = flash_attention(q, k, v, causal=True, block_q=32,
+                              block_k=32, interpret=True)
+        expected = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_flash_attention_off_grid_seq_grads(self):
+        """The custom-vjp boundary must stay differentiable on fallback
+        and shrunken-block shapes alike."""
+        ks = jax.random.split(jax.random.PRNGKey(6), 3)
+        shape = (1, 24, 2, 8)
+        q, k, v = (jax.random.normal(kk, shape, jnp.float32) for kk in ks)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True,
+                                           block_q=32, block_k=32,
+                                           interpret=True) ** 2)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_flash_attention_bf16_off_grid(self):
+        ks = jax.random.split(jax.random.PRNGKey(7), 3)
+        shape = (1, 48, 2, 16)
+        q, k, v = (jax.random.normal(kk, shape, jnp.float32)
+                   .astype(jnp.bfloat16) for kk in ks)
+        out = flash_attention(q, k, v, causal=True, block_q=32,
+                              block_k=32, interpret=True)
+        expected = reference_attention(q.astype(jnp.float32),
+                                       k.astype(jnp.float32),
+                                       v.astype(jnp.float32), causal=True)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(expected), rtol=0.05,
+                                   atol=0.05)
+
+
+class TestPallasMatmul:
+    """Blocked Pallas matmul — the per-tile compute of the fused
+    collective ops."""
+
+    def test_tile_contract_shapes(self):
+        from horovod_tpu.ops.pallas_kernels import pallas_matmul
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(16, 128), jnp.float32)
+        w = jnp.asarray(rng.randn(128, 256), jnp.float32)
+        out = pallas_matmul(x, w, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_off_contract_falls_back(self):
+        from horovod_tpu.ops.pallas_kernels import pallas_matmul
+
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(7, 33), jnp.float32)   # nothing tiles
+        w = jnp.asarray(rng.randn(33, 19), jnp.float32)
+        out = pallas_matmul(x, w, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_bf16_accumulates_fp32(self):
+        from horovod_tpu.ops.pallas_kernels import pallas_matmul
+
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(8, 128), jnp.bfloat16)
+        w = jnp.asarray(rng.randn(128, 128), jnp.bfloat16)
+        out = pallas_matmul(x, w, interpret=True)
+        assert out.dtype == jnp.bfloat16
+        ref = np.asarray(x, np.float32) @ np.asarray(w, np.float32)
+        np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                                   rtol=0.05, atol=0.05)
+
+
+class TestFusedMatmulCollectives:
+    """Tile-fused matmul⊗collective ring kernels vs the unfused
+    formulation they replace — numerics pinned per the
+    graceful-degradation contract (ISSUE 9 tentpole)."""
+
+    W = 8
+
+    def _mesh(self):
+        from jax.sharding import Mesh
+
+        devs = np.asarray(jax.devices("cpu")[:self.W])
+        return Mesh(devs.reshape(self.W), ("tp",))
+
+    def _run(self, fn, *args, out_specs=None):
+        from jax.sharding import PartitionSpec as P
+
+        sm = jax.jit(jax.shard_map(
+            fn, mesh=self._mesh(), in_specs=(P(),) * len(args),
+            out_specs=out_specs if out_specs is not None else P(),
+            check_vma=False))
+        return sm(*args)
+
+    def test_matmul_reducescatter_matches_unfused(self):
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.ops.pallas_kernels import matmul_reducescatter
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(64, 16), jnp.float32)
+        w = jnp.asarray(rng.randn(16, 8), jnp.float32)
+
+        def f(x, w):
+            fused = matmul_reducescatter(x, w, "tp", fused=True)
+            ref = matmul_reducescatter(x, w, "tp", fused=False)
+            return fused, ref
+
+        fused, ref = self._run(f, x, w, out_specs=(P("tp"), P("tp")))
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        # closed form: replicated inputs psum W identical contributions
+        np.testing.assert_allclose(np.asarray(ref).reshape(64, 8),
+                                   self.W * np.asarray(x @ w),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_allgather_matmul_matches_unfused(self):
+        from horovod_tpu.ops.pallas_kernels import allgather_matmul
+
+        rng = np.random.RandomState(1)
+        shards = jnp.asarray(rng.randn(self.W, 4, 16), jnp.float32)
+        w = jnp.asarray(rng.randn(16, 8), jnp.float32)
+
+        def f(shards, w):
+            from jax import lax
+
+            mine = jnp.take(shards, lax.axis_index("tp"), axis=0)
+            fused = allgather_matmul(mine, w, "tp", fused=True)
+            ref = allgather_matmul(mine, w, "tp", fused=False)
+            return fused, ref
+
+        from jax.sharding import PartitionSpec as P
+
+        fused, ref = self._run(f, shards, w, out_specs=(P(), P()))
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-6)
+        expect = np.asarray(shards).reshape(self.W * 4, 16) @ \
+            np.asarray(w)
+        np.testing.assert_allclose(np.asarray(ref), expect, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_bf16_ring_accumulates_fp32(self):
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.ops.pallas_kernels import matmul_reducescatter
+
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(32, 16), jnp.bfloat16)
+        w = jnp.asarray(rng.randn(16, 8), jnp.bfloat16)
+
+        def f(x, w):
+            return matmul_reducescatter(x, w, "tp", fused=True)
+
+        out = self._run(f, x, w, out_specs=P("tp"))
+        assert out.dtype == jnp.bfloat16
+        ref = self.W * (np.asarray(x, np.float32) @
+                        np.asarray(w, np.float32))
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32).reshape(32, 8), ref,
+            rtol=0.1, atol=0.5)
+
+    def test_shape_validation(self):
+        from horovod_tpu.ops.pallas_kernels import (
+            allgather_matmul,
+            matmul_reducescatter,
+        )
+
+        def bad_rows(x, w):
+            return matmul_reducescatter(x, w, "tp")
+
+        def bad_rank(x, w):
+            return allgather_matmul(x[None], w, "tp")
+
+        x = jnp.zeros((30, 16))     # 30 % 8 != 0
+        w = jnp.zeros((16, 8))
+        with pytest.raises(ValueError, match="divisible"):
+            self._run(bad_rows, x, w)
+        with pytest.raises(ValueError, match="2-D"):
+            self._run(bad_rank, jnp.zeros((8, 16)), w)
+
+    def test_resolve_modes(self):
+        from horovod_tpu.ops.pallas_kernels import (
+            resolve_fused_collectives,
+        )
+
+        assert resolve_fused_collectives("on") is True
+        assert resolve_fused_collectives("off") is False
+        # auto = TPU only; this suite runs the CPU twin
+        assert resolve_fused_collectives("auto") is False
+        with pytest.raises(ValueError, match="fused_collectives"):
+            resolve_fused_collectives("maybe")
+
+    def test_fused_launch_counter(self):
+        from horovod_tpu import telemetry
+        from horovod_tpu.ops.pallas_kernels import matmul_reducescatter
+
+        telemetry.enable()
+        try:
+            before = telemetry.value(
+                "hvd_pallas_fused_launches_total",
+                kernel="matmul_reducescatter")
+
+            def f(x, w):
+                return matmul_reducescatter(x, w, "tp", fused=True)
+
+            from jax.sharding import PartitionSpec as P
+
+            self._run(f, jnp.zeros((16, 8)), jnp.zeros((8, 4)),
+                      out_specs=P("tp"))
+            after = telemetry.value(
+                "hvd_pallas_fused_launches_total",
+                kernel="matmul_reducescatter")
+            assert after > before
+        finally:
+            telemetry.disable()

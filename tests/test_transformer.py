@@ -134,3 +134,141 @@ class TestTpuEfficiencyHints:
                 if m:
                     n = int(m.group(1))
                     assert d % n == 0 and d // n >= 128, (d, n)
+
+
+class TestFusedTpApply:
+    """Tile-fused sequence-parallel execution (ISSUE 9): fused_tp_apply
+    under shard_map over tp must reproduce the GSPMD apply's logits —
+    the numerics pin of the matmul⊗collective kernels in their
+    transformer wiring."""
+
+    def _cfg(self, **kw):
+        return small_cfg(num_heads=8, d_model=64, d_ff=128,
+                         fused_collectives="on", **kw)
+
+    def _run(self, cfg, variables, tokens, **apply_kw):
+        import flax.core.meta as meta
+
+        from horovod_tpu.models.transformer import fused_tp_apply
+
+        mesh = make_parallel_mesh(tp=8, devices=jax.devices("cpu")[:8])
+        unboxed = meta.unbox(variables)
+
+        def f(v, toks):
+            return fused_tp_apply(v, cfg, toks, **apply_kw)
+
+        return jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False))(unboxed, tokens)
+
+    @pytest.mark.parametrize("impl", ["dense", "flash"])
+    def test_matches_gspmd_apply(self, impl):
+        cfg = self._cfg(attention_impl=impl)
+        model = TransformerLM(cfg)
+        tokens = make_tokens(b=2, t=32)
+        variables = model.init(jax.random.PRNGKey(0), tokens)
+        expected = model.apply(variables, tokens)
+        out = self._run(cfg, variables, tokens)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(expected),
+                                   rtol=3e-4, atol=3e-4)
+
+    def test_unfused_sp_twin_matches_too(self):
+        """fused=False keeps the same Megatron-SP structure with plain
+        collectives — the graceful-degradation baseline the fused path
+        is pinned against."""
+        cfg = self._cfg()
+        model = TransformerLM(cfg)
+        tokens = make_tokens(b=2, t=32)
+        variables = model.init(jax.random.PRNGKey(1), tokens)
+        expected = model.apply(variables, tokens)
+        fused = self._run(cfg, variables, tokens, fused=True)
+        unfused = self._run(cfg, variables, tokens, fused=False)
+        np.testing.assert_allclose(np.asarray(fused),
+                                   np.asarray(unfused),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(unfused),
+                                   np.asarray(expected),
+                                   rtol=3e-4, atol=3e-4)
+
+    def test_divisibility_validation(self):
+        cfg = self._cfg()
+        model = TransformerLM(cfg)
+        tokens = make_tokens(b=1, t=28)      # 28 % 8 != 0
+        variables = model.init(jax.random.PRNGKey(0), tokens)
+        with pytest.raises(ValueError, match="divisible"):
+            self._run(cfg, variables, tokens)
+
+    def test_rejects_sequence_parallel_attention(self):
+        cfg = self._cfg(attention_impl="ring")
+        model = TransformerLM(self._cfg())
+        tokens = make_tokens(b=1, t=32)
+        variables = model.init(jax.random.PRNGKey(0), tokens)
+        with pytest.raises(ValueError, match="attention_impl"):
+            self._run(cfg, variables, tokens)
+
+    def test_fused_kernel_grads_match_unfused(self):
+        """The ring kernels must stay differentiable (training wiring
+        depends on it): per-rank grads through the fused ops equal the
+        grads through their unfused formulations inside the SAME
+        shard_map program — the transpose of the ring is the transpose
+        of the collective it replaces."""
+        from horovod_tpu.ops.pallas_kernels import (
+            allgather_matmul,
+            matmul_reducescatter,
+        )
+
+        mesh = make_parallel_mesh(tp=8, devices=jax.devices("cpu")[:8])
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(64, 16), jnp.float32)
+        w = jnp.asarray(rng.randn(16, 8), jnp.float32)
+        xs = jnp.asarray(rng.randn(4, 16), jnp.float32)
+
+        def grads(fused):
+            def loss(x, w, xs):
+                a = jnp.sum(matmul_reducescatter(x, w, "tp",
+                                                 fused=fused) ** 2)
+                b = jnp.sum(allgather_matmul(xs, w, "tp",
+                                             fused=fused) ** 2)
+                return a + b
+
+            return jax.jit(jax.shard_map(
+                jax.grad(loss, argnums=(0, 1, 2)), mesh=mesh,
+                in_specs=(P(), P(), P()), out_specs=P(),
+                check_vma=False))(x, w, xs)
+
+        for gf, gu, name in zip(grads(True), grads(False),
+                                ("dx", "dw", "dxs")):
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(gu),
+                                       rtol=1e-4, atol=1e-4,
+                                       err_msg=name)
+
+    def test_grads_flow_through_fused_apply(self):
+        """End-to-end differentiability smoke: the fused SP forward
+        backprops to every parameter leaf with finite values."""
+        import flax.core.meta as meta
+        import optax
+
+        from horovod_tpu.models.transformer import fused_tp_apply
+
+        cfg = self._cfg()
+        model = TransformerLM(cfg)
+        tokens = make_tokens(b=2, t=32)
+        variables = meta.unbox(model.init(jax.random.PRNGKey(0),
+                                          tokens))
+        mesh = make_parallel_mesh(tp=8, devices=jax.devices("cpu")[:8])
+
+        def loss_fused(v, toks):
+            logits = fused_tp_apply(v, cfg, toks)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], toks[:, 1:]).mean()
+
+        g = jax.jit(jax.shard_map(
+            jax.grad(loss_fused), mesh=mesh, in_specs=(P(), P()),
+            out_specs=P(), check_vma=False))(variables, tokens)
+        leaves = jax.tree_util.tree_leaves(g)
+        assert leaves and all(
+            np.isfinite(np.asarray(x)).all() for x in leaves)
+        # the loss actually depends on the weights through the fused
+        # path: at least the block kernels carry non-zero gradient
+        assert any(float(jnp.max(jnp.abs(x))) > 0 for x in leaves)
