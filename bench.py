@@ -921,6 +921,55 @@ def run_chaos(args, hvd):
         f"recovery_s={r1['recovery_s']:.3f} "
         f"(steps_lost={r1['steps_lost']} <= checkpoint_every={every}); "
         f"two-run determinism: {deterministic}")
+
+    # -- guard: silent corruption → detect → rollback → replay -----------
+    # the same seeded scenario hvdci gates on (guard/smoke.py), run
+    # twice: a corrupt fault perturbs one replica's parameters, the
+    # checksum vote names the rank within one check interval, the
+    # run rolls back to the pinned last-good checkpoint and replays to
+    # a trajectory bit-identical to a fault-free run
+    import time as _time
+
+    from horovod_tpu import guard as hvd_guard
+    from horovod_tpu.guard import checksum as guard_checksum
+    from horovod_tpu.guard import smoke as guard_smoke
+    from horovod_tpu.utils.overlap_probe import _median_time
+
+    groot = tempfile.mkdtemp(prefix="bench_guard_chaos_")
+    try:
+        g1 = guard_smoke._run_chaos(os.path.join(groot, "run1"))
+        g2 = guard_smoke._run_chaos(os.path.join(groot, "run2"))
+    finally:
+        shutil.rmtree(groot, ignore_errors=True)
+    guard_deterministic = (
+        g1["detected_at"] == g2["detected_at"]
+        and g1["steps_replayed"] == g2["steps_replayed"]
+        and g1["trajectory"] == g2["trajectory"]
+        and np.array_equal(g1["final"], g2["final"]))
+    # enabled-path cost: one replica-checksum pass over a params-sized
+    # tree (the overlap probe's median-timing harness; amortize by the
+    # check interval for the per-step figure)
+    probe_params = {"w%d" % i: np.random.RandomState(seed + i)
+                    .rand(256, 256).astype(np.float32) for i in range(4)}
+    checksum_s = _median_time(
+        lambda t: guard_checksum.fingerprint(t), (probe_params,),
+        iters=5, warmup=1)
+    # disabled-path cost: the module-level hook with no guard armed —
+    # the contract tier-1 pins < 5µs/call
+    hvd_guard.clear_guard()
+    n = 100_000
+    t0 = _time.perf_counter()
+    for i in range(n):
+        hvd_guard.check(i)
+    disabled_s = (_time.perf_counter() - t0) / n
+    log(f"bench[chaos]: guard corrupt at step {guard_smoke.CORRUPT_AT} "
+        f"detected at step {g1['detected_at']} (rank "
+        f"{g1['diverged_rank']}), rolled back and replayed "
+        f"{g1['steps_replayed']} steps "
+        f"(<= every+interval={guard_smoke.EVERY + guard_smoke.INTERVAL}); "
+        f"checksum {checksum_s * 1e3:.2f} ms/check, disabled hook "
+        f"{disabled_s * 1e9:.0f} ns/step; two-run determinism: "
+        f"{guard_deterministic}")
     return {
         "metric": "chaos_probe",
         "chaos_seed": seed,
@@ -932,6 +981,14 @@ def run_chaos(args, hvd):
         "steps_lost": r1["steps_lost"],
         "chaos_resumed_step": r1["resumed_step"],
         "chaos_deterministic": deterministic,
+        "guard_corrupt_step": guard_smoke.CORRUPT_AT,
+        "guard_check_interval": guard_smoke.INTERVAL,
+        "guard_detected_step": g1["detected_at"],
+        "guard_diverged_rank": g1["diverged_rank"],
+        "guard_steps_replayed": g1["steps_replayed"],
+        "guard_deterministic": guard_deterministic,
+        "guard_checksum_seconds": round(checksum_s, 6),
+        "guard_disabled_overhead_seconds": round(disabled_s, 9),
     }
 
 

@@ -282,6 +282,7 @@ from horovod_tpu import checkpoint  # noqa: E402,F401
 from horovod_tpu import data  # noqa: E402,F401
 from horovod_tpu import elastic  # noqa: E402,F401
 from horovod_tpu import faults  # noqa: E402,F401
+from horovod_tpu import guard  # noqa: E402,F401
 
 __all__ = [
     # basics
@@ -308,6 +309,6 @@ __all__ = [
     "DistributedOptimizer", "DistributedAdasumOptimizer",
     "DistributedGradientTape", "DistributedTrainStep",
     "SyncBatchNorm",
-    # callbacks + checkpoint + data pipeline + elastic
-    "callbacks", "checkpoint", "data", "elastic",
+    # callbacks + checkpoint + data pipeline + elastic + integrity plane
+    "callbacks", "checkpoint", "data", "elastic", "guard",
 ]

@@ -1,7 +1,7 @@
 """``python -m horovod_tpu.analysis ci`` / ``hvdci`` — the one-shot CI
 entry point.
 
-Three gates, one invocation, one exit code (docs/perf_gate.md):
+Four gates, one invocation, one exit code (docs/perf_gate.md):
 
 1. **hvdlint** over the pre-commit scope (``--changed``: staged +
    unstaged + untracked files under ``horovod_tpu/``; falls back to the
@@ -9,7 +9,10 @@ Three gates, one invocation, one exit code (docs/perf_gate.md):
    gets linted, just wider);
 2. the **HLO/artifact rule pack** over every checked-in
    ``BENCH_r0*.json`` / ``MULTICHIP_r0*.json``;
-3. the **perf gate** trajectory self-walk.
+3. the **perf gate** trajectory self-walk;
+4. the **guard-chaos smoke** (``guard/smoke.py``): a seeded silent-
+   corruption → detect → rollback → replay round trip, run twice and
+   required bit-identical (docs/guardian.md).
 
 The whole run is a tier-1 test with the same <30 s budget as the
 hvdlint self-run, so "CI passed" and "the analysis suite passed" are
@@ -92,17 +95,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         except perf_gate.GateError as e:
             gate_error = str(e)
 
+    # 4 — guard-chaos smoke: the integrity plane's detect→rollback→
+    # replay loop, seeded and deterministic (sub-second, CPU-only)
+    try:
+        from horovod_tpu.guard.smoke import run_smoke
+
+        guard_errors = run_smoke()
+    except Exception as e:          # noqa: BLE001 — a crash IS a failure
+        guard_errors = [f"guard-smoke crashed: {type(e).__name__}: {e}"]
+
     elapsed = time.perf_counter() - t0
     gate_findings = gate.findings if gate is not None else []
     rc = 2 if (art_error or gate_error) else (
         1 if (lint.findings or art_findings or gate_findings
-              or metrics_errors) else 0)
+              or metrics_errors or guard_errors) else 0)
 
     if args.json_out:
         print(json.dumps({
             "lint": dict(lint.as_json(), scope=scope),
             "artifact_findings": [f.as_json() for f in art_findings],
             "metrics_schema_errors": metrics_errors,
+            "guard_smoke_errors": guard_errors,
             "perf_gate": gate.as_json() if gate is not None else None,
             "errors": [e for e in (art_error, gate_error) if e],
             "elapsed_s": round(elapsed, 3),
@@ -116,6 +129,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f.format())
     for e in metrics_errors:
         print(f"hvdci: metrics-schema: {e}")
+    for e in guard_errors:
+        print(f"hvdci: guard-smoke: {e}")
     for f in gate_findings:
         print(f.format())
     for err in (art_error, gate_error):
@@ -124,7 +139,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(f"hvdci: lint[{scope}] {len(lint.findings)} · "
           f"artifacts[{len(artifacts)}] "
           f"{len(art_findings) + len(metrics_errors)} · "
-          f"perf-gate {len(gate_findings)} finding(s) "
+          f"perf-gate {len(gate_findings)} · "
+          f"guard-smoke {len(guard_errors)} finding(s) "
           f"in {elapsed:.2f}s — {'FAIL' if rc else 'ok'}")
     return rc
 

@@ -28,6 +28,36 @@ SNAPSHOT_KIND = "hvdtel_snapshot"
 
 _NUM = (int, float)
 
+# the guard plane's closed series vocabulary (docs/guardian.md): any
+# series in the hvd_guard_* namespace must be one of these base names,
+# so a typo'd or ad-hoc guard metric fails tier-1 instead of silently
+# forking the dashboard contract
+GUARD_SERIES = frozenset({
+    "hvd_guard_checks_total",
+    "hvd_guard_checksum_seconds",
+    "hvd_guard_anomalies_total",
+    "hvd_guard_skipped_steps_total",
+    "hvd_guard_grad_norm",
+    "hvd_guard_rollbacks_total",
+    "hvd_guard_steps_replayed",
+    "hvd_guard_last_good_step",
+    "hvd_guard_divergence_rank",
+    "hvd_guard_preempt_departures_total",
+    "hvd_guard_preempt_drains_total",
+})
+
+
+def _check_guard_series(errors: List[str], obj, field: str) -> None:
+    if not isinstance(obj, dict):
+        return      # shape error already reported by _check_series_map
+    for k in obj:
+        if isinstance(k, str) and k.startswith("hvd_guard"):
+            base = k.split("{", 1)[0]
+            if base not in GUARD_SERIES:
+                errors.append(
+                    f"{field}[{k!r}]: unknown guard series {base!r} — "
+                    f"not in metrics_schema.GUARD_SERIES")
+
 
 def _check_series_map(errors: List[str], obj, field: str) -> None:
     if not isinstance(obj, dict):
@@ -95,6 +125,9 @@ def validate_snapshot(obj: Dict) -> List[str]:
     _check_series_map(errors, obj.get("counters", {}), "counters")
     _check_series_map(errors, obj.get("gauges", {}), "gauges")
     _check_histograms(errors, obj.get("histograms", {}))
+    _check_guard_series(errors, obj.get("counters", {}), "counters")
+    _check_guard_series(errors, obj.get("gauges", {}), "gauges")
+    _check_guard_series(errors, obj.get("histograms", {}), "histograms")
     return errors
 
 
@@ -108,6 +141,7 @@ def validate_bench_metrics(obj: Dict) -> List[str]:
         errors.append(f"metrics.schema_version: expected "
                       f"{SCHEMA_VERSION}, got {obj.get('schema_version')!r}")
     _check_series_map(errors, obj.get("counters", {}), "metrics.counters")
+    _check_guard_series(errors, obj.get("counters", {}), "metrics.counters")
     return errors
 
 

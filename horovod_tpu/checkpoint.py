@@ -156,6 +156,11 @@ class Checkpointer:
         # returned to the caller.
         self._error_lock = threading.Lock()
         self._writer_error: Optional[BaseException] = None
+        # steps pinned against retention (guardian "last-good" rollback
+        # targets, docs/guardian.md).  Written by the caller thread,
+        # read by _gc() on the writer thread — lock-guarded.
+        self._pin_lock = threading.Lock()
+        self._pins: set = set()
         # observability for the bench probe: the train-loop blocking
         # time of the last save (D2H cut only, async) and the last
         # end-to-end write duration (background, after wait())
@@ -337,12 +342,38 @@ class Checkpointer:
         self._dispatch(write)
         return True
 
+    def pin(self, step: int) -> None:
+        """Exempt ``step`` from retention until :meth:`unpin`.
+
+        The guardian's rollback contract (docs/guardian.md): between
+        anomaly detection and restore, further saves may push the
+        last-good step past ``max_to_keep`` — a pinned step can never be
+        reaped in that window.  Pins cover the pickle layout (the
+        multi-process production writer); the orbax manager owns its own
+        retention."""
+        with self._pin_lock:
+            self._pins.add(int(step))
+
+    def unpin(self, step: int) -> None:
+        """Release a :meth:`pin`; the step rejoins normal retention on
+        the next save's GC pass."""
+        with self._pin_lock:
+            self._pins.discard(int(step))
+
+    def pinned_steps(self) -> list:
+        with self._pin_lock:
+            return sorted(self._pins)
+
     def _gc(self) -> None:
         # rank retention over the pickle layout only — mixing in orbax
         # step numbers could delete a just-written pickle step while
         # never pruning the (manager-owned) orbax dirs
         steps = sorted(self._pickle_steps())
+        with self._pin_lock:
+            pins = set(self._pins)
         for s in steps[:-self._max_to_keep]:
+            if s in pins:     # a rollback target is never reaped
+                continue
             import shutil
 
             shutil.rmtree(os.path.join(self._dir, f"step_{s}"),

@@ -25,7 +25,10 @@ from horovod_tpu.runner.hosts import HostInfo, SlotInfo, get_host_assignments
 from horovod_tpu.runner.network import (
     AckResponse,
     BasicService,
+    GetHealthyPeerRequest,
     HeartbeatRequest,
+    PeerAddressResponse,
+    PlannedDepartureRequest,
     RegisterWorkerRequest,
     WorkerReadyRequest,
     notify_hosts_updated,
@@ -114,6 +117,10 @@ class ElasticDriver:
         # last client that may still detach from it.
         self._coord_services: List = []
         self._worker_notify_addrs: Dict[int, Tuple[str, int]] = {}
+        # (host, local_rank) keys that announced a preemption-grace
+        # departure (guard/preempt.py): their exit — any code — is
+        # graceful, so no blacklist, no quarantine, no sibling abort
+        self._planned_departures: set = set()
         self._create_worker_fn: Optional[Callable] = None
         self._shutdown = threading.Event()
         self._resume_lock = threading.Lock()   # serialize concurrent resumes
@@ -181,6 +188,44 @@ class ElasticDriver:
             self._registry.record_ready(req.host, req.local_rank)
             self._check_generation_ready()
             return AckResponse()
+        if isinstance(req, PlannedDepartureRequest):
+            # preemption grace (guard/preempt.py): the worker has
+            # committed (or is committing) a priority checkpoint and
+            # will exit.  Exempt it from death verdicts now; its exit
+            # is handled as graceful in record_worker_exit.
+            self._health.mark_departing(req.host, req.local_rank)
+            with self._lock:
+                self._planned_departures.add((req.host, req.local_rank))
+            telemetry.counter(
+                "hvd_guard_preempt_departures_total",
+                "planned (preemption-grace) departures announced").inc()
+            hvd_logging.info(
+                "elastic: worker %s:%d announced a planned departure at "
+                "step %d — exempt from death verdicts and quarantine",
+                req.host, req.local_rank, getattr(req, "step", -1))
+            return AckResponse()
+        if isinstance(req, GetHealthyPeerRequest):
+            # peer repair (guard/repair.py): hand the diverged worker a
+            # healthy peer's notification address.  Healthy = currently
+            # assigned to a different rank, registered a notification
+            # service, not departing; prefer rank 0 (the checkpoint
+            # writer — its copy is the recovery reference).
+            with self._lock:
+                rank_of = {s.rank: k for k, s in self._assignments.items()}
+                candidates = []
+                for rank in sorted(self._worker_notify_addrs):
+                    if rank == req.rank or rank not in rank_of:
+                        continue
+                    key = rank_of[rank]
+                    if key in self._planned_departures:
+                        continue
+                    candidates.append(
+                        (rank, self._worker_notify_addrs[rank]))
+            for rank, addr in candidates:
+                if not self._health.is_departing(*rank_of[rank]):
+                    return PeerAddressResponse(rank=rank,
+                                               address=tuple(addr))
+            return PeerAddressResponse()
         if isinstance(req, GetRankAndSizeRequest):
             with self._lock:
                 slot = self._assignments.get((req.host, req.local_rank))
@@ -620,6 +665,18 @@ class ElasticDriver:
                 hvd_logging.debug(
                     "elastic: ignoring exit code %d from unassigned worker "
                     "%s:%d", exit_code, host, local_rank)
+                return
+            if (host, local_rank) in self._planned_departures:
+                # preemption grace: the departure was announced and the
+                # state committed — this exit is not a failure (no
+                # blacklist, no quarantine, no sibling abort) and not a
+                # job-completing success either (the work is unfinished;
+                # the host returns to the pool when discovery re-lists it)
+                self._planned_departures.discard((host, local_rank))
+                hvd_logging.info(
+                    "elastic: worker %s:%d exited (code %d) after a "
+                    "planned departure — treating as graceful",
+                    host, local_rank, exit_code)
                 return
         if self._host_manager.is_blacklisted(host):
             # one incident, one reset: the first failure on this host

@@ -99,6 +99,10 @@ class HealthMonitor:
         self._start_thread = start_thread
         self._lock = threading.Lock()
         self._workers: Dict[Tuple[str, int], _WorkerHealth] = {}
+        # workers that announced a planned (preemption) departure:
+        # exempt from death/hang verdicts — their silence is expected
+        # and must not trigger regeneration ahead of the clean exit
+        self._departing: set = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -151,6 +155,10 @@ class HealthMonitor:
                          step: int = -1) -> None:
         now = self._clock()
         with self._lock:
+            if (host, local_rank) in self._departing:
+                # a straggler beat sent during the drain window must not
+                # re-enroll the worker: its exit is imminent and planned
+                return
             w = self._workers.get((host, local_rank))
             if w is None:
                 w = _WorkerHealth(now, self._clock,
@@ -166,9 +174,22 @@ class HealthMonitor:
             if step >= 0:
                 w.progress.update(step, now=now)
 
+    def mark_departing(self, host: str, local_rank: int) -> None:
+        """A planned (preemption-grace) departure was announced: stop
+        counting this worker toward death/hang verdicts.  Its eventual
+        exit is handled by the driver as graceful (guard/preempt.py)."""
+        with self._lock:
+            self._departing.add((host, local_rank))
+            self._workers.pop((host, local_rank), None)
+
+    def is_departing(self, host: str, local_rank: int) -> bool:
+        with self._lock:
+            return (host, local_rank) in self._departing
+
     def forget(self, host: str, local_rank: int) -> None:
         with self._lock:
             self._workers.pop((host, local_rank), None)
+            self._departing.discard((host, local_rank))
 
     def purge(self, assigned: set) -> None:
         """Drop entries for workers no longer assigned (driver calls this
@@ -177,6 +198,7 @@ class HealthMonitor:
         with self._lock:
             self._workers = {k: w for k, w in self._workers.items()
                              if k in assigned}
+            self._departing &= assigned
 
     def max_step(self) -> int:
         """Highest training step any monitored worker ever reported —

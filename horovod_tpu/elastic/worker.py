@@ -97,6 +97,10 @@ class WorkerNotificationManager:
         self._listeners: List = []
         self._service: Optional["WorkerNotificationService"] = None
         self._heartbeat: Optional[HeartbeatSender] = None
+        # peer-repair provider (guard/repair.py): a callable returning
+        # this worker's committed (step, state) snapshot, served to a
+        # diverged peer over the notification channel
+        self._state_provider = None
 
     def init(self) -> None:
         if self._service is not None:
@@ -141,6 +145,23 @@ class WorkerNotificationManager:
                         driver_addr, secret_key, host, local_rank,
                         interval)
                     self._heartbeat.start()
+
+    def set_state_provider(self, provider) -> None:
+        """Install the callable a diverged peer's ``FetchStateRequest``
+        is served from: ``provider() -> (step, state)`` or None when
+        nothing is committed yet (guard/repair.py).  Typically
+        ``lambda: (state._commit_count, state._saved_state)`` guarded by
+        the training loop's commit."""
+        with self._lock:
+            self._state_provider = provider
+
+    def handle_fetch_state(self):
+        """NotificationServer dispatch target for FetchStateRequest."""
+        with self._lock:
+            provider = self._state_provider
+        if provider is None:
+            return None
+        return provider()
 
     def register_listener(self, listener) -> None:
         with self._lock:

@@ -31,6 +31,11 @@ Sites currently instrumented (grep ``faults.inject`` for ground truth):
 ``timeline.write``          timeline writer thread, once per event
 ``probe.connect``           NIC-probe task → driver connect scan
 ``telemetry.export``        metrics snapshot writer, once per export pass
+``guard.params``            guardian replica-checksum pass — the ``corrupt``
+                            action's SDC point (once per check interval)
+``guard.check``             each guardian check pass (numerics + checksum)
+``worker.preempt``          preemption handler drain → commit → notify path
+``guard.repair``            peer state fetch in the guard repair path
 ==========================  =================================================
 
 (Coverage is enforced statically: hvdlint rule HVD006 fails on any

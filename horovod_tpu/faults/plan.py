@@ -32,6 +32,13 @@ Actions:
                    slow-host fault
 ``value[(v)]``     return ``v`` from ``inject()`` — the call site defines
                    the semantics (e.g. a discovery flap)
+``corrupt[(s)]``   return a deterministically perturbed copy of the value
+                   passed to ``inject(site, value=...)`` — one element of
+                   one array leaf gets ``+= s * (1 + |x|)`` (default
+                   ``s=1.0``), with leaf and element chosen by an RNG
+                   seeded from ``(seed, site, hit)``.  The silent-data-
+                   corruption fault (docs/guardian.md); with no value
+                   passed, returns ``s`` itself
 =================  ==========================================================
 """
 
@@ -85,7 +92,8 @@ class FaultSpec:
     def __init__(self, site: str, action: str = "raise",
                  arg: Any = None, at: int = 1, count: int = 1,
                  prob: float = 1.0):
-        if action not in ("crash", "hang", "raise", "delay", "value"):
+        if action not in ("crash", "hang", "raise", "delay", "value",
+                          "corrupt"):
             raise ValueError(f"unknown fault action {action!r}")
         if at < 1:
             raise ValueError(f"fault hit index must be >= 1, got {at}")
@@ -172,9 +180,10 @@ class FaultPlan:
         """Unblock any in-progress ``hang``/``delay`` waits (teardown)."""
         self._cancel.set()
 
-    def inject(self, site: str) -> Any:
+    def inject(self, site: str, value: Any = None) -> Any:
         """One hit at ``site``: fire every matching spec.  Returns the
-        ``value`` action's arg (last one wins) or None."""
+        ``value`` action's arg or the ``corrupt`` action's perturbed
+        copy of ``value`` (last one wins) or None."""
         with self._lock:
             hit = self._hits.get(site, 0) + 1
             self._hits[site] = hit
@@ -185,8 +194,8 @@ class FaultPlan:
                 self._fired.append((site, hit, s.action))
         out = None
         for s in due:
-            res = self._fire(s, site, hit)
-            if s.action == "value":
+            res = self._fire(s, site, hit, value)
+            if s.action in ("value", "corrupt"):
                 out = res
         return out
 
@@ -198,7 +207,8 @@ class FaultPlan:
         rng = random.Random(f"{self.seed}:{site}:{hit}")
         return rng.random() < spec.prob
 
-    def _fire(self, spec: FaultSpec, site: str, hit: int) -> Any:
+    def _fire(self, spec: FaultSpec, site: str, hit: int,
+              value: Any = None) -> Any:
         hvd_logging.warning("faults: firing %s at %s (hit %d)",
                             spec.action, site, hit)
         # telemetry is imported lazily: telemetry.export imports this
@@ -231,7 +241,42 @@ class FaultPlan:
             return None
         if spec.action == "raise":
             raise _make_exception(spec.arg, site, hit)
+        if spec.action == "corrupt":
+            scale = float(spec.arg) if spec.arg is not None else 1.0
+            return _corrupt_value(value, scale, self.seed, site, hit)
         return spec.arg       # "value"
+
+
+def _corrupt_value(value: Any, scale: float, seed: int, site: str,
+                   hit: int) -> Any:
+    """Deterministic single-element perturbation of an array pytree.
+
+    Leaf and flat index are drawn from an RNG seeded on
+    ``(seed, site, hit)`` — a pure function of the plan, so two runs of
+    the same plan corrupt the same element by the same amount.  The
+    perturbation ``x += scale * (1 + |x|)`` moves the element whether or
+    not it is near zero, and preserves the leaf's dtype."""
+    if value is None:
+        return scale
+    # lazy: only the corrupt action needs array machinery, and _fire
+    # never runs on the production no-plan path
+    import jax
+    import numpy as np
+
+    rng = random.Random(f"{seed}:{site}:{hit}:corrupt")
+    leaves, treedef = jax.tree_util.tree_flatten(value)
+    eligible = [i for i, leaf in enumerate(leaves)
+                if hasattr(leaf, "shape") and getattr(leaf, "size", 0)]
+    if not eligible:
+        return value
+    li = eligible[rng.randrange(len(eligible))]
+    leaf = np.array(leaves[li])          # host copy, original untouched
+    flat = leaf.reshape(-1)
+    j = rng.randrange(flat.size)
+    x = float(flat[j])
+    flat[j] = np.asarray(x + scale * (1.0 + abs(x)), dtype=leaf.dtype)
+    leaves[li] = leaf
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 def _make_exception(name: Optional[str], site: str, hit: int) -> BaseException:
@@ -325,8 +370,11 @@ def load_env_plan(force: bool = False) -> Optional[FaultPlan]:
         return _plan
 
 
-def inject(site: str) -> Any:
+def inject(site: str, value: Any = None) -> Any:
     """The chaos hook: one hit at ``site`` against the active plan.
+
+    ``value`` is only consulted by the ``corrupt`` action, which returns
+    a perturbed copy of it; other actions ignore it.
 
     No active plan → returns None after one global check (plus a
     one-time env parse on the first call in the process) — cheap enough
@@ -336,4 +384,4 @@ def inject(site: str) -> Any:
             return None
         if load_env_plan() is None:
             return None
-    return _plan.inject(site)
+    return _plan.inject(site, value)

@@ -1,0 +1,146 @@
+"""Seeded guard-chaos smoke for ``hvdci`` (analysis/ci.py gate 4).
+
+A sub-second, CPU-only, two-replica lockstep simulation of the full
+SDC story: a seeded ``corrupt`` fault flips one element of rank 1's
+parameters at a known step, the replica-consistency vote names rank 1
+within one check interval, rank 0 rolls back to its pinned last-good
+checkpoint, rank 1 repairs by adopting rank 0's restored state (the
+in-process stand-in for the peer-RPC path in guard/repair.py), and the
+replayed trajectory lands bit-identical to a fault-free run — twice,
+so determinism itself is gated.
+
+Returns error strings (empty = pass) in the same idiom as
+``analysis.metrics_schema`` so ci.py folds it straight into its exit
+code.  Budget: well under a second — pure numpy, a tempdir
+checkpointer, ~20 simulated steps.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Dict, List
+
+import numpy as np
+
+from horovod_tpu import faults
+from horovod_tpu.faults import FaultPlan
+from horovod_tpu.guard import checksum
+from horovod_tpu.guard.numerics import GuardRollback
+from horovod_tpu.guard.rollback import RollbackManager
+
+STEPS = 12
+EVERY = 2          # checkpoint_every
+INTERVAL = 2       # guard check interval
+CORRUPT_AT = 5     # corruption strikes rank 1 at this step
+SEED = 1234
+RANKS = 2
+
+
+def _batch(step: int) -> np.ndarray:
+    # derived from the global step alone so replay sees identical data
+    return np.random.RandomState(SEED + step).rand(4).astype(np.float32)
+
+
+def _train(w: np.ndarray, batch: np.ndarray) -> np.ndarray:
+    return w - 0.1 * (w - batch)
+
+
+def _fault_free() -> np.ndarray:
+    w = np.full((4,), 2.0, np.float32)
+    for s in range(1, STEPS + 1):
+        w = _train(w, _batch(s))
+    return w
+
+
+def _run_chaos(root: str) -> Dict[str, Any]:
+    from horovod_tpu.checkpoint import Checkpointer
+    from horovod_tpu.elastic.state import TpuState
+
+    # rank 1's check at step CORRUPT_AT is the 2*CORRUPT_AT-th
+    # guard.params hit (two ranks interleave, rank 0 first)
+    plan = FaultPlan(seed=SEED).add(
+        "guard.params", "corrupt", at=2 * CORRUPT_AT, arg=1.0)
+    faults.set_plan(plan)
+    try:
+        ckpt = Checkpointer(root, use_orbax=False)
+        state = TpuState(params={"w": np.full((4,), 2.0, np.float32)},
+                         checkpointer=ckpt, checkpoint_every=EVERY)
+        rb = RollbackManager(state)
+        params = [np.asarray(state.params["w"]).copy()
+                  for _ in range(RANKS)]
+        checkers = [checksum.ReplicaChecker(INTERVAL) for _ in range(RANKS)]
+        detected_at = None
+        diverged_rank = None
+        replayed = None
+        trajectory: List[float] = []
+
+        step = 0
+        while step < STEPS:
+            step = state._commit_count + 1
+            batch = _batch(step)
+            params = [_train(w, batch) for w in params]
+            state.params = {"w": params[0].copy()}
+            state.commit()
+            rb.note_commit()
+            try:
+                for r in range(RANKS):
+                    corrupted = faults.inject("guard.params",
+                                              value={"w": params[r]})
+                    if corrupted is not None:
+                        params[r] = corrupted["w"]
+                    if checkers[r].due(step):
+                        fps = [checksum.fingerprint({"w": w})
+                               for w in params]
+                        report = checksum.compare(fps)
+                        checkers[r].check(step, {"w": params[r]})
+                        if report:
+                            detected_at = step
+                            diverged_rank = report[0]
+                            raise GuardRollback("divergence", step=step)
+                        rb.note_verified(step)
+            except GuardRollback:
+                replayed = rb.rollback(reason="divergence")
+                restored = np.asarray(state.params["w"]).copy()
+                # peer repair: the diverged rank adopts the healthy copy
+                params = [restored.copy() for _ in range(RANKS)]
+                continue
+            trajectory.append(round(float(params[0].sum()), 6))
+        state.wait()
+        return {"detected_at": detected_at, "diverged_rank": diverged_rank,
+                "steps_replayed": replayed, "trajectory": trajectory,
+                "final": params[0].copy(),
+                "pinned": sorted(ckpt.pinned_steps())}
+    finally:
+        faults.clear_plan()
+
+
+def run_smoke() -> List[str]:
+    """Run the seeded guard-chaos scenario twice; returns a list of
+    error strings (empty = pass)."""
+    errors: List[str] = []
+    with tempfile.TemporaryDirectory(prefix="hvdguard-smoke-") as d:
+        r1 = _run_chaos(os.path.join(d, "a"))
+        r2 = _run_chaos(os.path.join(d, "b"))
+    if r1["detected_at"] is None:
+        errors.append("guard-smoke: corruption was never detected")
+        return errors
+    if r1["diverged_rank"] != 1:
+        errors.append(f"guard-smoke: vote named rank "
+                      f"{r1['diverged_rank']}, expected 1")
+    if not CORRUPT_AT <= r1["detected_at"] <= CORRUPT_AT + INTERVAL:
+        errors.append(f"guard-smoke: detected at step {r1['detected_at']}, "
+                      f"outside one check interval of {CORRUPT_AT}")
+    if r1["steps_replayed"] is None or \
+            not 0 < r1["steps_replayed"] <= EVERY + INTERVAL:
+        errors.append(f"guard-smoke: steps_replayed={r1['steps_replayed']} "
+                      f"exceeds checkpoint_every+interval={EVERY + INTERVAL}")
+    clean = _fault_free()
+    if not np.array_equal(r1["final"], clean):
+        errors.append("guard-smoke: recovered trajectory differs from the "
+                      "fault-free run")
+    if r1["detected_at"] != r2["detected_at"] or \
+            r1["trajectory"] != r2["trajectory"] or \
+            not np.array_equal(r1["final"], r2["final"]):
+        errors.append("guard-smoke: two seeded runs were not identical")
+    return errors

@@ -41,6 +41,24 @@ from horovod_tpu.runtime.topology import GLOBAL_AXES
 AxisSpec = Union[str, Sequence[str]]
 
 
+def _sumsq(tree):
+    """fp32 sum of squares over every leaf (the global-norm reduction
+    the guard computes in-graph)."""
+    s = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        s = s + jnp.sum(jnp.square(jnp.asarray(leaf, jnp.float32)))
+    return s
+
+
+def _guard_select(ok, new_params, new_opt, params, opt_state):
+    """Keep the update only when the guard predicate holds; otherwise
+    keep the pre-step state — in-graph, so donation can't lose the
+    clean copy."""
+    sel = lambda n, o: jnp.where(ok, n, o)  # noqa: E731
+    return (jax.tree_util.tree_map(sel, new_params, params),
+            jax.tree_util.tree_map(sel, new_opt, opt_state))
+
+
 class DistributedTrainStep:
     """Compiled data-parallel training step.
 
@@ -82,7 +100,8 @@ class DistributedTrainStep:
                  shard_optimizer_states: bool = False,
                  exchange_bucket_bytes: Optional[int] = None,
                  hierarchy: str = "auto",
-                 fused_collectives: str = "auto"):
+                 fused_collectives: str = "auto",
+                 guard=None):
         """``steps_per_call > 1`` scans that many optimizer steps inside
         the one compiled program (the Keras ``steps_per_execution``
         knob): one dispatch amortizes per-call host/launch overhead —
@@ -131,6 +150,22 @@ class DistributedTrainStep:
         numerics are identical either way, and the resolved mode is an
         AOT-key field so a warm start never serves a fused executable
         to an unfused config.
+
+        ``guard`` attaches the numerics guardian
+        (:class:`horovod_tpu.guard.TrainingGuard` or anything exposing
+        ``current_limit()``/``observe()``): the compiled step takes one
+        extra traced scalar — the spike limit — computes the global
+        gradient norm, and where-selects the *pre-step* ``(params,
+        opt_state)`` whenever the norm is non-finite or above the
+        limit, so a poisoned update is never applied even with donated
+        buffers.  The limit is a runtime value, so per-step threshold
+        changes never recompile.  Requires ``steps_per_call=1`` (each
+        optimizer step must be individually observable).  In shard_map
+        pre-reduction paths (``shard_optimizer_states`` or ``op=None``)
+        the guarded norm is the root-sum-square over all device-local
+        gradients — device-consistent via one scalar allreduce — rather
+        than the norm of the reduced gradient; the guardian's EMA
+        baseline adapts to whichever statistic the mode produces.
 
         ``hierarchy`` picks the sharded exchange's topology:
         ``"auto"`` (default) resolves against the data-axes
@@ -220,6 +255,13 @@ class DistributedTrainStep:
             raise ValueError(
                 f"steps_per_call must be >= 1, got {steps_per_call}")
         self._steps_per_call = int(steps_per_call)
+        self._guard = guard
+        if guard is not None and self._steps_per_call != 1:
+            raise ValueError(
+                "guard= requires steps_per_call=1: the guardian must "
+                "observe (and be able to suppress) every optimizer step "
+                "individually — a scanned multi-step program would apply "
+                "k-1 updates before the host sees the first norm")
         self._compiler_options = dict(compiler_options) \
             if compiler_options is not None else None
         self._donate_batch = bool(donate_batch)
@@ -284,15 +326,38 @@ class DistributedTrainStep:
                 params = optax.apply_updates(params, updates)
                 return params, opt_state, loss
 
+            def guarded_step(params, opt_state, batch, limit):
+                loss, grads = jax.value_and_grad(self._loss_fn)(params, batch)
+                gnorm = jnp.sqrt(_sumsq(grads))
+                ok = jnp.isfinite(gnorm) & (gnorm <= limit)
+                updates, new_opt = self._optimizer.update(
+                    grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                params, opt_state = _guard_select(
+                    ok, new_params, new_opt, params, opt_state)
+                return params, opt_state, loss, gnorm
+
             if self._fsdp_axis is not None:
                 # params/opt arrive committed with their FSDP placements
                 # (init) and GSPMD propagates them through the step,
                 # inserting gather/reduce-scatter; the batch keeps its
                 # data-axis constraint so data parallelism can't silently
                 # degrade to replicated compute on a raw batch
+                if guard is not None:
+                    self._step = jax.jit(
+                        guarded_step,
+                        in_shardings=(None, None, batch_sharding, None),
+                        donate_argnums=donated)
+                else:
+                    self._step = jax.jit(
+                        multi(step),
+                        in_shardings=(None, None, batch_sharding),
+                        donate_argnums=donated)
+            elif guard is not None:
                 self._step = jax.jit(
-                    multi(step),
-                    in_shardings=(None, None, batch_sharding),
+                    guarded_step,
+                    in_shardings=(repl, repl, batch_sharding, repl),
+                    out_shardings=(repl, repl, repl, repl),
                     donate_argnums=donated)
             else:
                 self._step = jax.jit(
@@ -354,6 +419,29 @@ class DistributedTrainStep:
                 loss = C.allreduce(loss, op=Average, axis=axes)
                 return params, opt_state, loss
 
+            def per_device_guarded(params, opt_state, batch, limit):
+                loss, grads = jax.value_and_grad(self._loss_fn)(params, batch)
+                if self._op is not None and not self._shard_opt:
+                    # reducer already made grads identical on every
+                    # device: the local norm IS the global norm
+                    grads, _ = reducer.update(grads, optax.EmptyState())
+                    gnorm = jnp.sqrt(_sumsq(grads))
+                else:
+                    # pre-reduction grads (the sharded exchange or the
+                    # delta-form optimizer owns the reduction): one
+                    # scalar allreduce makes the verdict — and therefore
+                    # the select — identical on every device
+                    gnorm = jnp.sqrt(C.allreduce(
+                        _sumsq(grads), op=Sum, axis=axes))
+                ok = jnp.isfinite(gnorm) & (gnorm <= limit)
+                updates, new_opt = self._optimizer.update(
+                    grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                params, opt_state = _guard_select(
+                    ok, new_params, new_opt, params, opt_state)
+                loss = C.allreduce(loss, op=Average, axis=axes)
+                return params, opt_state, loss, gnorm
+
             # out_specs=P() with check_vma=False: params come out
             # genuinely replicated (the reducer or the delta-form
             # optimizer chain makes every shard's update identical), but
@@ -368,13 +456,21 @@ class DistributedTrainStep:
             # semantics (save on rank 0, broadcast on restore); a
             # reshard of a restored checkpoint replicates rank 0's
             # momenta, which is exactly what broadcast-restore does.
-            smapped = shard_map(
-                per_device, mesh=self._mesh,
-                in_specs=(P(), P(), P(self._data_axes)),
-                out_specs=(P(), P(), P()),
-                check_vma=False)
-            self._step = jax.jit(
-                multi(smapped), donate_argnums=donated)
+            if guard is not None:
+                smapped = shard_map(
+                    per_device_guarded, mesh=self._mesh,
+                    in_specs=(P(), P(), P(self._data_axes), P()),
+                    out_specs=(P(), P(), P(), P()),
+                    check_vma=False)
+                self._step = jax.jit(smapped, donate_argnums=donated)
+            else:
+                smapped = shard_map(
+                    per_device, mesh=self._mesh,
+                    in_specs=(P(), P(), P(self._data_axes)),
+                    out_specs=(P(), P(), P()),
+                    check_vma=False)
+                self._step = jax.jit(
+                    multi(smapped), donate_argnums=donated)
         else:
             raise ValueError(f"unknown mode {mode!r}")
 
@@ -464,6 +560,7 @@ class DistributedTrainStep:
             "fsdp_axis": self._fsdp_axis,
             "steps_per_call": self._steps_per_call,
             "donate_batch": self._donate_batch,
+            "guard": self._guard is not None,
         }
 
     def init(self, params):
@@ -570,7 +667,10 @@ class DistributedTrainStep:
         ``docs/scaling.md`` bytes-on-wire model inspect (see
         :mod:`horovod_tpu.utils.hlo`).  Uses the same compile options
         as execution."""
-        return self._step.lower(params, opt_state, batch).compile(
+        args = (params, opt_state, batch)
+        if self._guard is not None:
+            args += (np.float32(np.inf),)
+        return self._step.lower(*args).compile(
             compiler_options=self._compiler_options).as_text()
 
     def _record_step_telemetry(self, params, t0: float) -> None:
@@ -608,13 +708,33 @@ class DistributedTrainStep:
         except Exception:  # noqa: BLE001 — observability must not sink a step
             pass
 
+    def _guard_unpack(self, out, limit):
+        """Guarded steps return ``(params, opt_state, loss, gnorm)``:
+        surface the norm to the guardian (which may raise per policy)
+        and hand the caller the usual 3-tuple.  The device→host read of
+        the norm scalar is the enabled-path cost ``bench.py --chaos``
+        reports as guard overhead."""
+        params, opt_state, loss, gnorm = out
+        self._guard.observe(float(gnorm), limit=float(limit))
+        return params, opt_state, loss
+
     def __call__(self, params, opt_state, batch):
         tel_on = telemetry.enabled()
         t0 = time.perf_counter() if tel_on else 0.0
+        if self._guard is not None:
+            # the limit rides as a traced runtime scalar: threshold
+            # drift as the EMA baseline tightens never recompiles
+            limit = np.float32(self._guard.current_limit())
+            args = (params, opt_state, batch, limit)
+        else:
+            limit = None
+            args = (params, opt_state, batch)
         if self._compiler_options is None and self._persistent_root is None:
-            out = self._step(params, opt_state, batch)
+            out = self._step(*args)
             if tel_on:
                 self._record_step_telemetry(params, t0)
+            if limit is not None:
+                return self._guard_unpack(out, limit)
             return out
         # AOT path, for two reasons that share the machinery: per-compile
         # XLA options need lower-once-compile-with-options, and the
@@ -624,8 +744,7 @@ class DistributedTrainStep:
         # differently-sharded arrays — and the cache is LRU-bounded
         # (Config.cache_capacity) so varying batch signatures don't
         # accumulate executables for the process lifetime.
-        leaves, treedef = jax.tree_util.tree_flatten(
-            (params, opt_state, batch))
+        leaves, treedef = jax.tree_util.tree_flatten(args)
         key = (treedef,
                tuple((np.shape(l), str(getattr(l, "dtype",
                                                type(l).__name__)),
@@ -638,7 +757,7 @@ class DistributedTrainStep:
             if st is not None:
                 st.cache_stats["misses"] += 1
             compiled, hit = self._compile_cache.aot_compile(
-                self._step, (params, opt_state, batch),
+                self._step, args,
                 extras=self._aot_extras(),
                 compiler_options=self._compiler_options,
                 directory=self._persistent_root,
@@ -652,9 +771,11 @@ class DistributedTrainStep:
         self._compiled_cache[key] = compiled     # reinsert = most recent
         while len(self._compiled_cache) > self._compiled_cache_max:
             self._compiled_cache.pop(next(iter(self._compiled_cache)))
-        out = compiled(params, opt_state, batch)
+        out = compiled(*args)
         if tel_on:
             self._record_step_telemetry(params, t0)
+        if limit is not None:
+            return self._guard_unpack(out, limit)
         return out
 
 

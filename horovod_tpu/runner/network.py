@@ -128,6 +128,52 @@ class HeartbeatRequest:
         self.metrics = metrics
 
 
+class PlannedDepartureRequest:
+    """Worker → driver: this worker is being preempted and will exit
+    after committing a priority checkpoint (guard/preempt.py).  The
+    driver marks it departing so the HealthMonitor stops counting it
+    toward death verdicts and its exit skips blacklist/quarantine."""
+
+    def __init__(self, host: str, local_rank: int, step: int = -1):
+        self.host = host
+        self.local_rank = local_rank
+        self.step = step
+
+
+class GetHealthyPeerRequest:
+    """Diverged worker → driver: name a healthy peer (another rank,
+    not suspect/departing) whose notification service can serve a
+    state snapshot for peer repair (guard/repair.py)."""
+
+    def __init__(self, host: str, local_rank: int, rank: int):
+        self.host = host
+        self.local_rank = local_rank
+        self.rank = rank
+
+
+class PeerAddressResponse:
+    """Driver → worker: a healthy peer's rank and notification address
+    (``address`` None when no healthy peer exists)."""
+
+    def __init__(self, rank: int = -1,
+                 address: Optional[Tuple[str, int]] = None):
+        self.rank = rank
+        self.address = address
+
+
+class FetchStateRequest:
+    """Diverged worker → healthy peer: send your committed state."""
+
+
+class StateSnapshotResponse:
+    """Healthy peer → diverged worker: committed ``(step, state)``
+    snapshot (``state`` None when the peer has nothing committed)."""
+
+    def __init__(self, step: int = -1, state: Any = None):
+        self.step = step
+        self.state = state
+
+
 class BasicService:
     """Threaded TCP server dispatching pickled requests to a handler
     (reference ``BasicService``, ``network.py:268``)."""
@@ -213,6 +259,14 @@ class NotificationServer:
             if isinstance(req, HostsUpdatedRequest):
                 manager.handle_hosts_updated(req.timestamp, req.res)
                 return AckResponse()
+            if isinstance(req, FetchStateRequest):
+                # peer-repair fetch (guard/repair.py) — served from the
+                # provider the manager registered, if any
+                fetch = getattr(manager, "handle_fetch_state", None)
+                snap = fetch() if fetch is not None else None
+                if snap is None:
+                    return StateSnapshotResponse()
+                return StateSnapshotResponse(step=snap[0], state=snap[1])
             raise ValueError(f"unexpected request {type(req).__name__}")
 
         self._service = BasicService("worker_notification", key, handle)
@@ -253,6 +307,17 @@ def notify_worker_ready(driver_addr: str, key: Optional[str],
     dhost, port = driver_addr.rsplit(":", 1)
     BasicClient((dhost, int(port)), key).request(
         WorkerReadyRequest(host, local_rank))
+
+
+def notify_planned_departure(driver_addr: str, key: Optional[str],
+                             host: str, local_rank: int,
+                             step: int = -1) -> None:
+    """Worker-side: announce a preemption-driven departure so the
+    driver treats the coming exit as planned (no blacklist, no
+    quarantine, no death verdict)."""
+    dhost, port = driver_addr.rsplit(":", 1)
+    BasicClient((dhost, int(port)), key, timeout_s=5.0).request(
+        PlannedDepartureRequest(host, local_rank, step))
 
 
 def notify_heartbeat(driver_addr: str, key: Optional[str],

@@ -69,6 +69,12 @@ KNOWN_KNOBS = frozenset({
     # -- perf regression gate (analysis/perf_gate.py, docs/perf_gate.md)
     "HOROVOD_PERF_GATE_TOLERANCE", "HOROVOD_PERF_GATE_OVERLAP_TOLERANCE",
     "HOROVOD_PERF_GATE_WIRE_TOLERANCE",
+    # -- training-state integrity plane (horovod_tpu/guard,
+    #    docs/guardian.md)
+    "HOROVOD_GUARD", "HOROVOD_GUARD_POLICY",
+    "HOROVOD_GUARD_CHECK_INTERVAL", "HOROVOD_GUARD_ZSCORE",
+    "HOROVOD_GUARD_WARMUP_STEPS", "HOROVOD_GUARD_EMA",
+    "HOROVOD_GUARD_PREEMPT",
     # -- health / quarantine / retry / chaos
     "HOROVOD_QUARANTINE_BASE_S", "HOROVOD_QUARANTINE_MAX_S",
     "HOROVOD_QUARANTINE_PROBATION_S", "HOROVOD_QUARANTINE_DISABLE",
@@ -210,6 +216,17 @@ class Config:
     # -- elastic
     elastic_enabled: bool = False
 
+    # -- training-state integrity plane (horovod_tpu/guard,
+    # docs/guardian.md): numerics guardian + replica checksums +
+    # rollback-and-replay + preemption grace
+    guard_enabled: bool = False
+    guard_policy: str = "rollback"       # skip_step | rollback | abort
+    guard_check_interval: int = 10       # replica-checksum cadence (steps)
+    guard_zscore: float = 6.0            # grad-norm spike threshold
+    guard_warmup_steps: int = 10         # steps before spike detection arms
+    guard_ema: float = 0.99              # EMA decay for the norm baseline
+    guard_preempt: bool = True           # SIGTERM graceful-departure handler
+
     # -- chaos (horovod_tpu/faults): the seeded fault plan, parsed and
     # installed at init() — docs/faults.md for the grammar
     fault_plan: Optional[str] = None
@@ -309,6 +326,15 @@ class Config:
                 "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0),
             adasum_num_chunks=_env_int("HOROVOD_ADASUM_NUM_CHUNKS", 1),
             elastic_enabled=_env_bool("HOROVOD_ELASTIC", False),
+            guard_enabled=_env_bool("HOROVOD_GUARD", False),
+            guard_policy=_env_str("HOROVOD_GUARD_POLICY",
+                                  "rollback").lower(),
+            guard_check_interval=_env_int("HOROVOD_GUARD_CHECK_INTERVAL",
+                                          10),
+            guard_zscore=_env_float("HOROVOD_GUARD_ZSCORE", 6.0),
+            guard_warmup_steps=_env_int("HOROVOD_GUARD_WARMUP_STEPS", 10),
+            guard_ema=_env_float("HOROVOD_GUARD_EMA", 0.99),
+            guard_preempt=_env_bool("HOROVOD_GUARD_PREEMPT", True),
             fault_plan=os.environ.get("HOROVOD_FAULT_PLAN"),
             mesh_shape=os.environ.get("HOROVOD_TPU_MESH_SHAPE"),
             fixed_knobs=frozenset(fixed),

@@ -501,3 +501,43 @@ class TestElasticStateThroughAsyncCheckpoint:
             assert state.restore_from_checkpoint() is False
         finally:
             hvd.shutdown()
+
+
+class TestPinAgainstRetention:
+    """The guardian's rollback target must survive retention GC
+    (docs/guardian.md): ``pin`` exempts a step until ``unpin``."""
+
+    def test_pinned_step_survives_gc(self, tmp_path):
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                           max_to_keep=2, use_orbax=False)
+        ckpt.save(0, make_state(0.0))
+        ckpt.pin(0)
+        for s in range(1, 6):                 # push far past max_to_keep
+            ckpt.save(s, make_state(float(s)))
+        assert 0 in ckpt.all_steps()          # the pin held
+        assert sorted(ckpt.all_steps()) == [0, 4, 5]
+        # the pinned step is still restorable, not a husk
+        restored = ckpt.restore(make_state(9.0), step=0)
+        np.testing.assert_allclose(np.asarray(restored["params"]["w"]), 0.0)
+
+    def test_unpin_rejoins_retention(self, tmp_path):
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                           max_to_keep=2, use_orbax=False)
+        ckpt.save(0, make_state(0.0))
+        ckpt.pin(0)
+        for s in range(1, 4):
+            ckpt.save(s, make_state(float(s)))
+        assert 0 in ckpt.all_steps()
+        ckpt.unpin(0)
+        ckpt.save(4, make_state(4.0))         # next GC pass reaps it
+        assert 0 not in ckpt.all_steps()
+        assert ckpt.pinned_steps() == []
+
+    def test_pinned_steps_reports(self, tmp_path):
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                           use_orbax=False)
+        ckpt.pin(3)
+        ckpt.pin(7)
+        assert ckpt.pinned_steps() == [3, 7]
+        ckpt.unpin(3)
+        assert ckpt.pinned_steps() == [7]

@@ -397,3 +397,70 @@ class TestHeartbeatWire:
         worker.report_step(5)
         worker.report_step(3)                     # regression ignored
         assert worker.current_step() >= 5
+
+
+class TestPlannedDepartureDriver:
+    """The driver half of preemption grace (docs/guardian.md): a
+    PlannedDepartureRequest exempts the worker from death verdicts and
+    turns its eventual exit into a graceful one — no quarantine, no
+    failure record, no sibling abort, no spurious job completion."""
+
+    def test_departure_exempts_from_death_verdict(self, monkeypatch):
+        from horovod_tpu.runner.network import PlannedDepartureRequest
+
+        clk = Clock()
+        driver = make_driver({"h1": 1, "h2": 1}, min_np=1,
+                             monkeypatch=monkeypatch, clk=clk)
+        gen0 = driver.generation
+        driver._handle(HeartbeatRequest("h1", 0, 3))
+        driver._handle(HeartbeatRequest("h2", 0, 3))
+        driver._handle(PlannedDepartureRequest("h2", 0, step=3))
+        # h2 now silent far past dead_s: no verdict, no regeneration
+        for t in range(1, 30):
+            clk.t = float(t)
+            driver._handle(HeartbeatRequest("h1", 0, 3 + t))
+            assert driver._health.check() == []
+        assert driver.generation == gen0
+        assert not driver.host_manager.is_blacklisted("h2")
+        driver.stop(0)
+
+    def test_exit_after_departure_is_graceful(self, monkeypatch):
+        from horovod_tpu.runner.network import PlannedDepartureRequest
+
+        driver = make_driver({"h1": 1, "h2": 1}, min_np=1,
+                             monkeypatch=monkeypatch)
+        gen0 = driver.generation
+        driver._handle(PlannedDepartureRequest("h2", 0, step=5))
+        # non-zero exit (SIGTERM's usual 143): neither a failure...
+        driver.record_worker_exit("h2", 0, 143)
+        assert not driver.host_manager.is_blacklisted("h2")
+        assert driver._registry.get_state("h2", 0) != "FAILURE"
+        assert driver.generation == gen0          # no resume queued
+        # ...nor a success that could complete the job mid-training
+        assert not driver._finished.is_set()
+        # the exemption is one-shot: a later exit at the same key goes
+        # through the normal failure path again
+        driver.record_worker_exit("h2", 0, 1)
+        assert driver.host_manager.is_blacklisted("h2")
+        driver.stop(0)
+
+    def test_healthy_peer_skips_departing_and_self(self, monkeypatch):
+        from horovod_tpu.runner.network import (
+            GetHealthyPeerRequest,
+            PlannedDepartureRequest,
+        )
+
+        driver = make_driver({"h1": 1, "h2": 1}, min_np=2,
+                             monkeypatch=monkeypatch)
+        with driver._lock:
+            ranks = {s.rank: k for k, s in driver._assignments.items()}
+            driver._worker_notify_addrs[0] = ("addr0", 1000)
+            driver._worker_notify_addrs[1] = ("addr1", 1001)
+        # diverged rank 1 asks: gets rank 0 (the checkpoint writer)
+        resp = driver._handle(GetHealthyPeerRequest("x", 0, rank=1))
+        assert (resp.rank, resp.address) == (0, ("addr0", 1000))
+        # rank 0 announces departure: no longer offered as a peer
+        driver._handle(PlannedDepartureRequest(*ranks[0]))
+        resp = driver._handle(GetHealthyPeerRequest("x", 0, rank=1))
+        assert resp.rank == -1 and resp.address is None
+        driver.stop(0)

@@ -189,3 +189,84 @@ class TestProcessWidePlan:
             faults.inject("hot.site")
         per_call = (time.perf_counter() - t0) / 100_000
         assert per_call < 5e-6
+
+
+class TestCorruptAction:
+    """The silent-data-corruption action (docs/guardian.md): a seeded
+    single-element perturbation of the value passed to ``inject``."""
+
+    def tree(self):
+        import numpy as np
+
+        return {"w": np.ones((4, 4), np.float32),
+                "b": np.zeros((4,), np.float32)}
+
+    def test_corrupt_perturbs_exactly_one_element(self):
+        import numpy as np
+
+        p = FaultPlan(seed=11).add("s", "corrupt", at=1)
+        out = p.inject("s", value=self.tree())
+        diffs = sum(int((np.asarray(out[k]) != v).sum())
+                    for k, v in self.tree().items())
+        assert diffs == 1
+
+    def test_original_value_untouched(self):
+        import numpy as np
+
+        tree = self.tree()
+        p = FaultPlan(seed=11).add("s", "corrupt", at=1)
+        out = p.inject("s", value=tree)
+        assert out is not tree
+        np.testing.assert_array_equal(tree["w"], 1.0)
+        np.testing.assert_array_equal(tree["b"], 0.0)
+
+    def test_same_plan_same_corruption(self):
+        import numpy as np
+
+        outs = []
+        for _ in range(2):
+            p = FaultPlan(seed=5).add("s", "corrupt", at=1, arg=2.0)
+            outs.append(p.inject("s", value=self.tree()))
+        np.testing.assert_array_equal(outs[0]["w"], outs[1]["w"])
+        np.testing.assert_array_equal(outs[0]["b"], outs[1]["b"])
+
+    def test_different_seed_different_corruption(self):
+        import numpy as np
+
+        a = FaultPlan(seed=5).add("s", "corrupt").inject(
+            "s", value=self.tree())
+        b = FaultPlan(seed=6).add("s", "corrupt").inject(
+            "s", value=self.tree())
+        same = all(np.array_equal(np.asarray(a[k]), np.asarray(b[k]))
+                   for k in a)
+        assert not same
+
+    def test_scale_arg_controls_magnitude(self):
+        import numpy as np
+
+        out = FaultPlan(seed=5).add("s", "corrupt", arg=100.0).inject(
+            "s", value=self.tree())
+        delta = max(float(np.abs(np.asarray(out[k])
+                                 - self.tree()[k]).max()) for k in out)
+        assert delta >= 100.0            # scale * (1 + |x|) >= scale
+
+    def test_dtype_preserved(self):
+        import numpy as np
+
+        tree = {"w": np.ones((4,), np.float16)}
+        out = FaultPlan(seed=5).add("s", "corrupt").inject("s", value=tree)
+        assert out["w"].dtype == np.float16
+
+    def test_no_value_returns_scale(self):
+        # a site called without value= still gets a usable signal
+        p = FaultPlan().add("s", "corrupt", arg=3.5, at=1)
+        assert p.inject("s") == 3.5
+
+    def test_grammar_parses_corrupt(self):
+        s = _parse_clause("guard.params@10:corrupt(1.5)")
+        assert (s.site, s.at, s.action, s.arg) == \
+            ("guard.params", 10, "corrupt", "1.5")
+
+    def test_no_array_leaves_returns_value_unchanged(self):
+        p = FaultPlan(seed=5).add("s", "corrupt", at=1)
+        assert p.inject("s", value={"meta": "tag"}) == {"meta": "tag"}

@@ -1,0 +1,411 @@
+"""hvdguard unit coverage (docs/guardian.md): the numerics guardian's
+EMA baseline and policies, checksum fingerprint/compare determinism and
+bit-flip sensitivity, rollback bookkeeping with checkpoint pinning,
+preemption-grace semantics, the peer-repair RPC round trip, and the
+disabled-path overhead pin."""
+
+import math
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import faults, guard
+from horovod_tpu.guard import (
+    GuardAbort,
+    GuardRollback,
+    NumericsGuardian,
+    PreemptionHandler,
+    ReplicaChecker,
+    RollbackManager,
+    TrainingGuard,
+    compare,
+    fingerprint,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear_plan()
+    guard.clear_guard()
+    yield
+    faults.clear_plan()
+    guard.clear_guard()
+
+
+class TestNumericsGuardian:
+    def test_warmup_limit_is_infinite(self):
+        g = NumericsGuardian(warmup_steps=5)
+        for _ in range(4):
+            assert g.current_limit() == math.inf
+            g.observe(1.0)
+        assert g.current_limit() == math.inf   # 4 < warmup
+        g.observe(1.0)
+        assert math.isfinite(g.current_limit())
+
+    def test_limit_tracks_baseline(self):
+        g = NumericsGuardian(warmup_steps=3, zscore=6.0)
+        for _ in range(20):
+            g.observe(1.0)
+        # flat history at norm 1.0: limit = exp(0 + 6 * std_floor)
+        assert g.current_limit() == pytest.approx(math.exp(6.0 * 0.05))
+
+    def test_nonfinite_detected_even_during_warmup(self):
+        g = NumericsGuardian(policy="skip_step", warmup_steps=100)
+        assert g.observe(float("nan")) == "nonfinite"
+        assert g.observe(float("inf")) == "nonfinite"
+        assert g.anomalies == 2
+
+    def test_spike_detected_after_warmup(self):
+        g = NumericsGuardian(policy="skip_step", warmup_steps=3)
+        for _ in range(10):
+            assert g.observe(1.0) == "ok"
+        assert g.observe(100.0) == "spike"
+
+    def test_anomaly_never_poisons_baseline(self):
+        g = NumericsGuardian(policy="skip_step", warmup_steps=3)
+        for _ in range(10):
+            g.observe(1.0)
+        limit = g.current_limit()
+        n = g.observed_steps
+        g.observe(float("nan"))
+        g.observe(limit * 10)
+        assert g.observed_steps == n           # anomalies not counted
+        assert g.current_limit() == limit      # baseline unchanged
+
+    def test_rollback_policy_raises(self):
+        g = NumericsGuardian(policy="rollback", warmup_steps=1)
+        g.observe(1.0)
+        with pytest.raises(GuardRollback) as ei:
+            g.observe(float("nan"))
+        assert ei.value.kind == "nonfinite"
+
+    def test_abort_policy_raises(self):
+        g = NumericsGuardian(policy="abort", warmup_steps=1)
+        with pytest.raises(GuardAbort):
+            g.observe(float("inf"))
+
+    def test_bad_policy_rejected(self):
+        with pytest.raises(ValueError, match="policy"):
+            NumericsGuardian(policy="ignore")
+        with pytest.raises(ValueError, match="ema"):
+            NumericsGuardian(ema=1.0)
+
+    def test_explicit_limit_overrides_baseline(self):
+        # the step ran with a stale limit (host baseline moved after
+        # dispatch): the verdict must judge against what the step used
+        g = NumericsGuardian(policy="skip_step", warmup_steps=1)
+        g.observe(1.0)
+        assert g.observe(5.0, limit=10.0) == "ok"
+        assert g.observe(5.0, limit=2.0) == "spike"
+
+
+class TestChecksum:
+    def tree(self, v=1.0):
+        return {"w": np.full((8, 8), v, np.float32),
+                "b": np.arange(8, dtype=np.float32),
+                "step": 7}
+
+    def test_equal_trees_agree(self):
+        assert fingerprint(self.tree()) == fingerprint(self.tree())
+
+    def test_single_bit_flip_changes_fingerprint(self):
+        a = self.tree()
+        b = self.tree()
+        raw = b["w"].view(np.uint32)
+        raw[3, 3] ^= 1                      # one mantissa bit
+        assert fingerprint(a) != fingerprint(b)
+
+    def test_nan_payload_bits_distinguished(self):
+        # equality-based comparison would call two NaNs equal; the
+        # byte-level fingerprint must not
+        a = np.array([float("nan")], np.float32)
+        b = a.copy()
+        b.view(np.uint32)[0] ^= 1           # different NaN payload
+        assert fingerprint({"x": a}) != fingerprint({"x": b})
+
+    def test_order_sensitivity(self):
+        a = np.array([1.0, 2.0], np.float32)
+        b = np.array([2.0, 1.0], np.float32)
+        assert fingerprint({"x": a}) != fingerprint({"x": b})
+
+    def test_compare_names_minority(self):
+        f = fingerprint(self.tree())
+        g = fingerprint(self.tree(2.0))
+        assert compare([f, f, f, f]) == []
+        assert compare([f, f, g, f]) == [2]
+        assert compare([g, f, f]) == [0]
+
+    def test_two_rank_tie_names_rank_one(self):
+        # rank 0 is the checkpoint writer — recovery treats it as the
+        # reference copy, so a 1v1 tie must name rank 1
+        f = fingerprint(self.tree())
+        g = fingerprint(self.tree(2.0))
+        assert compare([f, g]) == [1]
+
+    def test_checker_cadence(self):
+        c = ReplicaChecker(interval=3)
+        assert [s for s in range(1, 10) if c.due(s)] == [3, 6, 9]
+        assert not ReplicaChecker(interval=0).due(10)
+
+    def test_checker_reports_diverged_rank(self):
+        trees = [self.tree(), self.tree(), self.tree(5.0)]
+        fps = [fingerprint(t) for t in trees]
+        c = ReplicaChecker(interval=1, gather_fn=lambda fp: fps)
+        report = c.check(3, trees[0])
+        assert report is not None and report.diverged == [2]
+        assert report.rank == 2 and report.step == 3
+
+    def test_checker_clean_returns_none(self):
+        c = ReplicaChecker(interval=1,
+                           gather_fn=lambda fp: [fp, fp, fp, fp])
+        assert c.check(5, self.tree()) is None
+
+
+class TestRollbackManager:
+    EVERY = 2
+
+    def make_state(self, tmp_path):
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                           max_to_keep=2, use_orbax=False)
+        return hvd.elastic.TpuState(
+            params={"w": np.zeros((4,), np.float32)},
+            checkpointer=ckpt, checkpoint_every=self.EVERY)
+
+    def run_to(self, state, rb, steps, verify_at=()):
+        for _ in range(steps):
+            step = state._commit_count + 1
+            state.params = {"w": np.full((4,), float(step), np.float32)}
+            state.commit()
+            rb.note_commit()
+            if step in verify_at:
+                rb.note_verified(step)
+        state.wait()
+
+    def test_note_commit_tracks_checkpoint_steps(self, tmp_path):
+        state = self.make_state(tmp_path)
+        rb = RollbackManager(state)
+        self.run_to(state, rb, 5)
+        assert rb.last_checkpoint_step == 4    # 5 % EVERY != 0
+        assert rb.last_good_step is None       # nothing verified yet
+
+    def test_note_verified_promotes_and_pins(self, tmp_path):
+        state = self.make_state(tmp_path)
+        rb = RollbackManager(state)
+        self.run_to(state, rb, 5, verify_at=(4,))
+        assert rb.last_good_step == 4
+        assert state._checkpointer.pinned_steps() == [4]
+        # a newer verified checkpoint takes the pin over
+        self.run_to(state, rb, 1, verify_at=(6,))
+        assert rb.last_good_step == 6
+        assert state._checkpointer.pinned_steps() == [6]
+
+    def test_verified_older_than_checkpoint_is_ignored(self, tmp_path):
+        state = self.make_state(tmp_path)
+        rb = RollbackManager(state)
+        self.run_to(state, rb, 4)
+        rb.note_verified(3)                    # checkpoint 4 is newer
+        assert rb.last_good_step is None
+
+    def test_rollback_restores_and_counts_replay(self, tmp_path):
+        state = self.make_state(tmp_path)
+        positions = {}
+        rb = RollbackManager(state,
+                             dataset_state_fn=lambda s: positions.get(s))
+        positions.update({2: "pos@2", 4: "pos@4", 6: "pos@6"})
+        self.run_to(state, rb, 7, verify_at=(4,))
+        replayed = rb.rollback(reason="test")
+        assert replayed == 3                   # 7 -> 4
+        assert state._commit_count == 4
+        np.testing.assert_allclose(np.asarray(state.params["w"]), 4.0)
+        assert rb.last_data_position == "pos@4"
+        assert rb.rollbacks == 1
+
+    def test_rollback_without_verification_uses_last_checkpoint(
+            self, tmp_path):
+        state = self.make_state(tmp_path)
+        rb = RollbackManager(state)
+        self.run_to(state, rb, 3)
+        assert rb.rollback() == 1              # 3 -> 2 (unverified)
+        assert state._commit_count == 2
+
+    def test_rollback_with_no_checkpoint_raises(self):
+        state = hvd.elastic.TpuState(
+            params={"w": np.zeros((2,), np.float32)})
+        rb = RollbackManager(state)
+        state.commit()
+        with pytest.raises(RuntimeError, match="no checkpoint"):
+            rb.rollback()
+
+
+class TestPreemptionHandler:
+    def test_drain_commit_notify_sequence(self):
+        events = []
+        h = PreemptionHandler(lambda: events.append("commit"),
+                              notify_fn=lambda: events.append("notify"))
+        assert not h.draining
+        assert not h.finalize()                # nothing requested
+        h.request()
+        assert h.draining
+        assert h.finalize()
+        assert events == ["commit", "notify"]
+
+    def test_finalize_is_idempotent(self):
+        commits = []
+        h = PreemptionHandler(lambda: commits.append(1))
+        h.request()
+        assert h.finalize()
+        assert not h.finalize()
+        assert len(commits) == 1
+
+    def test_notify_failure_does_not_lose_commit(self):
+        commits = []
+
+        def bad_notify():
+            raise OSError("driver gone")
+
+        h = PreemptionHandler(lambda: commits.append(1),
+                              notify_fn=bad_notify)
+        h.request()
+        assert h.finalize()                    # commit landed anyway
+        assert len(commits) == 1
+
+    def test_install_uninstall_restores_prior_handler(self):
+        import signal
+
+        prev = signal.getsignal(signal.SIGTERM)
+        h = PreemptionHandler(lambda: None).install()
+        assert signal.getsignal(signal.SIGTERM) == h._on_signal
+        h.uninstall()
+        assert signal.getsignal(signal.SIGTERM) == prev
+
+    def test_chaos_site_fires_in_finalize(self):
+        faults.set_plan(faults.FaultPlan(sim=True).add(
+            "worker.preempt", "raise", "OSError"))
+        h = PreemptionHandler(lambda: None)
+        h.request()
+        with pytest.raises(OSError):
+            h.finalize()
+
+
+class TestPeerRepairRPC:
+    """The FetchStateRequest round trip over a real NotificationServer
+    (the wire a diverged worker repairs through)."""
+
+    KEY = "test-secret"
+
+    def serve(self, provider):
+        from horovod_tpu.elastic.worker import WorkerNotificationManager
+        from horovod_tpu.runner.network import NotificationServer
+
+        mgr = WorkerNotificationManager()
+        mgr.set_state_provider(provider)
+        server = NotificationServer(mgr, self.KEY)
+        server.start()
+        return server
+
+    def test_fetch_committed_snapshot(self):
+        from horovod_tpu.guard.repair import fetch_peer_state
+
+        snap = {"w": np.arange(4, dtype=np.float32)}
+        server = self.serve(lambda: (11, snap))
+        try:
+            addr = ("127.0.0.1", server.address[1])
+            got = fetch_peer_state(addr, self.KEY)
+            assert got is not None and got[0] == 11
+            np.testing.assert_array_equal(got[1]["w"], snap["w"])
+        finally:
+            server.shutdown()
+
+    def test_no_provider_returns_none(self):
+        from horovod_tpu.elastic.worker import WorkerNotificationManager
+        from horovod_tpu.guard.repair import fetch_peer_state
+        from horovod_tpu.runner.network import NotificationServer
+
+        server = NotificationServer(WorkerNotificationManager(), self.KEY)
+        server.start()
+        try:
+            addr = ("127.0.0.1", server.address[1])
+            assert fetch_peer_state(addr, self.KEY) is None
+        finally:
+            server.shutdown()
+
+    def test_repair_chaos_site_fires(self):
+        from horovod_tpu.guard.repair import fetch_peer_state
+
+        faults.set_plan(faults.FaultPlan(sim=True).add(
+            "guard.repair", "raise", "ConnectionResetError"))
+        with pytest.raises(ConnectionResetError):
+            fetch_peer_state(("127.0.0.1", 1), self.KEY)
+
+
+class TestTrainingGuard:
+    def test_from_config_off_returns_none(self):
+        cfg = hvd.runtime.Config()
+        assert TrainingGuard.from_config(cfg) is None
+
+    def test_from_config_builds_wired_guard(self, tmp_path):
+        cfg = hvd.runtime.Config(guard_enabled=True, guard_policy="abort",
+                                 guard_check_interval=7, guard_zscore=4.0)
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                           use_orbax=False)
+        state = hvd.elastic.TpuState(params={"w": np.zeros(2)},
+                                     checkpointer=ckpt)
+        g = TrainingGuard.from_config(cfg, state=state)
+        assert g is not None and g.policy == "abort"
+        assert g.checker.interval == 7
+        assert g.numerics.zscore == 4.0
+        assert g.rollback_mgr is not None
+
+    def test_check_replicas_raises_on_divergence(self):
+        fps = []
+        g = TrainingGuard(check_interval=2,
+                          gather_fn=lambda fp: fps)
+        params = {"w": np.ones(4, np.float32)}
+        fps.extend([fingerprint(params),
+                    fingerprint({"w": np.zeros(4, np.float32)})])
+        assert g.check_replicas(1, params) is params   # not due
+        with pytest.raises(GuardRollback, match="rank 1 diverged"):
+            g.check_replicas(2, params)
+
+    def test_corrupt_chaos_replaces_params(self):
+        faults.set_plan(faults.FaultPlan(seed=3, sim=True).add(
+            "guard.params", "corrupt", arg=2.0, at=1))
+        g = TrainingGuard(check_interval=0)
+        params = {"w": np.ones(4, np.float32)}
+        out = g.check_replicas(1, params)
+        assert out is not params
+        assert not np.array_equal(out["w"], params["w"])
+
+    def test_rollback_without_manager_raises(self):
+        with pytest.raises(RuntimeError, match="RollbackManager"):
+            TrainingGuard().rollback()
+
+
+class TestModuleHook:
+    def test_disabled_check_is_noop(self):
+        assert guard.active_guard() is None
+        assert guard.check(123) is None
+
+    def test_armed_check_dispatches(self):
+        fps = []
+        g = guard.set_guard(TrainingGuard(check_interval=1,
+                                          gather_fn=lambda fp: fps))
+        assert guard.active_guard() is g
+        params = {"w": np.ones(2, np.float32)}
+        fps[:] = [fingerprint(params), fingerprint(params)]
+        assert guard.check(1, params) is params
+        guard.clear_guard()
+        assert guard.active_guard() is None
+
+    def test_disabled_check_is_cheap(self):
+        # the hook sits on the per-step hot path: when no guard is
+        # armed it must be one global None test (same contract and
+        # same pin as faults.inject — docs/guardian.md)
+        guard.clear_guard()
+        t0 = time.perf_counter()
+        for i in range(100_000):
+            guard.check(i)
+        per_call = (time.perf_counter() - t0) / 100_000
+        assert per_call < 5e-6

@@ -185,3 +185,52 @@ class TestBookkeeping:
     def test_dead_s_defaults_to_ten_intervals(self):
         mon = make_monitor(Clock(), [], interval_s=2.0, dead_s=None)
         assert mon.dead_s == 20.0
+
+
+class TestPlannedDeparture:
+    """Preemption grace (guard/preempt.py, docs/guardian.md): a worker
+    that announced a planned departure is exempt from death verdicts —
+    silence is expected, straggler beats must not re-enroll it."""
+
+    def test_departing_worker_never_declared_dead(self):
+        clk, deaths = Clock(), []
+        mon = make_monitor(clk, deaths, dead_s=10.0)
+        mon.record_heartbeat("h1", 0, step=1)
+        mon.record_heartbeat("h2", 0, step=1)
+        clk.t = 1.0
+        mon.mark_departing("h2", 0)
+        assert mon.is_departing("h2", 0)
+        for t in range(2, 40):             # far past dead_s of silence
+            clk.t = float(t)
+            mon.record_heartbeat("h1", 0, step=t)
+            assert mon.check() == []
+        assert deaths == []
+
+    def test_straggler_beat_does_not_reenroll(self):
+        clk, deaths = Clock(), []
+        mon = make_monitor(clk, deaths, dead_s=10.0)
+        mon.record_heartbeat("h1", 0, step=5)
+        mon.mark_departing("h1", 0)
+        # a beat already in flight when the drain started arrives late
+        mon.record_heartbeat("h1", 0, step=6)
+        clk.t = 100.0                      # would be dead if re-enrolled
+        assert mon.check() == []
+        assert deaths == []
+        assert mon.max_step() == -1        # not monitored at all
+
+    def test_forget_clears_departing_mark(self):
+        mon = make_monitor(Clock(), [])
+        mon.mark_departing("h1", 0)
+        mon.forget("h1", 0)
+        assert not mon.is_departing("h1", 0)
+        # fresh enrollment works again (e.g. the host came back later)
+        mon.record_heartbeat("h1", 0)
+        assert mon.max_step() == -1
+
+    def test_purge_drops_unassigned_departing(self):
+        mon = make_monitor(Clock(), [])
+        mon.mark_departing("h1", 0)
+        mon.mark_departing("h2", 0)
+        mon.purge({("h2", 0)})             # h1 left the assignment
+        assert not mon.is_departing("h1", 0)
+        assert mon.is_departing("h2", 0)
