@@ -992,6 +992,162 @@ def run_chaos(args, hvd):
     }
 
 
+def run_serve(args, hvd):
+    """``--serve``: the serving-plane SLO probe (docs/serving.md).
+
+    A seeded open-loop traffic generator (arrivals march at
+    ``--serve-rps`` regardless of completions) drives the real
+    admission queue → continuous batcher → replica pool stack on a
+    logical clock the fake executor advances, so every latency is a
+    pure function of the seed:
+
+    * the **baseline** pass emits ``serve_p50_latency_s`` /
+      ``serve_p99_latency_s`` / ``serve_throughput_rps`` — the fields
+      the perf gate diffs (PERF001/PERF005) under the
+      ``serve_offered_rps`` comparability key;
+    * the **chaos** pass kills one replica mid-load through the
+      ``serve.batch`` fault site and asserts the exactly-once
+      contract: zero lost responses, zero duplicated responses, every
+      in-flight request re-executed exactly once, graceful drain for
+      the survivor, and p99 inflation bounded by
+      ``--serve-p99-inflation-max``;
+    * both passes run **twice**; ``serve_deterministic`` is the
+      bit-identity of the full result dicts.
+    """
+    import numpy as np
+
+    from horovod_tpu import faults
+    from horovod_tpu.faults import FaultPlan
+    from horovod_tpu.serve import (
+        ADMITTED,
+        AdmissionQueue,
+        ContinuousBatcher,
+        InferenceRequest,
+        Replica,
+        ReplicaPool,
+    )
+
+    seed = args.serve_seed
+    n_requests = args.serve_requests
+    rps = float(args.serve_rps)
+    max_batch = args.serve_max_batch
+
+    def scenario(crash_at=None):
+        plan = None
+        if crash_at is not None:
+            plan = FaultPlan(seed=seed, sim=True).add(
+                "serve.batch", "crash", at=crash_at)
+            faults.set_plan(plan)
+        try:
+            now = [0.0]
+
+            def clock():
+                return now[0]
+
+            def executor(payloads):
+                # service time is a pure function of occupancy: the
+                # logical clock makes every latency seeded-deterministic
+                now[0] += 0.004 + 0.001 * len(payloads)
+                return [round(float(np.asarray(p).sum()), 6)
+                        for p in payloads]
+
+            queue = AdmissionQueue(depth=max(2 * n_requests, 64),
+                                   clock=clock)
+            pool = ReplicaPool(queue, drain_timeout_s=1.0, clock=clock)
+            replicas = [pool.add_replica(
+                Replica(f"r{i}", executor, host=f"serve-host-{i}",
+                        clock=clock)) for i in range(2)]
+
+            got = {}
+            batcher = ContinuousBatcher(
+                queue, pool, max_batch=max_batch, clock=clock,
+                on_response=lambda r: got.setdefault(
+                    r.request_id, []).append((r.latency_s, r.requeues)))
+
+            rng = np.random.RandomState(seed)
+            payloads = [rng.rand(8).astype(np.float32)
+                        for _ in range(n_requests)]
+            arrivals = [i / rps for i in range(n_requests)]
+            admitted = []
+            i = 0
+            # open-loop: the next arrival is due at its precomputed
+            # time whether or not the pool keeps up; between arrivals
+            # the batcher drains, and an idle queue fast-forwards the
+            # clock to the next arrival
+            while i < n_requests or len(queue):
+                if i < n_requests and now[0] >= arrivals[i]:
+                    req = InferenceRequest(
+                        request_id=f"req-{i:04d}", payload=payloads[i],
+                        arrival_s=arrivals[i],
+                        deadline_s=arrivals[i] + 2.0)
+                    if queue.submit(req) == ADMITTED:
+                        admitted.append(req.request_id)
+                    i += 1
+                    continue
+                if len(queue) and pool.serving_count():
+                    batcher.step()
+                    continue
+                if i < n_requests:
+                    now[0] = arrivals[i]
+                    continue
+                break
+            drains = [pool.drain(r) for r in pool.replicas() if r.alive]
+            lat = sorted(ls[0][0] for ls in got.values() if ls)
+            makespan = max(now[0], 1e-9)
+            return {
+                "admitted": len(admitted),
+                "lost": len(set(admitted) - set(got)),
+                "duplicates": sum(1 for ls in got.values()
+                                  if len(ls) != 1),
+                "requeued": sum(1 for ls in got.values()
+                                if any(r > 0 for _, r in ls)),
+                "p50": round(float(np.percentile(lat, 50)), 6)
+                if lat else None,
+                "p99": round(float(np.percentile(lat, 99)), 6)
+                if lat else None,
+                "throughput_rps": round(len(got) / makespan, 3),
+                "drains": drains,
+                "states": sorted(r.state for r in replicas),
+                "makespan_s": round(makespan, 6),
+            }
+        finally:
+            if plan is not None:
+                faults.clear_plan()
+
+    crash_at = max(2, n_requests // (2 * max_batch))
+    base1, base2 = scenario(), scenario()
+    chaos1, chaos2 = scenario(crash_at=crash_at), scenario(crash_at=crash_at)
+    deterministic = base1 == base2 and chaos1 == chaos2
+
+    inflation = round(chaos1["p99"] / base1["p99"], 4) \
+        if base1["p99"] else None
+    ok = (deterministic
+          and base1["lost"] == 0 and base1["duplicates"] == 0
+          and chaos1["lost"] == 0 and chaos1["duplicates"] == 0
+          and chaos1["requeued"] > 0
+          and all(chaos1["drains"])
+          and inflation is not None
+          and inflation <= args.serve_p99_inflation_max)
+    return {
+        "metric": "serve",
+        "ok": ok,
+        "serve_offered_rps": rps,
+        "serve_requests": n_requests,
+        "serve_max_batch": max_batch,
+        "serve_admitted": base1["admitted"],
+        "serve_p50_latency_s": base1["p50"],
+        "serve_p99_latency_s": base1["p99"],
+        "serve_throughput_rps": base1["throughput_rps"],
+        "serve_deterministic": deterministic,
+        "serve_chaos_lost": chaos1["lost"],
+        "serve_chaos_duplicates": chaos1["duplicates"],
+        "serve_chaos_requeued": chaos1["requeued"],
+        "serve_chaos_p99_latency_s": chaos1["p99"],
+        "serve_chaos_p99_inflation": inflation,
+        "serve_chaos_drain_graceful": all(chaos1["drains"]),
+    }
+
+
 def run_autotune(args, hvd):
     """``--autotune``: tune the jit-path knobs that set the BENCH
     numbers (steps_per_call, flash block) against the measured rate —
@@ -1268,6 +1424,25 @@ def main():
                         "bounded by this")
     p.add_argument("--chaos-seed", type=int, default=42,
                    help="FaultPlan / data seed for the chaos scenario")
+    p.add_argument("--serve", action="store_true",
+                   help="run the serving-plane SLO probe instead of the "
+                        "training bench: a seeded open-loop generator "
+                        "through the admission queue / batcher / "
+                        "replica pool, plus the replica-kill chaos "
+                        "variant (docs/serving.md)")
+    p.add_argument("--serve-requests", type=int, default=64,
+                   help="requests per --serve pass")
+    p.add_argument("--serve-rps", type=float, default=400.0,
+                   help="offered open-loop arrival rate (logical "
+                        "clock); also the PERF001/PERF005 "
+                        "comparability key")
+    p.add_argument("--serve-max-batch", type=int, default=4,
+                   help="continuous-batcher packing limit for --serve")
+    p.add_argument("--serve-seed", type=int, default=42,
+                   help="traffic / FaultPlan seed for --serve")
+    p.add_argument("--serve-p99-inflation-max", type=float, default=5.0,
+                   help="chaos-variant p99 may inflate at most this "
+                        "factor over the fault-free pass")
     p.add_argument("--autotune", action="store_true",
                    help="tune the jit-path throughput knobs "
                         "(steps_per_call; flash block for the "
@@ -1305,6 +1480,11 @@ def main():
     telemetry.run_context().update()
     if args.chaos:
         emit(dict(run_chaos(args, hvd), **artifact_metadata(hvd),
+                  **telemetry_fields()),
+             args.json_out)
+        return
+    if args.serve:
+        emit(dict(run_serve(args, hvd), **artifact_metadata(hvd),
                   **telemetry_fields()),
              args.json_out)
         return

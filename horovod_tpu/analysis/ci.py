@@ -1,7 +1,7 @@
 """``python -m horovod_tpu.analysis ci`` / ``hvdci`` — the one-shot CI
 entry point.
 
-Four gates, one invocation, one exit code (docs/perf_gate.md):
+Five gates, one invocation, one exit code (docs/perf_gate.md):
 
 1. **hvdlint** over the pre-commit scope (``--changed``: staged +
    unstaged + untracked files under ``horovod_tpu/``; falls back to the
@@ -12,7 +12,10 @@ Four gates, one invocation, one exit code (docs/perf_gate.md):
 3. the **perf gate** trajectory self-walk;
 4. the **guard-chaos smoke** (``guard/smoke.py``): a seeded silent-
    corruption → detect → rollback → replay round trip, run twice and
-   required bit-identical (docs/guardian.md).
+   required bit-identical (docs/guardian.md);
+5. the **serve-chaos smoke** (``serve/smoke.py``): the serving plane's
+   enqueue → batch → kill-replica → requeue → drain loop, seeded, run
+   twice and required bit-identical (docs/serving.md).
 
 The whole run is a tier-1 test with the same <30 s budget as the
 hvdlint self-run, so "CI passed" and "the analysis suite passed" are
@@ -104,11 +107,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     except Exception as e:          # noqa: BLE001 — a crash IS a failure
         guard_errors = [f"guard-smoke crashed: {type(e).__name__}: {e}"]
 
+    # 5 — serve-chaos smoke: the serving plane's crash→requeue→drain
+    # loop, seeded and deterministic (sub-second, CPU-only)
+    try:
+        from horovod_tpu.serve.smoke import run_smoke as run_serve_smoke
+
+        serve_errors = run_serve_smoke()
+    except Exception as e:          # noqa: BLE001 — a crash IS a failure
+        serve_errors = [f"serve-smoke crashed: {type(e).__name__}: {e}"]
+
     elapsed = time.perf_counter() - t0
     gate_findings = gate.findings if gate is not None else []
     rc = 2 if (art_error or gate_error) else (
         1 if (lint.findings or art_findings or gate_findings
-              or metrics_errors or guard_errors) else 0)
+              or metrics_errors or guard_errors or serve_errors) else 0)
 
     if args.json_out:
         print(json.dumps({
@@ -116,6 +128,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "artifact_findings": [f.as_json() for f in art_findings],
             "metrics_schema_errors": metrics_errors,
             "guard_smoke_errors": guard_errors,
+            "serve_smoke_errors": serve_errors,
             "perf_gate": gate.as_json() if gate is not None else None,
             "errors": [e for e in (art_error, gate_error) if e],
             "elapsed_s": round(elapsed, 3),
@@ -131,6 +144,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"hvdci: metrics-schema: {e}")
     for e in guard_errors:
         print(f"hvdci: guard-smoke: {e}")
+    for e in serve_errors:
+        print(f"hvdci: serve-smoke: {e}")
     for f in gate_findings:
         print(f.format())
     for err in (art_error, gate_error):
@@ -140,7 +155,8 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"artifacts[{len(artifacts)}] "
           f"{len(art_findings) + len(metrics_errors)} · "
           f"perf-gate {len(gate_findings)} · "
-          f"guard-smoke {len(guard_errors)} finding(s) "
+          f"guard-smoke {len(guard_errors)} · "
+          f"serve-smoke {len(serve_errors)} finding(s) "
           f"in {elapsed:.2f}s — {'FAIL' if rc else 'ok'}")
     return rc
 

@@ -46,6 +46,24 @@ GUARD_SERIES = frozenset({
     "hvd_guard_preempt_drains_total",
 })
 
+# the serving plane's closed series vocabulary (docs/serving.md): same
+# contract as GUARD_SERIES for the hvd_serve_* namespace
+SERVE_SERIES = frozenset({
+    "hvd_serve_queue_depth",
+    "hvd_serve_admitted_total",
+    "hvd_serve_shed_total",
+    "hvd_serve_completed_total",
+    "hvd_serve_requeued_total",
+    "hvd_serve_batches_total",
+    "hvd_serve_batch_occupancy",
+    "hvd_serve_latency_seconds",
+    "hvd_serve_replicas",
+    "hvd_serve_replica_deaths_total",
+    "hvd_serve_drains_total",
+    "hvd_serve_drain_timeouts_total",
+    "hvd_serve_scale_events_total",
+})
+
 
 def _check_guard_series(errors: List[str], obj, field: str) -> None:
     if not isinstance(obj, dict):
@@ -57,6 +75,18 @@ def _check_guard_series(errors: List[str], obj, field: str) -> None:
                 errors.append(
                     f"{field}[{k!r}]: unknown guard series {base!r} — "
                     f"not in metrics_schema.GUARD_SERIES")
+
+
+def _check_serve_series(errors: List[str], obj, field: str) -> None:
+    if not isinstance(obj, dict):
+        return      # shape error already reported by _check_series_map
+    for k in obj:
+        if isinstance(k, str) and k.startswith("hvd_serve"):
+            base = k.split("{", 1)[0]
+            if base not in SERVE_SERIES:
+                errors.append(
+                    f"{field}[{k!r}]: unknown serve series {base!r} — "
+                    f"not in metrics_schema.SERVE_SERIES")
 
 
 def _check_series_map(errors: List[str], obj, field: str) -> None:
@@ -128,6 +158,9 @@ def validate_snapshot(obj: Dict) -> List[str]:
     _check_guard_series(errors, obj.get("counters", {}), "counters")
     _check_guard_series(errors, obj.get("gauges", {}), "gauges")
     _check_guard_series(errors, obj.get("histograms", {}), "histograms")
+    _check_serve_series(errors, obj.get("counters", {}), "counters")
+    _check_serve_series(errors, obj.get("gauges", {}), "gauges")
+    _check_serve_series(errors, obj.get("histograms", {}), "histograms")
     return errors
 
 
@@ -142,6 +175,7 @@ def validate_bench_metrics(obj: Dict) -> List[str]:
                       f"{SCHEMA_VERSION}, got {obj.get('schema_version')!r}")
     _check_series_map(errors, obj.get("counters", {}), "metrics.counters")
     _check_guard_series(errors, obj.get("counters", {}), "metrics.counters")
+    _check_serve_series(errors, obj.get("counters", {}), "metrics.counters")
     return errors
 
 

@@ -75,6 +75,15 @@ THROUGHPUT_FIELDS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("transformer_tokens_per_sec", ("transformer_params_m",)),
     ("moe_tokens_per_sec", ("moe_params_m",)),
     ("vit_img_sec_per_chip", ("vit_params_m",)),
+    ("serve_throughput_rps", ("serve_offered_rps",)),
+)
+
+#: latency (lower-is-better) fields and their comparability keys —
+#: PERF005 fails on *growth* beyond the throughput tolerance, so
+#: ``bench.py --serve`` tail latency is gateable like throughput
+LATENCY_FIELDS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("serve_p50_latency_s", ("serve_offered_rps",)),
+    ("serve_p99_latency_s", ("serve_offered_rps",)),
 )
 
 
@@ -270,6 +279,32 @@ def diff(baseline: Sequence[Artifact], candidate: Artifact,
                 f"{drop * 100:.1f}% ({cand_v:g} vs {ref:g} in "
                 f"{ref_name}; tolerance "
                 f"{tol.throughput * 100:.0f}%)"))
+
+    # PERF005 — latency (lower is better): growth beyond the
+    # throughput tolerance vs the best (lowest) comparable baseline
+    for field, keys in LATENCY_FIELDS:
+        cand_v = _numeric(candidate.get(field))
+        if cand_v is None:
+            continue
+        best = None
+        for base in baseline:
+            base_v = _numeric(base.get(field))
+            if base_v is None or not _keys_match(base, candidate, keys):
+                continue
+            if best is None or base_v < best[0]:
+                best = (base_v, base.name)
+        if best is None:
+            continue
+        ref, ref_name = best
+        if ref > 0 and cand_v > (1.0 + tol.throughput) * ref:
+            growth = (cand_v - ref) / ref
+            findings.append(GateFinding(
+                "PERF005",
+                f"{candidate.name}: {field} inflated "
+                f"{growth * 100:.1f}% ({cand_v:g} vs {ref:g} in "
+                f"{ref_name}; tolerance "
+                f"{tol.throughput * 100:.0f}%) — tail latency "
+                f"regressed under the same offered load"))
 
     # PERF002 — measured overlap
     for key in sorted(candidate.fields):

@@ -189,20 +189,8 @@ class ElasticDriver:
             self._check_generation_ready()
             return AckResponse()
         if isinstance(req, PlannedDepartureRequest):
-            # preemption grace (guard/preempt.py): the worker has
-            # committed (or is committing) a priority checkpoint and
-            # will exit.  Exempt it from death verdicts now; its exit
-            # is handled as graceful in record_worker_exit.
-            self._health.mark_departing(req.host, req.local_rank)
-            with self._lock:
-                self._planned_departures.add((req.host, req.local_rank))
-            telemetry.counter(
-                "hvd_guard_preempt_departures_total",
-                "planned (preemption-grace) departures announced").inc()
-            hvd_logging.info(
-                "elastic: worker %s:%d announced a planned departure at "
-                "step %d — exempt from death verdicts and quarantine",
-                req.host, req.local_rank, getattr(req, "step", -1))
+            self.announce_departure(req.host, req.local_rank,
+                                    step=getattr(req, "step", -1))
             return AckResponse()
         if isinstance(req, GetHealthyPeerRequest):
             # peer repair (guard/repair.py): hand the diverged worker a
@@ -330,6 +318,25 @@ class ElasticDriver:
             "elastic: generation %d fully ready — %d worker(s) in "
             "recovery_s=%.1f%s", gen, len(keys), recovery_s, detect)
 
+    def announce_departure(self, host: str, local_rank: int,
+                           step: int = -1) -> None:
+        """A planned (preemption-grace or serve-drain) departure: the
+        worker has committed (or is committing) its state and will
+        exit.  Exempt it from death verdicts now; its exit is handled
+        as graceful in :meth:`record_worker_exit` — no blacklist, no
+        quarantine, no sibling abort (guard/preempt.py, serve/pool.py).
+        """
+        self._health.mark_departing(host, local_rank)
+        with self._lock:
+            self._planned_departures.add((host, local_rank))
+        telemetry.counter(
+            "hvd_guard_preempt_departures_total",
+            "planned (preemption-grace) departures announced").inc()
+        hvd_logging.info(
+            "elastic: worker %s:%d announced a planned departure at "
+            "step %d — exempt from death verdicts and quarantine",
+            host, local_rank, step)
+
     def _on_worker_dead(self, host: str, local_rank: int,
                         detect_s: float, reason: str) -> None:
         """Health-monitor verdict: treat as a failure exit NOW — the
@@ -338,6 +345,12 @@ class ElasticDriver:
         abort event kills the tree)."""
         if self._shutdown.is_set():
             return    # completed/stopped job: silence is expected
+        if "departure" in reason:
+            # the planned-departure grace expired: the worker announced
+            # but wedged instead of exiting.  Revoke the graceful-exit
+            # exemption so this takes the normal failure path
+            with self._lock:
+                self._planned_departures.discard((host, local_rank))
         # the pre-failure training peak, for the generation_history
         # steps_lost estimate (monitor lock first, ours second — the
         # same one-way order _check_generation_ready uses)

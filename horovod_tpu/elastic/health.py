@@ -26,9 +26,11 @@ monitor deterministically on a fake clock.
 
 Knobs: ``HOROVOD_ELASTIC_HEARTBEAT_INTERVAL`` (seconds between worker
 beats, 0 disables the subsystem), ``HOROVOD_ELASTIC_HEARTBEAT_SUSPECT_
-MISSES``, ``HOROVOD_ELASTIC_HEARTBEAT_DEAD_S``, and
+MISSES``, ``HOROVOD_ELASTIC_HEARTBEAT_DEAD_S``,
 ``HOROVOD_ELASTIC_PROGRESS_TIMEOUT_S`` (0 disables the progress
-detector).  See docs/running.md.
+detector), and ``HOROVOD_ELASTIC_DEPART_GRACE_S`` (how long an
+announced planned departure may linger before the wedged worker falls
+back to the normal dead-worker path).  See docs/running.md.
 """
 
 from __future__ import annotations
@@ -45,6 +47,7 @@ from horovod_tpu.utils.stall import ProgressWatchdog
 DEFAULT_INTERVAL_S = 2.0
 DEFAULT_SUSPECT_MISSES = 3
 DEFAULT_DEAD_MULTIPLE = 10     # dead_s default = interval * this
+DEFAULT_DEPART_GRACE_MULTIPLE = 3   # depart_grace_s default = dead_s * this
 
 # health-plane telemetry (docs/metrics.md): what used to exist only as
 # log lines.  Heartbeat age + progress stall are the precursors
@@ -87,6 +90,7 @@ class HealthMonitor:
                  suspect_misses: int = DEFAULT_SUSPECT_MISSES,
                  dead_s: Optional[float] = None,
                  progress_timeout_s: float = 0.0,
+                 depart_grace_s: Optional[float] = None,
                  clock: Callable[[], float] = time.monotonic,
                  start_thread: bool = True):
         self._on_dead = on_dead
@@ -95,14 +99,20 @@ class HealthMonitor:
         self.dead_s = float(dead_s) if dead_s is not None \
             else self.interval_s * DEFAULT_DEAD_MULTIPLE
         self.progress_timeout_s = float(progress_timeout_s)
+        self.depart_grace_s = float(depart_grace_s) \
+            if depart_grace_s is not None \
+            else self.dead_s * DEFAULT_DEPART_GRACE_MULTIPLE
         self._clock = clock
         self._start_thread = start_thread
         self._lock = threading.Lock()
         self._workers: Dict[Tuple[str, int], _WorkerHealth] = {}
-        # workers that announced a planned (preemption) departure:
-        # exempt from death/hang verdicts — their silence is expected
-        # and must not trigger regeneration ahead of the clean exit
-        self._departing: set = set()
+        # workers that announced a planned (preemption) departure,
+        # keyed to the announce time: exempt from death/hang verdicts —
+        # their silence is expected and must not trigger regeneration
+        # ahead of the clean exit.  The exemption is bounded: a worker
+        # that announces but never exits within depart_grace_s is
+        # wedged, and falls back to the normal dead-worker path
+        self._departing: Dict[Tuple[str, int], float] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -110,6 +120,7 @@ class HealthMonitor:
     def from_env(cls, on_dead) -> "HealthMonitor":
         interval = heartbeat_interval_s()
         dead_env = os.environ.get("HOROVOD_ELASTIC_HEARTBEAT_DEAD_S")
+        grace_env = os.environ.get("HOROVOD_ELASTIC_DEPART_GRACE_S")
         return cls(
             on_dead,
             interval_s=interval,
@@ -118,7 +129,8 @@ class HealthMonitor:
                 DEFAULT_SUSPECT_MISSES)),
             dead_s=float(dead_env) if dead_env else None,
             progress_timeout_s=float(os.environ.get(
-                "HOROVOD_ELASTIC_PROGRESS_TIMEOUT_S", 0.0)))
+                "HOROVOD_ELASTIC_PROGRESS_TIMEOUT_S", 0.0)),
+            depart_grace_s=float(grace_env) if grace_env else None)
 
     @property
     def enabled(self) -> bool:
@@ -179,7 +191,7 @@ class HealthMonitor:
         counting this worker toward death/hang verdicts.  Its eventual
         exit is handled by the driver as graceful (guard/preempt.py)."""
         with self._lock:
-            self._departing.add((host, local_rank))
+            self._departing[(host, local_rank)] = self._clock()
             self._workers.pop((host, local_rank), None)
 
     def is_departing(self, host: str, local_rank: int) -> bool:
@@ -189,7 +201,7 @@ class HealthMonitor:
     def forget(self, host: str, local_rank: int) -> None:
         with self._lock:
             self._workers.pop((host, local_rank), None)
-            self._departing.discard((host, local_rank))
+            self._departing.pop((host, local_rank), None)
 
     def purge(self, assigned: set) -> None:
         """Drop entries for workers no longer assigned (driver calls this
@@ -198,7 +210,8 @@ class HealthMonitor:
         with self._lock:
             self._workers = {k: w for k, w in self._workers.items()
                              if k in assigned}
-            self._departing &= assigned
+            self._departing = {k: t for k, t in self._departing.items()
+                               if k in assigned}
 
     def max_step(self) -> int:
         """Highest training step any monitored worker ever reported —
@@ -247,12 +260,27 @@ class HealthMonitor:
                         "heartbeat(s) (%.1fs silent; declared dead at "
                         "%.1fs)", key[0], key[1],
                         age / self.interval_s, age, self.dead_s)
+            if self.depart_grace_s > 0:
+                # bounded exemption: an announced departure that never
+                # became a process exit is a wedged worker, not a
+                # graceful one — fall back to the dead-worker path
+                for key, announced in list(self._departing.items()):
+                    waited = now - announced
+                    if waited >= self.depart_grace_s:
+                        dead.append((key, waited,
+                                     "departure grace expired (wedged)"))
+                        del self._departing[key]
         _TEL_BEAT_AGE.set(max_age)
         for (host, local_rank), detect_s, reason in dead:
             # verdict telemetry BEFORE the callback: bench.py --chaos
             # and the driver both read detect_s from the registry
             _TEL_DETECT.set(detect_s)
-            _TEL_DEATHS.inc(reason="hung" if "hung" in reason
-                            else "missed_heartbeats")
+            if "hung" in reason:
+                label = "hung"
+            elif "departure" in reason:
+                label = "depart_grace_expired"
+            else:
+                label = "missed_heartbeats"
+            _TEL_DEATHS.inc(reason=label)
             self._on_dead(host, local_rank, detect_s, reason)
         return [k for k, _, _ in dead]

@@ -277,3 +277,33 @@ def init_notification_manager() -> Optional[WorkerNotificationManager]:
             except Exception as e:  # non-elastic runs have no driver
                 hvd_logging.debug("notification manager init skipped: %s", e)
         return _manager
+
+
+def announce_departure(step: int = -1) -> bool:
+    """Worker-side planned-departure announcement: tell the elastic
+    driver this process will exit on purpose (preemption grace, serve
+    replica drain) so the exit is graceful — no blacklist, no
+    quarantine, no sibling abort.  Reads the worker identity from the
+    env the driver exported; no-op (False) outside elastic runs.  The
+    exemption is bounded by ``HOROVOD_ELASTIC_DEPART_GRACE_S``: a
+    worker that announces but wedges instead of exiting falls back to
+    the normal dead-worker path."""
+    import socket
+
+    driver_addr = os.environ.get("HOROVOD_ELASTIC_DRIVER_ADDR")
+    if not driver_addr:
+        return False
+    from horovod_tpu.runner.network import notify_planned_departure
+
+    if step < 0:
+        step = current_step()
+    try:
+        notify_planned_departure(
+            driver_addr, os.environ.get("HOROVOD_SECRET_KEY"),
+            os.environ.get("HOROVOD_HOSTNAME", socket.gethostname()),
+            int(os.environ.get("HOROVOD_LOCAL_RANK", "0")), step)
+        return True
+    except OSError as e:
+        # best-effort: a dead driver cannot grant grace anyway
+        hvd_logging.warning("departure announcement failed: %s", e)
+        return False

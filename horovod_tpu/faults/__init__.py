@@ -36,6 +36,12 @@ Sites currently instrumented (grep ``faults.inject`` for ground truth):
 ``guard.check``             each guardian check pass (numerics + checksum)
 ``worker.preempt``          preemption handler drain → commit → notify path
 ``guard.repair``            peer state fetch in the guard repair path
+``serve.batch``             replica batch execution — ``crash`` models a
+                            replica dying mid-batch (lease re-enqueues)
+``serve.feed``              each continuous-batcher engine step — ``hang``
+                            models a wedged queue feeder
+``serve.drain``             replica drain completion — ``raise``/``hang``
+                            models a drain wedged past its grace window
 ==========================  =================================================
 
 (Coverage is enforced statically: hvdlint rule HVD006 fails on any

@@ -66,6 +66,11 @@ KNOWN_KNOBS = frozenset({
     "HOROVOD_ELASTIC_HEARTBEAT_SUSPECT_MISSES",
     "HOROVOD_ELASTIC_HEARTBEAT_DEAD_S",
     "HOROVOD_ELASTIC_PROGRESS_TIMEOUT_S",
+    "HOROVOD_ELASTIC_DEPART_GRACE_S",
+    # -- serving plane (horovod_tpu/serve, docs/serving.md)
+    "HOROVOD_SERVE_QUEUE_DEPTH", "HOROVOD_SERVE_MAX_REQUEUES",
+    "HOROVOD_SERVE_MAX_BATCH", "HOROVOD_SERVE_DRAIN_TIMEOUT_S",
+    "HOROVOD_SERVE_SCALE_UP_DEPTH", "HOROVOD_SERVE_SCALE_DOWN_DEPTH",
     # -- perf regression gate (analysis/perf_gate.py, docs/perf_gate.md)
     "HOROVOD_PERF_GATE_TOLERANCE", "HOROVOD_PERF_GATE_OVERLAP_TOLERANCE",
     "HOROVOD_PERF_GATE_WIRE_TOLERANCE",

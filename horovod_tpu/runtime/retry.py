@@ -97,17 +97,23 @@ class RetryPolicy:
                 if attempt + 1 >= self.max_attempts:
                     break
                 delay = self.backoff_s(attempt)
-                elapsed = self._clock() - start
-                if self.deadline_s > 0 and \
-                        elapsed + delay > self.deadline_s:
-                    hvd_logging.warning(
-                        "%s: deadline %.1fs exhausted after %d attempt(s): "
-                        "%s", self.name, self.deadline_s, attempt + 1, e)
-                    _tel_counter(
-                        "hvd_retry_exhausted_total",
-                        "retry policies giving up (attempts or "
-                        "deadline)").inc(policy=self.name)
-                    raise
+                if self.deadline_s > 0:
+                    remaining = self.deadline_s - (self._clock() - start)
+                    if remaining <= 0:
+                        hvd_logging.warning(
+                            "%s: deadline %.1fs exhausted after %d "
+                            "attempt(s): %s", self.name, self.deadline_s,
+                            attempt + 1, e)
+                        _tel_counter(
+                            "hvd_retry_exhausted_total",
+                            "retry policies giving up (attempts or "
+                            "deadline)").inc(policy=self.name)
+                        raise
+                    # the final sleep is clamped to the remaining budget
+                    # — a full-jitter draw can no longer overshoot the
+                    # deadline, and the budget's tail still buys one
+                    # last attempt
+                    delay = min(delay, remaining)
                 hvd_logging.warning(
                     "%s: attempt %d/%d failed (%s: %s) — retrying in "
                     "%.2fs", self.name, attempt + 1, self.max_attempts,

@@ -1,0 +1,61 @@
+"""hvdserve: resilient serving plane on the elastic runtime.
+
+The serving plane (docs/serving.md) turns the substrate PRs 3–11 built
+— AOT executable store, heartbeat/health plane, quarantine-with-decay,
+deterministic fault injection, telemetry registry — into a request
+path that degrades gracefully instead of dropping or duplicating work:
+
+* :mod:`~horovod_tpu.serve.request` — request/response records; the
+  request id is the exactly-once token;
+* :mod:`~horovod_tpu.serve.queue` — bounded admission queue:
+  deadline-aware shedding + backpressure at the front door, and the
+  ``queued → inflight → done`` state machine that makes crash
+  re-enqueue exactly-once;
+* :mod:`~horovod_tpu.serve.replica` — one serving slot with the
+  SERVING → DRAINING → DEPARTED / DEAD lifecycle;
+* :mod:`~horovod_tpu.serve.batcher` — continuous batcher packing
+  signature-compatible requests into AOT-cached executables
+  (:class:`~horovod_tpu.serve.batcher.ExecutableCache`);
+* :mod:`~horovod_tpu.serve.pool` — replica pool: leases, crash
+  recovery, graceful drain via the planned-departure path, and
+  queue-depth scale signals for the elastic driver
+  (:class:`~horovod_tpu.serve.pool.ElasticServeBridge`);
+* :mod:`~horovod_tpu.serve.smoke` — the seeded sub-second chaos
+  scenario hvdci gate 5 runs twice and diffs bit-for-bit.
+
+Fault sites: ``serve.batch`` (replica crash mid-batch), ``serve.feed``
+(queue-feeder hang), ``serve.drain`` (drain wedged past its window).
+Metrics: the closed ``hvd_serve_*`` vocabulary in
+``analysis/metrics_schema.py SERVE_SERIES``.
+"""
+
+from horovod_tpu.serve.batcher import ContinuousBatcher, ExecutableCache
+from horovod_tpu.serve.pool import ElasticServeBridge, ReplicaPool
+from horovod_tpu.serve.queue import (
+    ADMITTED,
+    SHED_DEADLINE,
+    SHED_DUPLICATE,
+    SHED_FULL,
+    SHED_REQUEUE_BUDGET,
+    AdmissionQueue,
+)
+from horovod_tpu.serve.replica import (
+    DEAD,
+    DEPARTED,
+    DRAINING,
+    SERVING,
+    Replica,
+)
+from horovod_tpu.serve.request import (
+    InferenceRequest,
+    InferenceResponse,
+    payload_signature,
+)
+
+__all__ = [
+    "ADMITTED", "SHED_DEADLINE", "SHED_DUPLICATE", "SHED_FULL",
+    "SHED_REQUEUE_BUDGET", "AdmissionQueue", "ContinuousBatcher",
+    "DEAD", "DEPARTED", "DRAINING", "ElasticServeBridge",
+    "ExecutableCache", "InferenceRequest", "InferenceResponse",
+    "Replica", "ReplicaPool", "SERVING", "payload_signature",
+]

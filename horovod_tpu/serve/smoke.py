@@ -1,0 +1,128 @@
+"""Seeded serve-chaos smoke for ``hvdci`` (analysis/ci.py gate 5).
+
+A sub-second, CPU-only, logical-clock run of the serving plane's whole
+robustness story: an open-loop generator admits a seeded request
+stream, the continuous batcher packs it onto two replicas, a seeded
+``serve.batch`` crash kills one replica mid-batch, its leased requests
+re-enqueue exactly once (no lost, no duplicated response), and the
+surviving replica finishes the stream then drains gracefully through
+the planned-departure path — twice, so determinism itself is gated.
+
+Returns error strings (empty = pass) in the same idiom as
+``guard.smoke`` so ci.py folds it straight into its exit code.
+Budget: well under a second — pure numpy payloads, a logical clock the
+fake executor advances, ~24 requests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from horovod_tpu import faults
+from horovod_tpu.faults import FaultPlan
+from horovod_tpu.serve.batcher import ContinuousBatcher
+from horovod_tpu.serve.pool import ReplicaPool
+from horovod_tpu.serve.queue import ADMITTED, AdmissionQueue
+from horovod_tpu.serve.replica import DEAD, DEPARTED, Replica
+from horovod_tpu.serve.request import InferenceRequest
+
+SEED = 1234
+N_REQUESTS = 24
+MAX_BATCH = 4
+CRASH_AT = 3       # third serve.batch hit → replica r0's second batch
+MAX_STEPS = 200    # engine-loop runaway guard
+
+
+def _scenario() -> Dict[str, Any]:
+    plan = FaultPlan(seed=SEED, sim=True).add(
+        "serve.batch", "crash", at=CRASH_AT)
+    faults.set_plan(plan)
+    try:
+        now = [0.0]
+
+        def clock() -> float:
+            return now[0]
+
+        def executor(payloads):
+            # service time is a pure function of occupancy, so the
+            # logical clock — and every latency derived from it — is
+            # identical across runs
+            now[0] += 0.004 + 0.001 * len(payloads)
+            return [round(float(np.asarray(p).sum()), 6)
+                    for p in payloads]
+
+        queue = AdmissionQueue(depth=64, max_requeues=3, clock=clock)
+        pool = ReplicaPool(queue, drain_timeout_s=1.0, clock=clock)
+        replicas = [pool.add_replica(
+            Replica(f"r{i}", executor, host=f"host-{i}", local_rank=0,
+                    clock=clock)) for i in range(2)]
+
+        got: Dict[str, List[Any]] = {}
+        batcher = ContinuousBatcher(
+            queue, pool, max_batch=MAX_BATCH, clock=clock,
+            on_response=lambda r: got.setdefault(
+                r.request_id, []).append((r.result, r.requeues, r.replica)))
+
+        rng = np.random.RandomState(SEED)
+        admitted: List[str] = []
+        for i in range(N_REQUESTS):
+            req = InferenceRequest(
+                request_id=f"req-{i:03d}",
+                payload=rng.rand(4).astype(np.float32),
+                deadline_s=now[0] + 10.0)
+            if queue.submit(req) == ADMITTED:
+                admitted.append(req.request_id)
+            now[0] += 0.001   # open-loop: arrivals march on regardless
+
+        steps = 0
+        while len(queue) and steps < MAX_STEPS:
+            batcher.step()
+            steps += 1
+            if pool.serving_count() == 0:
+                break
+
+        drains = [pool.drain(r) for r in pool.replicas() if r.alive]
+        return {
+            "admitted": admitted,
+            "responses": sorted((rid, tuple(rs)) for rid, rs in got.items()),
+            "requeued_ids": sorted(rid for rid, rs in got.items()
+                                   if any(r[1] > 0 for r in rs)),
+            "states": [r.state for r in replicas],
+            "drains": drains,
+            "steps": steps,
+            "clock": round(now[0], 6),
+        }
+    finally:
+        faults.clear_plan()
+
+
+def run_smoke() -> List[str]:
+    """Run the seeded serve-chaos scenario twice; returns a list of
+    error strings (empty = pass)."""
+    errors: List[str] = []
+    r1 = _scenario()
+    r2 = _scenario()
+    responded = {rid for rid, _ in r1["responses"]}
+    lost = sorted(set(r1["admitted"]) - responded)
+    if lost:
+        errors.append(f"serve-smoke: {len(lost)} admitted request(s) "
+                      f"lost ({lost[:3]}...)")
+    dupes = sorted(rid for rid, rs in r1["responses"] if len(rs) != 1)
+    if dupes:
+        errors.append(f"serve-smoke: duplicated responses for {dupes[:3]}")
+    if not r1["requeued_ids"]:
+        errors.append("serve-smoke: crash fired but no request was "
+                      "re-executed (requeue path untested)")
+    if len(r1["requeued_ids"]) > MAX_BATCH:
+        errors.append(f"serve-smoke: {len(r1['requeued_ids'])} requests "
+                      f"requeued — more than one lease of {MAX_BATCH}")
+    if sorted(r1["states"]) != sorted([DEAD, DEPARTED]):
+        errors.append(f"serve-smoke: replica states {r1['states']}, "
+                      f"expected one dead (crash) one departed (drain)")
+    if not all(r1["drains"]):
+        errors.append("serve-smoke: survivor drain was not graceful")
+    if r1 != r2:
+        errors.append("serve-smoke: two seeded runs were not identical")
+    return errors

@@ -415,8 +415,10 @@ class TestPlannedDepartureDriver:
         driver._handle(HeartbeatRequest("h1", 0, 3))
         driver._handle(HeartbeatRequest("h2", 0, 3))
         driver._handle(PlannedDepartureRequest("h2", 0, step=3))
-        # h2 now silent far past dead_s: no verdict, no regeneration
-        for t in range(1, 30):
+        # h2 now silent far past dead_s (5 s) but inside the depart
+        # grace (dead_s * 3): no verdict, no regeneration
+        assert driver._health.depart_grace_s == 15.0
+        for t in range(1, 15):
             clk.t = float(t)
             driver._handle(HeartbeatRequest("h1", 0, 3 + t))
             assert driver._health.check() == []
@@ -442,6 +444,42 @@ class TestPlannedDepartureDriver:
         # through the normal failure path again
         driver.record_worker_exit("h2", 0, 1)
         assert driver.host_manager.is_blacklisted("h2")
+        driver.stop(0)
+
+    def test_graceful_drain_during_probation_is_not_a_relapse(
+            self, monkeypatch):
+        """A replica that drains gracefully while its host is on
+        quarantine probation (e.g. a serve-pool scale-down or a
+        preemption notice) must NOT count as a relapse: no new failure
+        record, no re-quarantine, and the probation window still
+        clears the record on survival."""
+        from horovod_tpu.runner.network import PlannedDepartureRequest
+
+        clk = Clock()
+        driver = make_driver({"h1": 1, "h2": 1}, min_np=1,
+                             monkeypatch=monkeypatch, clk=clk)
+        q = HostQuarantine(base_s=10.0, max_s=100.0, probation_s=30.0,
+                           disabled=False, clock=clk)
+        driver.host_manager._quarantine = q
+        # one prior failure: quarantined 10 s, then probation until t=40
+        driver.host_manager.quarantine("h2")
+        assert driver.host_manager.is_quarantined("h2")
+        clk.t = 10.0
+        assert not driver.host_manager.is_quarantined("h2")
+        assert q.status("h2") == "probation"
+        # mid-probation the worker announces departure and exits 143
+        clk.t = 15.0
+        driver._handle(PlannedDepartureRequest("h2", 0, step=5))
+        driver.record_worker_exit("h2", 0, 143)
+        # not a relapse: failure count unchanged, still on probation
+        assert q.failures("h2") == 1
+        assert q.status("h2") == "probation"
+        assert not driver.host_manager.is_blacklisted("h2")
+        # surviving the remainder of the window clears the record
+        clk.t = 40.0
+        assert not driver.host_manager.is_quarantined("h2")
+        assert q.status("h2") is None
+        assert q.failures("h2") == 0
         driver.stop(0)
 
     def test_healthy_peer_skips_departing_and_self(self, monkeypatch):

@@ -192,15 +192,16 @@ class TestPlannedDeparture:
     that announced a planned departure is exempt from death verdicts —
     silence is expected, straggler beats must not re-enroll it."""
 
-    def test_departing_worker_never_declared_dead(self):
+    def test_departing_worker_not_declared_dead_within_grace(self):
         clk, deaths = Clock(), []
         mon = make_monitor(clk, deaths, dead_s=10.0)
+        assert mon.depart_grace_s == 30.0   # dead_s * 3 default
         mon.record_heartbeat("h1", 0, step=1)
         mon.record_heartbeat("h2", 0, step=1)
         clk.t = 1.0
         mon.mark_departing("h2", 0)
         assert mon.is_departing("h2", 0)
-        for t in range(2, 40):             # far past dead_s of silence
+        for t in range(2, 31):   # far past dead_s, inside the grace
             clk.t = float(t)
             mon.record_heartbeat("h1", 0, step=t)
             assert mon.check() == []
@@ -213,7 +214,7 @@ class TestPlannedDeparture:
         mon.mark_departing("h1", 0)
         # a beat already in flight when the drain started arrives late
         mon.record_heartbeat("h1", 0, step=6)
-        clk.t = 100.0                      # would be dead if re-enrolled
+        clk.t = 25.0    # > dead_s if re-enrolled, < the depart grace
         assert mon.check() == []
         assert deaths == []
         assert mon.max_step() == -1        # not monitored at all
@@ -234,3 +235,57 @@ class TestPlannedDeparture:
         mon.purge({("h2", 0)})             # h1 left the assignment
         assert not mon.is_departing("h1", 0)
         assert mon.is_departing("h2", 0)
+
+
+class TestDepartGrace:
+    """The planned-departure exemption is bounded: a worker that
+    announces but wedges instead of exiting must fall back to the
+    normal dead-worker path once ``depart_grace_s`` elapses — the
+    bookkeeping must not leak forever."""
+
+    def test_wedged_departure_falls_back_to_dead_path(self):
+        clk, deaths = Clock(), []
+        mon = make_monitor(clk, deaths, dead_s=10.0,
+                           depart_grace_s=20.0)
+        mon.record_heartbeat("h1", 0, step=5)
+        clk.t = 1.0
+        mon.mark_departing("h1", 0)
+        clk.t = 20.9                        # 19.9 s waited: still exempt
+        assert mon.check() == []
+        clk.t = 21.0                        # grace expired: wedged
+        assert mon.check() == [("h1", 0)]
+        assert len(deaths) == 1
+        host, lr, detect_s, reason = deaths[0]
+        assert (host, lr) == ("h1", 0)
+        assert detect_s == 20.0             # announce → declaration span
+        assert "departure grace expired" in reason
+        # the bookkeeping is purged — no leak, no double declaration
+        assert not mon.is_departing("h1", 0)
+        assert mon.check() == []
+        assert len(deaths) == 1
+
+    def test_clean_exit_within_grace_never_declares(self):
+        clk, deaths = Clock(), []
+        mon = make_monitor(clk, deaths, dead_s=10.0,
+                           depart_grace_s=20.0)
+        mon.record_heartbeat("h1", 0)
+        mon.mark_departing("h1", 0)
+        clk.t = 5.0
+        mon.forget("h1", 0)                 # the driver saw the exit
+        clk.t = 100.0
+        assert mon.check() == []
+        assert deaths == []
+
+    def test_zero_grace_disables_the_bound(self):
+        clk, deaths = Clock(), []
+        mon = make_monitor(clk, deaths, dead_s=10.0, depart_grace_s=0.0)
+        mon.record_heartbeat("h1", 0)
+        mon.mark_departing("h1", 0)
+        clk.t = 1e6
+        assert mon.check() == []
+        assert deaths == []
+
+    def test_grace_knob_from_env(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_ELASTIC_DEPART_GRACE_S", "45")
+        mon = HealthMonitor.from_env(lambda *a: None)
+        assert mon.depart_grace_s == 45.0

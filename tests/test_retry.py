@@ -96,6 +96,27 @@ class TestCall:
                         deadline_s=25.0, clock=clock).call(fn)
         assert fn.calls < 10
 
+    def test_final_sleep_clamped_to_deadline_budget(self):
+        # injectable clock advanced only by the recorded sleeps: with
+        # base_s=8 and deadline 10 the second backoff draw (16 s) must
+        # be clamped to the 2 s of budget left — buying one last
+        # attempt at t=10 — and the policy never sleeps past t=10
+        t = [0.0]
+        slept = []
+
+        def sleep(s):
+            slept.append(s)
+            t[0] += s
+
+        fn = Flaky(99)
+        with pytest.raises(OSError):
+            make_policy(max_attempts=5, jitter=False, base_s=8.0,
+                        max_s=100.0, deadline_s=10.0,
+                        clock=lambda: t[0], sleep=sleep).call(fn)
+        assert slept == [8.0, 2.0]     # 16 s draw clamped to remaining
+        assert t[0] == 10.0            # woke exactly at the deadline
+        assert fn.calls == 3           # the clamp bought a final try
+
     def test_zero_deadline_means_no_deadline(self):
         fn = Flaky(3)
         assert make_policy(max_attempts=5, deadline_s=0.0).call(fn) == "ok"

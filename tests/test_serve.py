@@ -1,0 +1,476 @@
+"""Serving plane (horovod_tpu/serve, docs/serving.md): admission
+queue exactly-once semantics, continuous batching, replica crash
+recovery, graceful drain, scale signals, the seeded chaos smoke, and
+the perf-gate contract for ``bench.py --serve`` artifacts — all on
+fake clocks, fully deterministic."""
+
+import pytest
+
+from horovod_tpu import faults
+from horovod_tpu.analysis import perf_gate as PG
+from horovod_tpu.serve import (
+    ADMITTED,
+    SHED_DEADLINE,
+    SHED_DUPLICATE,
+    SHED_FULL,
+    SHED_REQUEUE_BUDGET,
+    AdmissionQueue,
+    ContinuousBatcher,
+    DEAD,
+    DEPARTED,
+    DRAINING,
+    ElasticServeBridge,
+    ExecutableCache,
+    InferenceRequest,
+    Replica,
+    ReplicaPool,
+    payload_signature,
+)
+from horovod_tpu.serve.request import DONE, INFLIGHT, QUEUED
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan():
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def req(rid, payload="x", deadline=0.0, **kw):
+    return InferenceRequest(request_id=rid, payload=payload,
+                            deadline_s=deadline, **kw)
+
+
+class TestPayloadSignature:
+    def test_array_like_keyed_by_shape_and_dtype(self):
+        class Arr:
+            shape = (4, 8)
+            dtype = "float32"
+
+        assert payload_signature(Arr()) == ((4, 8), "float32")
+
+    def test_plain_payload_keyed_by_type(self):
+        assert payload_signature("hello") == ("str",)
+        assert payload_signature(3) == ("int",)
+        assert payload_signature("a") == payload_signature("b")
+
+    def test_request_derives_signature(self):
+        assert req("r1", payload=7).signature == ("int",)
+
+
+class TestAdmission:
+    def test_admit_then_shed_full_at_depth(self):
+        q = AdmissionQueue(depth=2, clock=Clock())
+        assert q.submit(req("r1")) == ADMITTED
+        assert q.submit(req("r2")) == ADMITTED
+        assert q.submit(req("r3")) == SHED_FULL
+        assert len(q) == 2
+
+    def test_infeasible_deadline_shed_at_the_front_door(self):
+        clk = Clock(10.0)
+        q = AdmissionQueue(depth=8, clock=clk)
+        q.note_service_time(0.5)
+        # 0.2 s of budget < the 0.5 s service estimate: shed now
+        assert q.submit(req("r1", deadline=10.2)) == SHED_DEADLINE
+        # ample budget (or no deadline at all): admitted
+        assert q.submit(req("r2", deadline=11.0)) == ADMITTED
+        assert q.submit(req("r3")) == ADMITTED
+
+    def test_live_id_resubmission_is_shed_as_duplicate(self):
+        q = AdmissionQueue(depth=8, clock=Clock())
+        assert q.submit(req("r1")) == ADMITTED
+        assert q.submit(req("r1")) == SHED_DUPLICATE       # queued
+        q.take(1)
+        assert q.submit(req("r1")) == SHED_DUPLICATE       # inflight
+        q.complete(["r1"])
+        assert q.submit(req("r1")) == ADMITTED             # done: a new life
+
+    def test_expired_deadline_shed_at_dequeue(self):
+        clk = Clock()
+        q = AdmissionQueue(depth=8, clock=clk)
+        q.submit(req("r1", deadline=5.0))
+        q.submit(req("r2", deadline=50.0))
+        clk.t = 10.0
+        got = q.take(4)
+        assert [r.request_id for r in got] == ["r2"]
+        assert q.state_of("r1") == DONE                    # shed, closed out
+
+    def test_stop_admitting_sheds_everything_after(self):
+        q = AdmissionQueue(depth=8, clock=Clock())
+        q.submit(req("r1"))
+        q.stop_admitting()
+        assert not q.admitting
+        assert q.submit(req("r2")) == SHED_FULL
+        assert len(q) == 1                                 # queued work stays
+
+    def test_service_time_ewma_folds(self):
+        q = AdmissionQueue(depth=8, clock=Clock())
+        q.note_service_time(1.0)
+        q.note_service_time(2.0)
+        assert q._service_est_s == pytest.approx(0.8 * 1.0 + 0.2 * 2.0)
+
+
+class TestExactlyOnce:
+    """The single transition rule — queued → inflight → done, requeue
+    re-admits only inflight — proven edge by edge."""
+
+    def test_take_leases_inflight(self):
+        q = AdmissionQueue(depth=8, clock=Clock())
+        q.submit(req("r1"))
+        assert q.state_of("r1") == QUEUED
+        (got,) = q.take(1)
+        assert got.request_id == "r1"
+        assert q.state_of("r1") == INFLIGHT
+
+    def test_requeue_inflight_exactly_once(self):
+        q = AdmissionQueue(depth=8, max_requeues=3, clock=Clock())
+        q.submit(req("r1"))
+        (lease,) = q.take(1)
+        assert q.requeue([lease]) == 1
+        assert q.state_of("r1") == QUEUED and len(q) == 1
+        # the second attempt on the SAME lease (e.g. two observers of
+        # one death) is a no-op — the id is no longer inflight
+        assert q.requeue([lease]) == 0
+        assert len(q) == 1
+
+    def test_requeue_after_complete_is_a_noop(self):
+        q = AdmissionQueue(depth=8, clock=Clock())
+        q.submit(req("r1"))
+        (lease,) = q.take(1)
+        q.complete(["r1"])
+        assert q.state_of("r1") == DONE
+        assert q.requeue([lease]) == 0
+        assert len(q) == 0
+
+    def test_requeue_of_queued_id_is_a_noop(self):
+        q = AdmissionQueue(depth=8, clock=Clock())
+        q.submit(req("r1"))
+        assert q.requeue([req("r1")]) == 0
+        assert len(q) == 1
+
+    def test_requeue_budget_sheds_poison_requests(self):
+        q = AdmissionQueue(depth=8, max_requeues=2, clock=Clock())
+        q.submit(req("r1"))
+        for _ in range(2):                      # two crash re-executions
+            (lease,) = q.take(1)
+            assert q.requeue([lease]) == 1
+        (lease,) = q.take(1)
+        assert q.requeue([lease]) == 0          # budget exhausted: shed
+        assert q.state_of("r1") == DONE
+        assert len(q) == 0
+        assert lease.requeues == 3
+
+    def test_requeue_lands_at_the_front_in_age_order(self):
+        q = AdmissionQueue(depth=8, clock=Clock())
+        for rid in ("r1", "r2", "r3"):
+            q.submit(req(rid))
+        lease = q.take(2)                       # r1, r2 in flight
+        assert q.requeue(lease) == 2
+        assert [r.request_id for r in q.take(4)] == ["r1", "r2", "r3"]
+
+
+class TestSignatureBatching:
+    def test_take_packs_only_compatible_requests(self):
+        q = AdmissionQueue(depth=8, clock=Clock())
+        q.submit(req("a1", payload=1))
+        q.submit(req("b1", payload="s"))
+        q.submit(req("a2", payload=2))
+        got = q.take(4)                         # head signature: int
+        assert [r.request_id for r in got] == ["a1", "a2"]
+        # the skipped str request kept its place at the head
+        assert [r.request_id for r in q.take(4)] == ["b1"]
+
+    def test_explicit_signature_filter(self):
+        q = AdmissionQueue(depth=8, clock=Clock())
+        q.submit(req("a1", payload=1))
+        q.submit(req("b1", payload="s"))
+        got = q.take(4, signature=("str",))
+        assert [r.request_id for r in got] == ["b1"]
+        assert len(q) == 1
+
+
+class TestExecutableCache:
+    def test_pads_to_bucket_and_truncates(self):
+        built = []
+
+        def build(signature, padded):
+            built.append((signature, padded))
+            return lambda xs: [x * 10 for x in xs]
+
+        cache = ExecutableCache(build, bucket_sizes=(1, 2, 4))
+        assert cache.run([1, 2, 3]) == [10, 20, 30]       # padded to 4
+        assert built == [(("int",), 4)]
+
+    def test_bucketed_sizes_share_one_executable(self):
+        built = []
+        cache = ExecutableCache(
+            lambda sig, n: built.append(n) or (lambda xs: list(xs)),
+            bucket_sizes=(1, 2, 4))
+        cache.run([1, 2, 3])
+        cache.run([4, 5, 6, 7])                 # same bucket (4)
+        cache.run([8])                          # bucket 1
+        assert built == [4, 1]
+        assert len(cache) == 2
+
+    def test_oversize_batch_uses_its_own_size(self):
+        cache = ExecutableCache(lambda sig, n: (lambda xs: list(xs)),
+                                bucket_sizes=(1, 2))
+        assert cache.padded_size(7) == 7
+        assert cache.run([1] * 7) == [1] * 7
+
+
+def make_plane(n_replicas=2, clk=None, executor=None, **pool_kw):
+    clk = clk or Clock()
+    q = AdmissionQueue(depth=64, max_requeues=3, clock=clk)
+    pool_kw.setdefault("drain_timeout_s", 10.0)
+    pool_kw.setdefault("scale_up_depth", 8)
+    pool_kw.setdefault("scale_down_depth", 1)
+    pool = ReplicaPool(q, clock=clk, **pool_kw)
+    executor = executor or (lambda xs: [x for x in xs])
+    for i in range(n_replicas):
+        pool.add_replica(Replica(f"r{i}", executor, host=f"h{i}",
+                                 clock=clk))
+    return q, pool, clk
+
+
+class TestReplicaPool:
+    def test_execute_completes_and_prices_latency(self):
+        q, pool, clk = make_plane(n_replicas=1)
+        q.submit(req("r1"))
+        clk.t = 0.25
+        resp = pool.execute(pool.pick(), q.take(4))
+        assert [r.request_id for r in resp] == ["r1"]
+        assert resp[0].latency_s == pytest.approx(0.25)
+        assert resp[0].replica == "r0" and resp[0].ok
+        assert q.state_of("r1") == DONE
+
+    def test_crash_requeues_the_lease_exactly_once(self):
+        q, pool, _ = make_plane(n_replicas=2)
+        faults.set_plan(faults.FaultPlan(sim=True).add(
+            "serve.batch", "crash", at=1))
+        for rid in ("r1", "r2", "r3"):
+            q.submit(req(rid))
+        victim = pool.pick()
+        assert pool.execute(victim, q.take(2)) == []      # died mid-batch
+        assert victim.state == DEAD
+        assert pool.serving_count() == 1
+        # the lease came back at the front, still exactly one copy each
+        batch = q.take(4)
+        assert [r.request_id for r in batch] == ["r1", "r2", "r3"]
+        # the second site hit is past the plan: the survivor finishes
+        survivor = pool.pick()
+        resp = pool.execute(survivor, batch)
+        assert sorted(r.request_id for r in resp) == ["r1", "r2", "r3"]
+        assert all(r.requeues == 1 for r in resp[:2])
+
+    def test_mark_dead_without_lease_is_safe_and_idempotent(self):
+        q, pool, _ = make_plane(n_replicas=1)
+        replica = pool.pick()
+        assert pool.mark_dead(replica, reason="probe") == 0
+        assert pool.mark_dead(replica, reason="again") == 0
+        assert replica.state == DEAD
+
+    def test_dead_replica_reports_to_the_elastic_bridge(self):
+        exits = []
+        q, pool, _ = make_plane(
+            n_replicas=1,
+            bridge=ElasticServeBridge(
+                on_dead=lambda h, lr: exits.append((h, lr))))
+        pool.mark_dead(pool.pick(), reason="chaos")
+        assert exits == [("h0", 0)]
+
+    def test_drain_is_graceful_and_announces_departure(self):
+        notices = []
+        q, pool, _ = make_plane(
+            n_replicas=2,
+            bridge=ElasticServeBridge(
+                notify_departure=lambda h, lr: notices.append((h, lr))))
+        replica = pool.pick()
+        assert pool.drain(replica) is True
+        assert replica.state == DEPARTED
+        assert notices == [(replica.host, replica.local_rank)]
+        assert pool.serving_count() == 1
+
+    def test_drain_waits_for_the_inflight_lease(self):
+        q, pool, clk = make_plane(n_replicas=1)
+        q.submit(req("r1"))
+        replica = pool.pick()
+        lease = q.take(1)
+        pool._leases[replica.name] = lease      # batch still running
+
+        def finish():                           # the batch lands mid-drain
+            pool._leases.pop(replica.name, None)
+            q.complete(["r1"])
+
+        assert pool.drain(replica, wait=finish) is True
+        assert replica.state == DEPARTED
+
+    def test_wedged_drain_falls_back_to_the_dead_path(self):
+        q, pool, clk = make_plane(n_replicas=1, drain_timeout_s=5.0)
+        q.submit(req("r1"))
+        replica = pool.pick()
+        pool._leases[replica.name] = q.take(1)  # lease never clears
+
+        assert pool.drain(replica, wait=lambda: setattr(
+            clk, "t", clk.t + 2.0)) is False
+        assert replica.state == DEAD
+        # the wedged replica's lease re-enqueued exactly once
+        assert [r.request_id for r in q.take(2)] == ["r1"]
+
+    def test_drain_fault_site_falls_back_to_the_dead_path(self):
+        faults.set_plan(faults.FaultPlan(sim=True).add(
+            "serve.drain", "raise", "OSError", at=1))
+        q, pool, _ = make_plane(n_replicas=1)
+        replica = pool.pick()
+        assert pool.drain(replica) is False
+        assert replica.state == DEAD
+
+    def test_drain_all_stops_admitting_then_departs_everyone(self):
+        q, pool, _ = make_plane(n_replicas=2)
+        pool.drain_all()
+        assert not q.admitting
+        assert q.submit(req("late")) == SHED_FULL
+        assert all(r.state == DEPARTED for r in pool.replicas())
+
+    def test_scale_signal_thresholds(self):
+        q, pool, _ = make_plane(n_replicas=2, scale_up_depth=4,
+                                scale_down_depth=1)
+        for i in range(4):
+            q.submit(req(f"r{i}"))
+        assert pool.scale_signal() == 1         # deep queue: add one
+        q.take(4)
+        assert pool.scale_signal() == -1        # idle, 2 serving: drain one
+        pool.drain(pool.pick())
+        assert pool.scale_signal() == 0         # never below one replica
+
+
+class TestElasticBridge:
+    def test_for_driver_routes_to_the_recovery_paths(self):
+        calls = []
+
+        class FakeDriver:
+            def record_worker_exit(self, host, lr, code):
+                calls.append(("exit", host, lr, code))
+
+            def announce_departure(self, host, lr):
+                calls.append(("depart", host, lr))
+
+        bridge = ElasticServeBridge.for_driver(FakeDriver())
+        bridge.on_dead("h1", 0)
+        bridge.notify_departure("h2", 1)
+        assert calls == [("exit", "h1", 0, 1), ("depart", "h2", 1)]
+
+
+class TestContinuousBatcher:
+    def test_step_packs_executes_and_reports(self):
+        q, pool, _ = make_plane(n_replicas=1)
+        got = []
+        b = ContinuousBatcher(q, pool, max_batch=4,
+                              on_response=got.append, clock=Clock())
+        for rid in ("r1", "r2", "r3"):
+            q.submit(req(rid))
+        resp = b.step()
+        assert len(resp) == 3 and len(got) == 3
+        assert len(q) == 0
+
+    def test_idle_step_is_empty(self):
+        q, pool, _ = make_plane(n_replicas=1)
+        assert ContinuousBatcher(q, pool, max_batch=4,
+                                 clock=Clock()).step() == []
+
+    def test_no_serving_replica_leaves_the_queue_alone(self):
+        q, pool, _ = make_plane(n_replicas=1)
+        pool.mark_dead(pool.pick())
+        q.submit(req("r1"))
+        assert ContinuousBatcher(q, pool, max_batch=4,
+                                 clock=Clock()).step() == []
+        assert len(q) == 1
+
+    def test_service_time_feeds_the_admission_controller(self):
+        clk = Clock()
+        q, pool, _ = make_plane(n_replicas=1, clk=clk)
+
+        def executor(xs):
+            clk.t += 1.0                        # each batch takes 1 s
+            return list(xs)
+
+        pool.replicas()[0].executor = executor
+        b = ContinuousBatcher(q, pool, max_batch=4, clock=clk)
+        q.submit(req("r1"))
+        b.step()
+        assert q._service_est_s == pytest.approx(1.0)
+        # a deadline tighter than the learned service time sheds now
+        assert q.submit(req("r2", deadline=clk.t + 0.5)) == SHED_DEADLINE
+
+
+class TestSmoke:
+    def test_serve_smoke_is_green_and_deterministic(self):
+        from horovod_tpu.serve.smoke import run_smoke
+
+        assert run_smoke() == []
+
+
+class TestServeArtifactGate:
+    """The perf-gate contract for ``bench.py --serve`` artifacts
+    (docs/perf_gate.md): fields validate, tail-latency growth fires
+    PERF005, identity mismatches refuse instead of diffing."""
+
+    META = {"schema_version": 1, "jax_version": "0.4.37",
+            "jaxlib_version": "0.4.36", "platform": "tpu",
+            "device_kind": "TPU v5 lite", "n_devices": 1,
+            "mesh_shape": [1, 1]}
+
+    def serve_fields(self, **over):
+        fields = {"metric": "serve", "serve_offered_rps": 400.0,
+                  "serve_p50_latency_s": 0.0095,
+                  "serve_p99_latency_s": 0.0127,
+                  "serve_throughput_rps": 380.9}
+        fields.update(over)
+        return dict(self.META, **fields)
+
+    def test_serve_artifact_validates(self):
+        art = PG._validate("serve", self.serve_fields())
+        assert art.get("serve_p99_latency_s") == 0.0127
+
+    def test_p99_inflation_fires_perf005(self):
+        base = PG._validate("base", self.serve_fields())
+        cand = PG._validate("cand", self.serve_fields(
+            serve_p99_latency_s=0.05))
+        rules = [f.rule for f in PG.diff([base], cand, PG.Tolerances())]
+        assert "PERF005" in rules
+        # within tolerance: silent
+        ok = PG._validate("ok", self.serve_fields(
+            serve_p99_latency_s=0.0129))
+        assert [f.rule for f in PG.diff([base], ok, PG.Tolerances())
+                if f.rule == "PERF005"] == []
+
+    def test_throughput_drop_fires_perf001(self):
+        base = PG._validate("base", self.serve_fields())
+        cand = PG._validate("cand", self.serve_fields(
+            serve_throughput_rps=190.0))
+        assert "PERF001" in [f.rule for f in PG.diff(
+            [base], cand, PG.Tolerances())]
+
+    def test_latency_not_compared_across_offered_loads(self):
+        """800 rps is a different experiment than 400 rps — higher
+        p99 under doubled load is not a regression."""
+        base = PG._validate("base", self.serve_fields())
+        cand = PG._validate("cand", self.serve_fields(
+            serve_offered_rps=800.0, serve_p99_latency_s=0.08,
+            serve_throughput_rps=100.0))
+        assert PG.diff([base], cand, PG.Tolerances()) == []
+
+    def test_identity_mismatch_refused_not_diffed(self):
+        base = PG._validate("base", self.serve_fields())
+        cand = PG._validate("cand", self.serve_fields(
+            device_kind="TPU v4", n_devices=8))
+        with pytest.raises(PG.GateError, match="not comparable"):
+            PG.check_comparable([base], cand)
