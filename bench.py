@@ -378,27 +378,101 @@ def run_checkpoint_probe(args, state, label, prefix=""):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _apply_wire_dtype(wire):
+    """Route a ``wire_dtype`` choice into the runtime: the codec dtype
+    lives in the runtime config (``HOROVOD_EXCHANGE_WIRE_DTYPE``), the
+    wire *reduction* itself is enabled by the int8-bits compression
+    marker.  Returns the ``compression=`` kwarg value ("fp32"/None =
+    uncompressed wire)."""
+    import horovod_tpu as hvd
+    from horovod_tpu.runtime import state as rt_state
+
+    if not wire or wire == "fp32":
+        return None
+    if rt_state.is_initialized():
+        rt_state.global_state().config.exchange_wire_dtype = wire
+    os.environ["HOROVOD_EXCHANGE_WIRE_DTYPE"] = wire
+    return hvd.Compression.int8
+
+
 def exchange_step_kwargs(args):
     """DistributedTrainStep kwargs for ``--shard-optimizer-states``:
-    the ZeRO-style sharded exchange with the bucket/hierarchy schedule
-    under test (the autotuner varies these per sample point)."""
+    the ZeRO-style sharded exchange with the bucket/hierarchy/wire
+    schedule under test (the autotuner varies these per sample point).
+    ``--plan`` rides along even without the sharded exchange — the
+    plan then just builds the step's mesh and batch sharding."""
+    kw = {}
+    if getattr(args, "plan", None):
+        from horovod_tpu.parallel import ShardingPlan
+
+        # pipeline plans (pp>1) don't flow into the data-parallel train
+        # step — they are probed via plan_probe_fields instead
+        if ShardingPlan.from_string(args.plan).pp == 1:
+            kw["plan"] = args.plan
     if not getattr(args, "shard_optimizer_states", False):
-        return {}
-    return {"mode": "shard_map", "shard_optimizer_states": True,
-            "exchange_bucket_bytes": args.exchange_bucket_bytes,
-            "hierarchy": args.hierarchy,
-            "fused_collectives": getattr(args, "fused_collectives",
-                                         "auto")}
+        return kw
+    kw.update({"mode": "shard_map", "shard_optimizer_states": True,
+               "exchange_bucket_bytes": args.exchange_bucket_bytes,
+               "hierarchy": args.hierarchy,
+               "fused_collectives": getattr(args, "fused_collectives",
+                                            "auto")})
+    compression = _apply_wire_dtype(getattr(args, "wire_dtype", None))
+    if compression is not None:
+        kw["compression"] = compression
+    return kw
 
 
 def exchange_report_fields(args, step):
     """The chosen exchange schedule, emitted next to the throughput it
     produced (the BENCH-JSON half of the acceptance contract)."""
+    fields = {}
+    if step.plan is not None:
+        fields["plan"] = step.plan.to_string()
     if not getattr(args, "shard_optimizer_states", False):
+        return fields
+    fields.update({"exchange_hierarchy": step.exchange_hierarchy,
+                   "exchange_bucket_bytes": args.exchange_bucket_bytes,
+                   "step_fused_collectives": step.fused_collectives})
+    if getattr(args, "wire_dtype", None):
+        fields["exchange_wire_dtype"] = args.wire_dtype
+    return fields
+
+
+#: Microbatch depth of the pipeline probe fields — mirrors the cost
+#: model's ``PLAN_SCORE_MICROBATCHES`` so the probe and the plan scorer
+#: report the same schedule point.
+PLAN_PROBE_MICROBATCHES = 8
+
+
+def plan_probe_fields(args, hvd):
+    """``--plan`` BENCH fields: the canonical (resolved) plan string,
+    plus — for pipeline plans — the schedule geometry of both pipeline
+    variants at the probe depth: ticks and bubble fraction for GPipe
+    (``v=1``) and interleaved-1F1B (the plan's ``v``), straight from
+    ``parallel/pipeline``'s schedule math.  The acceptance check reads
+    ``pipeline_bubble_1f1b < pipeline_bubble_gpipe`` off these."""
+    if not getattr(args, "plan", None):
         return {}
-    return {"exchange_hierarchy": step.exchange_hierarchy,
-            "exchange_bucket_bytes": args.exchange_bucket_bytes,
-            "step_fused_collectives": step.fused_collectives}
+    from horovod_tpu.parallel import (ShardingPlan, bubble_fraction,
+                                      pipeline_ticks)
+
+    plan = ShardingPlan.from_string(args.plan).resolve(hvd.size())
+    fields = {"plan": plan.to_string()}
+    if plan.pp > 1:
+        s, v = plan.pp, plan.virtual_stages
+        m = PLAN_PROBE_MICROBATCHES
+        if m % s:
+            m = s * max(1, PLAN_PROBE_MICROBATCHES // s)
+        fields.update({
+            "pipeline_stages": s,
+            "pipeline_virtual": v,
+            "pipeline_microbatches": m,
+            "pipeline_ticks_gpipe": pipeline_ticks(s, m),
+            "pipeline_ticks_1f1b": pipeline_ticks(s, m, v),
+            "pipeline_bubble_gpipe": round(bubble_fraction(s, m), 6),
+            "pipeline_bubble_1f1b": round(bubble_fraction(s, m, v), 6),
+        })
+    return fields
 
 
 def run_resnet(args, hvd):
@@ -1148,6 +1222,23 @@ def run_serve(args, hvd):
     }
 
 
+def _plan_axis_values(world):
+    """Canonical dp×fsdp factorizations of ``world`` — the sharding
+    plan's data-extent search axis for ``--autotune``.  Model extents
+    (pp/ep/sp/tp) repartition the network and cannot be flipped inside
+    a timed bench loop, so the searched plan space is the set of ways
+    to split the data extent between replication (dp) and parameter
+    sharding (fsdp)."""
+    from horovod_tpu.parallel import ShardingPlan
+
+    plans = []
+    for fsdp in range(1, world + 1):
+        if world % fsdp:
+            continue
+        plans.append(ShardingPlan(dp=world // fsdp, fsdp=fsdp).to_string())
+    return plans
+
+
 def run_autotune(args, hvd):
     """``--autotune``: tune the jit-path knobs that set the BENCH
     numbers (steps_per_call, flash block) against the measured rate —
@@ -1185,7 +1276,16 @@ def run_autotune(args, hvd):
             # coordinate descent (docs/fused_kernels.md); the cost
             # model below prunes this axis without hardware
             "fused_collectives": ["off", "on"],
+            # wire codec per exchange hop (fp32 = uncompressed) —
+            # cost-model-priced via WIRE_DTYPE_BITS
+            "wire_dtype": ["fp32", "int8", "fp8_e4m3"],
         }
+        plans = _plan_axis_values(hvd.size())
+        if len(plans) > 1:
+            # plan space: every dp×fsdp factorization of the world —
+            # the sharding-plan compiler's search axis, pruned by
+            # plan_cost_s like the other exchange knobs
+            exchange_axes["plan"] = plans
 
     def apply_exchange_point(a, point):
         if exchange_axes:
@@ -1193,6 +1293,9 @@ def run_autotune(args, hvd):
                 point["exchange_bucket_bytes"] or None
             a.hierarchy = point["hierarchy"]
             a.fused_collectives = point["fused_collectives"]
+            a.wire_dtype = point["wire_dtype"]
+            if "plan" in point:
+                a.plan = point["plan"]
 
     def exchange_predictor():
         """Static exchange-schedule scorer for the autotuner's prune
@@ -1209,13 +1312,18 @@ def run_autotune(args, hvd):
         if model == "transformer":
             d, layers, v = args.tf_d_model, args.tf_layers, 32_000
             payload = 4.0 * (12 * layers * d * d + v * d)
+            # 6 FLOPs/param/token forward+backward, v5e peak bf16
+            compute_s = (6.0 * (payload / 4.0) * args.tf_batch_size
+                         * args.tf_seq_len) / 197e12
         else:
             payload = 4.0 * 25.6e6          # ResNet-50 fp32 grads
+            compute_s = 3.0 * 4.1e9 * 128 / 197e12
         shape = list(rt_state.global_state().mesh.shape.values())
         n_dcn = shape[0] if len(shape) == 2 else 1
         n_ici = shape[-1]
         return lambda point: score_exchange_schedule(
-            point, payload, n_dcn=n_dcn, n_ici=n_ici)
+            point, payload, n_dcn=n_dcn, n_ici=n_ici,
+            compute_s=compute_s)
 
     if model == "transformer":
         axes = {"steps_per_call": [1, 5, 10, 20, 40],
@@ -1367,6 +1475,18 @@ def main():
                         "(docs/fused_kernels.md).  The overlap probe "
                         "reports tail_exchange_s for both paths "
                         "either way")
+    p.add_argument("--plan", default=None, metavar="PLAN",
+                   help="parallelism plan (HOROVOD_PLAN grammar, e.g. "
+                        "'dp=4,fsdp=2' or 'dp=2,pp=2,v=2'): builds the "
+                        "step's mesh from the plan and emits plan + "
+                        "pipeline probe fields into BENCH JSON "
+                        "(docs/parallelism.md)")
+    p.add_argument("--wire-dtype", default=None,
+                   choices=["fp32", "int8", "fp8_e4m3"],
+                   help="exchange wire codec for the sharded exchange "
+                        "(fp32 = uncompressed; int8/fp8_e4m3 set "
+                        "HOROVOD_EXCHANGE_WIRE_DTYPE + the int8-bits "
+                        "wire reduction); also an --autotune axis")
     p.add_argument("--hierarchy", default="auto",
                    choices=["auto", "flat", "two_level"],
                    help="exchange topology: two_level reduce-scatters "
@@ -1502,6 +1622,7 @@ def main():
         out.update(run_vit(args, hvd))
     if args.model == "moe":
         out.update(run_moe(args, hvd))
+    out.update(plan_probe_fields(args, hvd))
     # compiled-executable cache counters (runtime/state.py cache_stats):
     # hits/misses are the in-memory signature caches, the aot_disk pair
     # is the persistent warm-start store

@@ -1,7 +1,7 @@
 """``python -m horovod_tpu.analysis ci`` / ``hvdci`` — the one-shot CI
 entry point.
 
-Five gates, one invocation, one exit code (docs/perf_gate.md):
+Six gates, one invocation, one exit code (docs/perf_gate.md):
 
 1. **hvdlint** over the pre-commit scope (``--changed``: staged +
    unstaged + untracked files under ``horovod_tpu/``; falls back to the
@@ -15,7 +15,11 @@ Five gates, one invocation, one exit code (docs/perf_gate.md):
    required bit-identical (docs/guardian.md);
 5. the **serve-chaos smoke** (``serve/smoke.py``): the serving plane's
    enqueue → batch → kill-replica → requeue → drain loop, seeded, run
-   twice and required bit-identical (docs/serving.md).
+   twice and required bit-identical (docs/serving.md);
+6. the **plan smoke** (``parallel/smoke.py``): a seeded dp×tp×pp
+   virtual-device walk of the sharding-plan compiler — tensor shards,
+   data-extent exchange and the interleaved-1F1B tick schedule, run
+   twice and required bit-identical (docs/parallelism.md).
 
 The whole run is a tier-1 test with the same <30 s budget as the
 hvdlint self-run, so "CI passed" and "the analysis suite passed" are
@@ -116,11 +120,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     except Exception as e:          # noqa: BLE001 — a crash IS a failure
         serve_errors = [f"serve-smoke crashed: {type(e).__name__}: {e}"]
 
+    # 6 — plan smoke: the sharding-plan compiler's dp×tp×pp virtual-
+    # device walk, seeded and deterministic (sub-second, CPU-only)
+    try:
+        from horovod_tpu.parallel.smoke import run_smoke as run_plan_smoke
+
+        plan_errors = run_plan_smoke()
+    except Exception as e:          # noqa: BLE001 — a crash IS a failure
+        plan_errors = [f"plan-smoke crashed: {type(e).__name__}: {e}"]
+
     elapsed = time.perf_counter() - t0
     gate_findings = gate.findings if gate is not None else []
     rc = 2 if (art_error or gate_error) else (
         1 if (lint.findings or art_findings or gate_findings
-              or metrics_errors or guard_errors or serve_errors) else 0)
+              or metrics_errors or guard_errors or serve_errors
+              or plan_errors) else 0)
 
     if args.json_out:
         print(json.dumps({
@@ -129,6 +143,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "metrics_schema_errors": metrics_errors,
             "guard_smoke_errors": guard_errors,
             "serve_smoke_errors": serve_errors,
+            "plan_smoke_errors": plan_errors,
             "perf_gate": gate.as_json() if gate is not None else None,
             "errors": [e for e in (art_error, gate_error) if e],
             "elapsed_s": round(elapsed, 3),
@@ -146,6 +161,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"hvdci: guard-smoke: {e}")
     for e in serve_errors:
         print(f"hvdci: serve-smoke: {e}")
+    for e in plan_errors:
+        print(f"hvdci: plan-smoke: {e}")
     for f in gate_findings:
         print(f.format())
     for err in (art_error, gate_error):
@@ -156,7 +173,8 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"{len(art_findings) + len(metrics_errors)} · "
           f"perf-gate {len(gate_findings)} · "
           f"guard-smoke {len(guard_errors)} · "
-          f"serve-smoke {len(serve_errors)} finding(s) "
+          f"serve-smoke {len(serve_errors)} · "
+          f"plan-smoke {len(plan_errors)} finding(s) "
           f"in {elapsed:.2f}s — {'FAIL' if rc else 'ok'}")
     return rc
 

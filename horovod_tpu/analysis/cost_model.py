@@ -153,6 +153,127 @@ def fused_tail_exchange_s(wire_s: float, compute_s: float,
     return startup + max(0.0, wire_s - max(0.0, float(compute_s)))
 
 
+# -- parallelism-plan pricing -----------------------------------------------
+
+
+#: Plan-grammar keys — mirrors ``parallel/plan.PLAN_KEYS`` (this module
+#: stays stdlib-only, so the grammar is duplicated by value like
+#: :data:`FUSED_TILE_COUNT`; ``v`` is the interleaved-1F1B
+#: virtual-stage count).
+PLAN_GRAMMAR_KEYS = ("dp", "pp", "fsdp", "ep", "sp", "tp", "v")
+
+#: Microbatch count the plan scorer assumes when the caller does not
+#: pin one — matches the bench pipeline probe's default depth.
+PLAN_SCORE_MICROBATCHES = 8
+
+#: Wire bits per ``HOROVOD_EXCHANGE_WIRE_DTYPE`` value — the
+#: ``wire_dtype`` autotune axis's pricing table (fp32 = no wire
+#: compression; int8 and fp8_e4m3 both move one byte per element, so
+#: the model ranks them identically and the measurement breaks the
+#: tie).
+WIRE_DTYPE_BITS = {"fp32": 32, "int8": 8, "fp8_e4m3": 8}
+
+
+def parse_plan(plan: Union[str, Dict]) -> Dict[str, int]:
+    """Parse the ``HOROVOD_PLAN`` grammar into a full extent dict
+    (every :data:`PLAN_GRAMMAR_KEYS` key, absent axes at 1).  The
+    stdlib mirror of ``parallel/plan.ShardingPlan.from_string`` for the
+    analysis layer; ``dp=?`` (an unresolved plan string) prices as
+    ``dp=1``."""
+    if isinstance(plan, dict):
+        ext = dict(plan)
+    else:
+        ext = {}
+        for item in str(plan).split(","):
+            item = item.strip()
+            if not item:
+                continue
+            key, sep, val = item.partition("=")
+            key = key.strip()
+            if not sep or key not in PLAN_GRAMMAR_KEYS:
+                raise ValueError(
+                    f"bad plan term {item!r}: expected axis=extent "
+                    f"with axis in {', '.join(PLAN_GRAMMAR_KEYS)}")
+            if key in ext:
+                raise ValueError(f"duplicate plan axis {key!r} in "
+                                 f"{plan!r}")
+            v = val.strip()
+            ext[key] = 1 if v == "?" else int(v)
+    out = {}
+    for k in PLAN_GRAMMAR_KEYS:
+        raw = ext.get(k)
+        v = 1 if raw is None else int(raw)
+        if v < 1:
+            raise ValueError(f"plan axis {k} must be >= 1, got {v}")
+        out[k] = v
+    return out
+
+
+def pipeline_bubble_fraction(stages: int, microbatches: int,
+                             virtual_stages: int = 1) -> float:
+    """Idle share of the pipeline schedule, ``(s-1)/(v*m+s-1)`` —
+    mirrors ``parallel/pipeline.bubble_fraction`` by value (GPipe at
+    ``v=1``, interleaved-1F1B at ``v>1``; docs/parallelism.md)."""
+    stages, microbatches = max(1, int(stages)), max(1, int(microbatches))
+    virtual_stages = max(1, int(virtual_stages))
+    return (stages - 1) / (virtual_stages * microbatches + stages - 1)
+
+
+def plan_exchange_wire_bytes(plan: Union[str, Dict],
+                             payload_bytes: float,
+                             n_dcn: int = 1,
+                             n_ici: int = 1,
+                             wire_bits_dcn: int = 8) -> WireBytes:
+    """Gradient-exchange wire bytes under a parallelism plan.
+
+    The model axes (pp/ep/sp/tp) shard the parameters, so each data
+    replica exchanges only ``payload / model_extent`` bytes.  The data
+    axes (dp × fsdp) then map onto the fabric DCN-outer/ICI-inner
+    (the ``AXIS_ORDER`` layout ``parallel/mesh.py`` realizes): ``dp``
+    absorbs the DCN extent first, the remainder rides ICI, and the
+    exchange goes two-level exactly when both derived extents exceed
+    1 — the same decision ``resolve_hierarchy`` makes at trace time.
+    """
+    ext = parse_plan(plan)
+    model = ext["pp"] * ext["ep"] * ext["sp"] * ext["tp"]
+    per_replica = float(payload_bytes) / max(1, model)
+    data_world = ext["dp"] * ext["fsdp"]
+    d_dcn = min(ext["dp"], max(1, int(n_dcn)))
+    while data_world % d_dcn:
+        d_dcn -= 1
+    d_ici = max(1, data_world // d_dcn)
+    hierarchy = "two_level" if d_dcn > 1 and d_ici > 1 else "flat"
+    return exchange_wire_bytes(per_replica, n_dcn=d_dcn, n_ici=d_ici,
+                               hierarchy=hierarchy,
+                               wire_bits_dcn=wire_bits_dcn)
+
+
+def plan_cost_s(plan: Union[str, Dict],
+                payload_bytes: float,
+                n_dcn: int = 1,
+                n_ici: int = 1,
+                compute_s: float = 0.0,
+                microbatches: int = PLAN_SCORE_MICROBATCHES,
+                hw: HardwareModel = V5E,
+                wire_bits_dcn: int = 8) -> float:
+    """Predicted per-step seconds of one plan: compute stretched by the
+    pipeline bubble (``t / (1 - bubble)`` — the idle ticks are pure
+    loss) plus the serial wire time of the plan-scoped gradient
+    exchange.  The quantity ``ThroughputAutotuner(predict=)`` ranks the
+    ``plan`` axis with (:func:`score_exchange_schedule`), and the
+    1F1B-beats-GPipe acceptance check reads straight off: same plan
+    with ``v>1`` has a strictly smaller bubble term."""
+    ext = parse_plan(plan)
+    bubble = 0.0
+    if ext["pp"] > 1:
+        bubble = pipeline_bubble_fraction(ext["pp"], microbatches,
+                                          ext["v"])
+    wire = plan_exchange_wire_bytes(plan, payload_bytes, n_dcn=n_dcn,
+                                    n_ici=n_ici,
+                                    wire_bits_dcn=wire_bits_dcn)
+    return float(compute_s) / (1.0 - bubble) + exchange_time_s(wire, hw)
+
+
 def score_exchange_schedule(point: Dict,
                             payload_bytes: float,
                             n_dcn: int = 1,
@@ -164,21 +285,50 @@ def score_exchange_schedule(point: Dict,
     """Rank one autotune sample point by its predicted *exposed*
     exchange seconds (negated — higher is better, matching the
     measured-rate objective).  ``point`` is a bench-autotuner sample
-    (``{"hierarchy": ..., "fused_collectives": ..., ...}``); knobs the
-    exchange model does not price (steps_per_call, flash_block, bucket
-    cap) leave the score unchanged, so per-axis scans of those knobs
-    see constant scores and stay fully measured.  Returns ``None``
-    when the point carries no exchange knob at all — the caller then
-    skips pruning entirely (the ParameterManager ``predict=``
-    contract: a predictor that cannot rank must not narrow the
-    grid)."""
+    (``{"hierarchy": ..., "fused_collectives": ..., "wire_dtype": ...,
+    "plan": ..., ...}``); knobs the exchange model does not price
+    (steps_per_call, flash_block, bucket cap) leave the score
+    unchanged, so per-axis scans of those knobs see constant scores
+    and stay fully measured.  ``wire_dtype`` prices the codec width
+    (:data:`WIRE_DTYPE_BITS`): the DCN hop in two_level, the whole
+    single-scope wire in flat (the flat quantized path compresses ICI
+    too).  A ``plan`` knob reprices the exchange under that plan's
+    factorization and adds the pipeline bubble penalty
+    (:func:`plan_cost_s`).  Returns ``None`` when the point carries no
+    exchange knob at all — the caller then skips pruning entirely (the
+    ParameterManager ``predict=`` contract: a predictor that cannot
+    rank must not narrow the grid)."""
     hierarchy = point.get("hierarchy")
     fused = point.get("fused_collectives")
-    if hierarchy is None and fused is None:
+    wire_dtype = point.get("wire_dtype")
+    plan = point.get("plan")
+    if hierarchy is None and fused is None and wire_dtype is None \
+            and plan is None:
         return None
+    wire_bits = WIRE_DTYPE_BITS.get(wire_dtype, 8)
+    if plan is not None:
+        ext = parse_plan(plan)
+        bubble = 0.0
+        if ext["pp"] > 1:
+            bubble = pipeline_bubble_fraction(
+                ext["pp"], PLAN_SCORE_MICROBATCHES, ext["v"])
+        wire = plan_exchange_wire_bytes(plan, float(payload_bytes),
+                                        n_dcn=n_dcn, n_ici=n_ici,
+                                        wire_bits_dcn=wire_bits)
+        exch = exchange_time_s(wire, hw)
+        if fused == "on":
+            exch = fused_tail_exchange_s(exch, compute_s, n_tiles)
+        # penalty form of the bubble stretch: the constant compute_s
+        # offset cancels in the ranking
+        return -(float(compute_s) * bubble / (1.0 - bubble) + exch)
     hierarchy = hierarchy if hierarchy in ("flat", "two_level") else "flat"
     wire = exchange_wire_bytes(float(payload_bytes), n_dcn=n_dcn,
-                               n_ici=n_ici, hierarchy=hierarchy)
+                               n_ici=n_ici, hierarchy=hierarchy,
+                               wire_bits_dcn=wire_bits)
+    if hierarchy == "flat" and wire_dtype in ("int8", "fp8_e4m3"):
+        # flat quantization compresses the single-scope wire everywhere
+        wire = WireBytes(ici=wire.ici * wire_bits / 32.0,
+                         dcn=wire.dcn * wire_bits / 32.0)
     serial = exchange_time_s(wire, hw)
     if fused == "on":
         return -fused_tail_exchange_s(serial, compute_s, n_tiles)

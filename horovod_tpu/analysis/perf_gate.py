@@ -69,21 +69,23 @@ _V1_IDENTITY = ("platform", "device_kind", "n_devices", "mesh_shape")
 
 #: throughput fields and the comparability key guarding each — only
 #: artifacts agreeing on the key's value are diffed (None key field on
-#: both sides also matches)
+#: both sides also matches).  ``plan`` guards every field: a dp=8 run
+#: against a dp=4,fsdp=2 run measures two different exchange
+#: schedules, not a regression (bench.py --plan; docs/parallelism.md)
 THROUGHPUT_FIELDS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
-    ("value", ("metric",)),
-    ("transformer_tokens_per_sec", ("transformer_params_m",)),
-    ("moe_tokens_per_sec", ("moe_params_m",)),
-    ("vit_img_sec_per_chip", ("vit_params_m",)),
-    ("serve_throughput_rps", ("serve_offered_rps",)),
+    ("value", ("metric", "plan")),
+    ("transformer_tokens_per_sec", ("transformer_params_m", "plan")),
+    ("moe_tokens_per_sec", ("moe_params_m", "plan")),
+    ("vit_img_sec_per_chip", ("vit_params_m", "plan")),
+    ("serve_throughput_rps", ("serve_offered_rps", "plan")),
 )
 
 #: latency (lower-is-better) fields and their comparability keys —
 #: PERF005 fails on *growth* beyond the throughput tolerance, so
 #: ``bench.py --serve`` tail latency is gateable like throughput
 LATENCY_FIELDS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
-    ("serve_p50_latency_s", ("serve_offered_rps",)),
-    ("serve_p99_latency_s", ("serve_offered_rps",)),
+    ("serve_p50_latency_s", ("serve_offered_rps", "plan")),
+    ("serve_p99_latency_s", ("serve_offered_rps", "plan")),
 )
 
 

@@ -307,7 +307,8 @@ class Checkpointer:
         return True
 
     def save_sharded(self, step: int, shard_state: Any,
-                     shard_rank: int, shard_count: int) -> bool:
+                     shard_rank: int, shard_count: int,
+                     plan: Any = None) -> bool:
         """Write THIS rank's 1/N shard of a sharded (ZeRO) state tree.
 
         Every rank calls this with its own ``shard_state`` — the
@@ -315,11 +316,20 @@ class Checkpointer:
         (flat ``(shard,)`` leaves keyed by fusion group).  Same async
         contract as :meth:`save`: blocks for the D2H copy only.  The
         step is complete once all ``shard_count`` files exist —
-        :meth:`restore_sharded` verifies that."""
+        :meth:`restore_sharded` verifies that.
+
+        ``plan`` (a :class:`~horovod_tpu.parallel.plan.ShardingPlan` or
+        grammar string) stamps the parallelism plan the state was
+        trained under into every shard payload, letting
+        :meth:`restore_sharded` reshard across *plan* changes — the
+        data extent (dp×fsdp) may change freely; a changed
+        model-parallel factorization (pp/ep/sp/tp) is refused there
+        instead of silently mis-slicing (docs/parallelism.md)."""
         if not 0 <= shard_rank < shard_count:
             raise ValueError(
                 f"shard_rank {shard_rank} out of range for "
                 f"shard_count {shard_count}")
+        plan_str = _canonical_plan(plan, shard_count)
         self.wait()
         t0 = time.perf_counter()
         host_state = _host_copy(shard_state)
@@ -330,11 +340,15 @@ class Checkpointer:
         def write():
             path = os.path.join(self._dir, f"step_{step}")
             os.makedirs(path, exist_ok=True)
+            payload = {"shard_rank": shard_rank,
+                       "shard_count": shard_count,
+                       "state": host_state}
+            if plan_str is not None:
+                payload["plan"] = plan_str
             _io_retry().call(
                 _atomic_write,
                 os.path.join(path, _shard_name(shard_rank, shard_count)),
-                {"shard_rank": shard_rank, "shard_count": shard_count,
-                 "state": host_state})
+                payload)
             hvd_logging.info(
                 "checkpoint: saved shard %d/%d of step %d to %s",
                 shard_rank, shard_count, step, self._dir)
@@ -474,9 +488,11 @@ class Checkpointer:
 
     def restore_sharded(self, target: Any, shard_rank: int,
                         shard_count: int,
-                        step: Optional[int] = None) -> Any:
+                        step: Optional[int] = None,
+                        plan: Any = None) -> Any:
         """Rebuild THIS rank's shard of a sharded state saved at any
-        world size.
+        world size — or under any *plan* with the same model-parallel
+        factorization.
 
         The saved shards concatenate back into the full flat buffer
         (padded to the *saving* world's multiple); ``target``'s leaf
@@ -484,7 +500,14 @@ class Checkpointer:
         buffer is re-padded (or pad-trimmed — the tail is zeros by the
         fusion-spec invariant) to ``shard * shard_count`` and re-sliced
         at ``shard_rank``.  Scalar leaves (optimizer step counters) are
-        replicated across shards; the saving rank 0's value wins."""
+        replicated across shards; the saving rank 0's value wins.
+
+        ``plan`` names the *restoring* run's plan.  When the checkpoint
+        carries a saved plan (:meth:`save_sharded` ``plan=``), the
+        model-parallel extents (pp/ep/sp/tp) must match — those change
+        the parameter tensors themselves, which no flat-buffer reshard
+        can fix — while the data extent (dp×fsdp) reshards exactly like
+        a world-size change."""
         self.wait()
         if step is None:
             step = self._resolve_step()
@@ -492,6 +515,10 @@ class Checkpointer:
             raise FileNotFoundError(f"no checkpoints in {self._dir}")
         path = os.path.join(self._dir, f"step_{step}")
         shards = _load_shards(path)
+        plan_str = _canonical_plan(plan, shard_count)
+        saved_plan = shards[0].get("plan")
+        if saved_plan is not None and plan_str is not None:
+            _check_plan_reshard(saved_plan, plan_str, path)
         saved_trees = [s["state"] for s in shards]
         t_leaves, treedef = jax.tree_util.tree_flatten(target)
         shard_leaves = [jax.tree_util.tree_flatten(t)[0]
@@ -548,6 +575,50 @@ class Checkpointer:
 
 def _shard_name(rank: int, count: int) -> str:
     return f"shard_{rank}_of_{count}.pkl"
+
+
+def _canonical_plan(plan: Any, shard_count: int) -> Optional[str]:
+    """Canonical plan string for shard payloads, validated against the
+    exchange width: the sharded state shards over the plan's data axes,
+    so a plan whose dp×fsdp disagrees with ``shard_count`` would stamp
+    a lie into the checkpoint."""
+    if plan is None:
+        return None
+    from horovod_tpu.parallel.plan import as_plan
+
+    p = as_plan(plan)
+    if p.dp is not None:
+        data_extent = p.dp * p.fsdp
+        if data_extent != shard_count:
+            raise ValueError(
+                f"plan {p.to_string()} shards the exchange over "
+                f"dp*fsdp={data_extent} ranks, but shard_count is "
+                f"{shard_count}")
+    return p.to_string(allow_unresolved=True)
+
+
+def _check_plan_reshard(saved: str, restoring: str, path: str) -> None:
+    """Refuse cross-plan restores that change the model-parallel
+    factorization: pp/ep/sp/tp extents reshape the parameter tensors
+    themselves, so the flat-buffer reshard of :func:`_reshard_leaf`
+    would slice garbage.  Data-extent (dp/fsdp) and virtual-stage
+    changes reshard fine."""
+    from horovod_tpu.parallel.plan import ShardingPlan
+
+    sp = ShardingPlan.from_string(saved.replace("dp=?", "dp=1")
+                                  if "dp=?" in saved else saved)
+    rp = ShardingPlan.from_string(restoring.replace("dp=?", "dp=1")
+                                  if "dp=?" in restoring else restoring)
+    model_axes = ("pp", "ep", "sp", "tp")
+    mismatch = [ax for ax in model_axes
+                if getattr(sp, ax) != getattr(rp, ax)]
+    if mismatch:
+        raise ValueError(
+            f"sharded checkpoint in {path} was saved under plan "
+            f"{saved!r} but the restore runs plan {restoring!r}: "
+            f"model-parallel extents differ on {mismatch} — resharding "
+            f"only covers data-extent (dp/fsdp) changes; re-partition "
+            f"the model to change pp/ep/sp/tp")
 
 
 def _load_shards(path: str) -> list:

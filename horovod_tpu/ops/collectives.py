@@ -330,6 +330,74 @@ def quantized_reducescatter(x: jax.Array, axis: AxisSpec = GLOBAL_AXES,
     return y.astype(x.dtype)
 
 
+def ef_quantized_reducescatter(x: jax.Array,
+                               axis: AxisSpec = GLOBAL_AXES,
+                               op: ReduceOp = Average,
+                               residual: Optional[jax.Array] = None,
+                               bits: int = 8,
+                               segments: Sequence[int] = (),
+                               wire_dtype: Optional[str] = None):
+    """:func:`quantized_reducescatter` with error-feedback residuals
+    (EF-SGD / 1-bit-Adam lineage): the quantization rounding error of
+    step *t* is carried locally and added back to the input of step
+    *t+1*, so the bias of the low-precision wire telescopes away
+    instead of accumulating into the trajectory.
+
+    Per step, with ``r`` the carried residual::
+
+        e   = x + r                  # error-compensated input (fp32)
+        q   = Q(e)                   # shared-scale int8 / fp8 codec
+        r'  = e - dQ(q)              # what the wire failed to carry
+        out = reduce_scatter(q)      # exact low-precision reduction
+
+    ``dQ(q)`` is this rank's *own* dequantized contribution at full
+    buffer length (the codec's exact int32 / fp32 accumulation means
+    the reduced sum is the sum of exactly these per-rank values, so
+    each rank's residual accounts for precisely its share of the
+    total error).  ``op=Average`` scales only the reduced shard; the
+    residual stays in per-rank sum-contribution units, matching the
+    next step's pre-reduction input.
+
+    Returns ``(shard, new_residual)`` — the dequantized 1/world slice
+    (like :func:`quantized_reducescatter`) plus the full-length fp32
+    residual to feed back next step.
+    """
+    if bits != 8:
+        raise ValueError("only 8-bit quantization is supported")
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("ef_quantized_reducescatter supports "
+                         "Sum/Average")
+    wire = _resolve_wire_dtype(wire_dtype)
+    world = axis_size(axis)
+    if x.ndim != 1 or x.shape[0] % world:
+        raise ValueError(
+            f"ef_quantized_reducescatter needs a flat buffer divisible "
+            f"by world size {world}, got shape {x.shape}")
+    x32 = x.astype(jnp.float32)
+    if residual is not None:
+        x32 = x32 + residual.astype(jnp.float32)
+    scale = _shared_wire_scale(x32, segments, axis, qmax=_WIRE_QMAX[wire])
+    ax = axis if isinstance(axis, str) else tuple(axis)
+    if wire == "fp8_e4m3":
+        sent = jnp.clip(x32 / scale, -448.0, 448.0) \
+            .astype(jnp.float8_e4m3fn).astype(jnp.float32)
+        total = lax.psum_scatter(sent, ax, tiled=True)
+    else:
+        q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
+        sent = q.astype(jnp.float32)
+        total = lax.psum_scatter(q.astype(jnp.int32), ax, tiled=True) \
+            .astype(jnp.float32)
+    new_residual = x32 - sent * scale
+    shard = x.shape[0] // world
+    if scale.ndim:          # per-segment scales: this shard's slice
+        scale = lax.dynamic_slice(scale, (axis_index(axis) * shard,),
+                                  (shard,))
+    y = total * scale
+    if op == ReduceOp.AVERAGE:
+        y = y / world
+    return y.astype(x.dtype), new_residual
+
+
 @dataclasses.dataclass(frozen=True)
 class ShardGroup:
     """One fused wire buffer of the sharded exchange: the leaves of a
@@ -474,7 +542,8 @@ def grouped_reducescatter(xs: Sequence[jax.Array],
                           quantized_bits: Optional[int] = None,
                           bucket_bytes: Optional[int] = None,
                           spec: Optional[FusionSpec] = None,
-                          fused_tail: bool = False):
+                          fused_tail: bool = False,
+                          residuals: Optional[Dict[str, jax.Array]] = None):
     """Fused reduce-scatter of many tensors — the first half of the
     ZeRO-style rewrite of :func:`grouped_allreduce` (reduce-scatter →
     shard-local math → allgather), with the same fusion machinery:
@@ -498,6 +567,14 @@ def grouped_reducescatter(xs: Sequence[jax.Array],
     monolithic shared-scale collective (the codec scale is agreed per
     buffer).
 
+    ``residuals`` (a ``{group key: (padded,) fp32}`` dict) switches the
+    quantized groups to the error-feedback codec
+    (:func:`ef_quantized_reducescatter`) and changes the return to
+    ``(shards, spec, new_residuals)`` — feed ``new_residuals`` back on
+    the next call so the wire's rounding bias telescopes away.  Groups
+    without a residual entry (non-floating, or quantization off) pass
+    through unchanged.
+
     Degenerate 1-shard worlds reduce to plain identity semantics: the
     "shard" is the whole (padded) buffer and ``psum_scatter`` over a
     size-1 axis is the local value itself.
@@ -512,6 +589,8 @@ def grouped_reducescatter(xs: Sequence[jax.Array],
             f"spec was planned for world {spec.world}, axis has {world}")
     ax = axis if isinstance(axis, str) else tuple(axis)
     shards: Dict[str, jax.Array] = {}
+    new_residuals: Dict[str, jax.Array] = \
+        dict(residuals) if residuals is not None else {}
     for gi, g in enumerate(spec.groups):
         flat = _group_flat(g, xs, prescale_factor)
         floating = jnp.issubdtype(flat.dtype, jnp.floating)
@@ -520,9 +599,14 @@ def grouped_reducescatter(xs: Sequence[jax.Array],
             # pad rides the last segment: zeros never raise its absmax
             segs = list(g.sizes)
             segs[-1] += g.padded - sum(g.sizes)
-            red = quantized_reducescatter(flat, axis=axis, op=op,
-                                          bits=quantized_bits,
-                                          segments=tuple(segs))
+            if residuals is not None and g.key in residuals:
+                red, new_residuals[g.key] = ef_quantized_reducescatter(
+                    flat, axis=axis, op=op, residual=residuals[g.key],
+                    bits=quantized_bits, segments=tuple(segs))
+            else:
+                red = quantized_reducescatter(flat, axis=axis, op=op,
+                                              bits=quantized_bits,
+                                              segments=tuple(segs))
         elif tail:
             red = _tiled_psum_scatter(flat, ax, world)
             if op == ReduceOp.AVERAGE and floating:
@@ -540,6 +624,8 @@ def grouped_reducescatter(xs: Sequence[jax.Array],
                     "op=Average requires floating dtypes, got "
                     f"{g.dtype}")
         shards[g.key] = _scale(red, postscale_factor)
+    if residuals is not None:
+        return shards, spec, new_residuals
     return shards, spec
 
 
@@ -568,7 +654,10 @@ def hierarchical_reducescatter(xs: Sequence[jax.Array],
                                quantized_bits: Optional[int] = None,
                                bucket_bytes: Optional[int] = None,
                                spec: Optional[FusionSpec] = None,
-                               fused_tail: bool = False):
+                               fused_tail: bool = False,
+                               quantize_inner: bool = False,
+                               inner_residuals: Optional[
+                                   Dict[str, jax.Array]] = None):
     """Topology-aware two-level reduce-scatter — the reduce phase of the
     hierarchical exchange (reference ``NCCLHierarchicalAllreduce``,
     ``nccl_operations.cc:191-341``: NCCL inside the node, MPI across).
@@ -594,6 +683,16 @@ def hierarchical_reducescatter(xs: Sequence[jax.Array],
     :func:`hierarchical_allgather` (cross-slice gather first, then
     intra-slice — each level's traffic stays on its own fabric).
 
+    ``quantize_inner=True`` (requires ``quantized_bits``) additionally
+    puts the codec on the ICI phase — double-compressed wire, for
+    bandwidth-bound multi-slice runs.  Pass ``inner_residuals``
+    (``{group key: (padded,) fp32}``) to run that hop through
+    :func:`ef_quantized_reducescatter` so the extra rounding is
+    error-fed-back instead of biasing the trajectory; the return then
+    becomes ``(shards, spec, new_inner_residuals)``.  Per-leaf segment
+    scales *do* ride the inner hop (the input buffer is still whole,
+    unlike the DCN phase), so small leaves keep their own codec step.
+
     Degenerate axes (size-1 dcn on a single slice, or size-1 ici) fall
     through cleanly: a ``psum_scatter`` over a 1-extent axis is the
     local value, so the two-level form equals the flat one.
@@ -601,6 +700,14 @@ def hierarchical_reducescatter(xs: Sequence[jax.Array],
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError("hierarchical_reducescatter supports "
                          "op=Sum/Average")
+    if quantize_inner and quantized_bits is None:
+        raise ValueError(
+            "quantize_inner puts the codec on the ICI phase; pass "
+            "quantized_bits=8 to select it")
+    if inner_residuals is not None and not quantize_inner:
+        raise ValueError(
+            "inner_residuals carry the ICI codec's error feedback; "
+            "pass quantize_inner=True to enable that hop")
     n_inner = int(lax.axis_size(inner_axis))
     n_outer = int(lax.axis_size(outer_axis))
     world = n_inner * n_outer
@@ -611,6 +718,8 @@ def hierarchical_reducescatter(xs: Sequence[jax.Array],
             f"spec was planned for world {spec.world}, mesh "
             f"({outer_axis},{inner_axis}) has {world}")
     shards: Dict[str, jax.Array] = {}
+    new_residuals: Dict[str, jax.Array] = \
+        dict(inner_residuals) if inner_residuals is not None else {}
     for gi, g in enumerate(spec.groups):
         flat = _group_flat(g, xs, prescale_factor)
         floating = jnp.issubdtype(flat.dtype, jnp.floating)
@@ -622,8 +731,24 @@ def hierarchical_reducescatter(xs: Sequence[jax.Array],
         # surviving block length is still divisible by n_outer.  With
         # fused_tail, the LAST group's intra phase goes tile-granular
         # (the DCN phase already rides the 1/n_inner shard and stays
-        # monolithic so the codec scale agreement is unchanged)
-        if fused_tail and gi == len(spec.groups) - 1:
+        # monolithic so the codec scale agreement is unchanged).
+        # quantize_inner replaces this hop with the shared-scale codec
+        # (per-leaf segments, pad riding the last one — the flat
+        # quantized path's convention), error-fed-back when the caller
+        # carries residuals.
+        if quantize_inner and floating:
+            segs = list(g.sizes)
+            segs[-1] += g.padded - sum(g.sizes)
+            if inner_residuals is not None and g.key in inner_residuals:
+                block, new_residuals[g.key] = ef_quantized_reducescatter(
+                    flat, axis=inner_axis, op=ReduceOp.SUM,
+                    residual=inner_residuals[g.key],
+                    bits=quantized_bits, segments=tuple(segs))
+            else:
+                block = quantized_reducescatter(
+                    flat, axis=inner_axis, op=ReduceOp.SUM,
+                    bits=quantized_bits, segments=tuple(segs))
+        elif fused_tail and gi == len(spec.groups) - 1:
             block = _tiled_psum_scatter(flat, inner_axis, n_inner)
         else:
             block = lax.psum_scatter(flat, inner_axis, tiled=True)
@@ -637,6 +762,8 @@ def hierarchical_reducescatter(xs: Sequence[jax.Array],
         if op == ReduceOp.AVERAGE:
             red = _scale(red, 1.0 / world)
         shards[g.key] = _scale(red, postscale_factor)
+    if inner_residuals is not None:
+        return shards, spec, new_residuals
     return shards, spec
 
 

@@ -206,9 +206,15 @@ def distributed_gradients(op: ReduceOp = Average,
 class ShardedOptimizerState(NamedTuple):
     """State of :func:`sharded_distributed_update`: the wrapped
     optimizer's state over this rank's flat gradient shards — 1/N of
-    the replicated-state footprint per rank."""
+    the replicated-state footprint per rank.
+
+    ``residuals`` (``error_feedback=True`` only, else None) carries the
+    per-group quantization residuals of the low-precision wire — fp32,
+    full padded buffer length per group (each rank compensates its own
+    pre-reduction contribution, which is full-length)."""
 
     inner: object
+    residuals: Optional[object] = None
 
 
 def _static_world(axis: AxisSpec) -> int:
@@ -263,7 +269,8 @@ def sharded_distributed_update(optimizer: optax.GradientTransformation,
                                bucket_bytes: Optional[int] = None,
                                world: Optional[int] = None,
                                hierarchy: str = "auto",
-                               fused_collectives: str = "auto"
+                               fused_collectives: str = "auto",
+                               error_feedback: bool = False
                                ) -> optax.GradientTransformation:
     """ZeRO-style sharded rewrite of ``chain(distributed_gradients,
     optimizer)``: reduce-scatter the gradients, run ``optimizer`` on
@@ -280,6 +287,16 @@ def sharded_distributed_update(optimizer: optax.GradientTransformation,
     (:func:`horovod_tpu.runtime.topology.resolve_hierarchy`).  With
     ``quantized_bits``, the two-level form scopes the int8 wire codec
     to the DCN hop only — ICI hops stay full precision.
+
+    ``error_feedback=True`` (requires ``quantized_bits``) carries the
+    codec's per-group rounding residual in the optimizer state and adds
+    it back to the next step's pre-quantization buffer
+    (:func:`horovod_tpu.ops.collectives.ef_quantized_reducescatter`),
+    telescoping the wire's bias away.  In the flat topology EF wraps
+    the single quantized reduce-scatter; in the two-level topology it
+    additionally turns ON the ICI-hop codec (``quantize_inner``) — the
+    compensated int8/fp8 ICI wire stays numerically pinned to the fp32
+    path, which uncompensated quantization there would not.
 
     Numerically equivalent to allreduce-then-update for *elementwise*
     optimizers (SGD, momentum, Adam/AdamW, RMSProp, …): their update
@@ -325,6 +342,11 @@ def sharded_distributed_update(optimizer: optax.GradientTransformation,
         raise ValueError(
             f"hierarchy must be one of {HIERARCHY_MODES}, got "
             f"{hierarchy!r}")
+    if error_feedback and quantized_bits is None:
+        raise ValueError(
+            "error_feedback compensates the quantized wire's rounding; "
+            "pass quantized_bits=8 (a wire-reduction compression) to "
+            "enable it")
     axes_names = (axis,) if isinstance(axis, str) else tuple(axis)
     if hierarchy == "two_level" and len(axes_names) != 2:
         raise ValueError(
@@ -347,7 +369,17 @@ def sharded_distributed_update(optimizer: optax.GradientTransformation,
         spec = _spec(leaves)
         template = {g.key: jnp.zeros((g.shard,), jnp.dtype(g.dtype))
                     for g in spec.groups}
-        return ShardedOptimizerState(inner=optimizer.init(template))
+        residuals = None
+        if error_feedback:
+            # full padded length per group: each rank compensates its
+            # own pre-reduction contribution (only floating groups ride
+            # the quantized wire)
+            residuals = {
+                g.key: jnp.zeros((g.padded,), jnp.float32)
+                for g in spec.groups
+                if jnp.issubdtype(jnp.dtype(g.dtype), jnp.floating)}
+        return ShardedOptimizerState(inner=optimizer.init(template),
+                                     residuals=residuals)
 
     def update_fn(updates, state, params=None):
         leaves, treedef = jax.tree_util.tree_flatten(updates)
@@ -355,26 +387,48 @@ def sharded_distributed_update(optimizer: optax.GradientTransformation,
         # static, so the branch compiles away and the program contains
         # exactly one exchange topology
         mode = resolve_hierarchy(hierarchy, _static_axis_sizes(axis))
+        residuals = state.residuals if error_feedback else None
         if mode == "two_level":
             outer, inner_ax = axes_names
-            shards, spec = C.hierarchical_reducescatter(
-                leaves, op=op, outer_axis=outer, inner_axis=inner_ax,
-                prescale_factor=prescale_factor,
-                postscale_factor=postscale_factor,
-                quantized_bits=quantized_bits,
-                bucket_bytes=bucket_bytes,
-                fused_tail=fused_tail)
+            if residuals is not None:
+                # EF turns on the ICI codec too — the residual pins it
+                shards, spec, residuals = C.hierarchical_reducescatter(
+                    leaves, op=op, outer_axis=outer, inner_axis=inner_ax,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                    quantized_bits=quantized_bits,
+                    bucket_bytes=bucket_bytes,
+                    fused_tail=fused_tail,
+                    quantize_inner=True, inner_residuals=residuals)
+            else:
+                shards, spec = C.hierarchical_reducescatter(
+                    leaves, op=op, outer_axis=outer, inner_axis=inner_ax,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                    quantized_bits=quantized_bits,
+                    bucket_bytes=bucket_bytes,
+                    fused_tail=fused_tail)
             # shard ownership is row-major over (inner, outer) — the
             # param slices and the reassembly must use that linearization
             own_axes = C.exchange_index_axes(outer, inner_ax)
         else:
-            shards, spec = C.grouped_reducescatter(
-                leaves, op=op, axis=axis,
-                prescale_factor=prescale_factor,
-                postscale_factor=postscale_factor,
-                quantized_bits=quantized_bits,
-                bucket_bytes=bucket_bytes,
-                fused_tail=fused_tail)
+            if residuals is not None:
+                shards, spec, residuals = C.grouped_reducescatter(
+                    leaves, op=op, axis=axis,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                    quantized_bits=quantized_bits,
+                    bucket_bytes=bucket_bytes,
+                    fused_tail=fused_tail,
+                    residuals=residuals)
+            else:
+                shards, spec = C.grouped_reducescatter(
+                    leaves, op=op, axis=axis,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                    quantized_bits=quantized_bits,
+                    bucket_bytes=bucket_bytes,
+                    fused_tail=fused_tail)
             own_axes = axis
         p_shards = None
         if params is not None:
@@ -385,7 +439,9 @@ def sharded_distributed_update(optimizer: optax.GradientTransformation,
                                              p_shards)
         out = C.grouped_allgather(upd_shards, spec, axis=own_axes)
         return jax.tree_util.tree_unflatten(treedef, out), \
-            ShardedOptimizerState(inner=inner)
+            ShardedOptimizerState(inner=inner,
+                                  residuals=residuals
+                                  if error_feedback else None)
 
     return optax.GradientTransformation(init_fn, update_fn)
 
@@ -404,7 +460,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          shard_optimizer_states: bool = False,
                          exchange_bucket_bytes: Optional[int] = None,
                          hierarchy: str = "auto",
-                         fused_collectives: str = "auto"
+                         fused_collectives: str = "auto",
+                         error_feedback: bool = False
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so each update uses cross-replica-reduced
     gradients (reference ``DistributedOptimizer`` factory,
@@ -430,7 +487,11 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     ``(dp_outer, dp_inner)`` extents both > 1, ``"flat"``/``"two_level"``
     force a mode (see :func:`sharded_distributed_update`).  Requires
     ``mode='shard_map'`` and an elementwise ``optimizer`` (see the
-    sharded transform's docstring).
+    sharded transform's docstring).  ``error_feedback=True`` (requires
+    a wire-reduction ``compression``) carries the codec's rounding
+    residual in the sharded state so the low-precision wire stays
+    numerically pinned to the fp32 path (see
+    :func:`sharded_distributed_update`).
     """
     del named_parameters
     if exchange_bucket_bytes is not None and not shard_optimizer_states:
@@ -465,6 +526,10 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                 "shard_optimizer_states supports only wire-reduction "
                 "compression (Compression.int8); compressor-style "
                 "codecs would decompress before the shard slicing")
+    if error_feedback and not shard_optimizer_states:
+        raise ValueError(
+            "error_feedback carries the sharded exchange's quantization "
+            "residual; pass shard_optimizer_states=True to enable it")
     if gradient_predivide_factor != 1.0:
         # reference semantics (torch/optimizer.py:119-123): split the
         # averaging across the sum — grads scale by 1/f before and f/size
@@ -486,7 +551,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
             quantized_bits=qbits,
             bucket_bytes=exchange_bucket_bytes,
             hierarchy=hierarchy,
-            fused_collectives=fused_collectives)
+            fused_collectives=fused_collectives,
+            error_feedback=error_feedback)
         if backward_passes_per_step > 1:
             return optax.MultiSteps(
                 chained, every_k_schedule=backward_passes_per_step)

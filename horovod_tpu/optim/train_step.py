@@ -101,6 +101,8 @@ class DistributedTrainStep:
                  exchange_bucket_bytes: Optional[int] = None,
                  hierarchy: str = "auto",
                  fused_collectives: str = "auto",
+                 error_feedback: bool = False,
+                 plan=None,
                  guard=None):
         """``steps_per_call > 1`` scans that many optimizer steps inside
         the one compiled program (the Keras ``steps_per_execution``
@@ -175,7 +177,73 @@ class DistributedTrainStep:
         ``"flat"``/``"two_level"`` force a mode.  When unset here, the
         runtime config's ``HOROVOD_EXCHANGE_HIERARCHY`` /
         ``HOROVOD_EXCHANGE_BUCKET_BYTES`` env knobs supply the
-        defaults (docs/overlap.md)."""
+        defaults (docs/overlap.md).
+
+        ``error_feedback=True`` (sharded exchange + wire-reduction
+        compression only) carries the per-bucket quantization residual
+        across steps and additionally quantizes the intra-slice (ICI)
+        reduce-scatter hop: each rank re-adds last step's local
+        rounding error before quantizing, so the int8/fp8 wire stays
+        numerically pinned to the fp32 path over a trajectory instead
+        of accumulating rounding bias (docs/parallelism.md).
+
+        ``plan`` (a :class:`~horovod_tpu.parallel.plan.ShardingPlan`
+        or its ``HOROVOD_PLAN`` grammar string; falls back to the env
+        knob) is the declarative parallelism source of truth: it
+        builds the mesh (DCN-outer/ICI-inner ``AXIS_ORDER``) when no
+        ``mesh`` is given, scopes the batch sharding and the gradient
+        exchange to its data axes (dp/fsdp — never the model axes),
+        turns ``fsdp>1`` into ``fsdp_axis`` placement under pjit, and
+        stamps its canonical string into the AOT key so a warm start
+        never serves an executable compiled for a different plan.
+        Pipeline plans (``pp>1``) are rejected here — pipelines run
+        through :mod:`horovod_tpu.parallel.pipeline`."""
+        from horovod_tpu.parallel.plan import ShardingPlan, as_plan
+
+        plan = as_plan(plan)
+        if plan is None and state.is_initialized():
+            cfg_plan = getattr(state.global_state().config, "plan", None)
+            if cfg_plan:
+                plan = ShardingPlan.from_string(cfg_plan)
+        if plan is not None:
+            if mesh is None:
+                plan = plan.resolve(len(jax.devices()))
+                mesh = plan.build_mesh()
+            else:
+                plan = plan.resolve(mesh.size)
+                if not plan.matches_mesh(mesh):
+                    raise ValueError(
+                        f"plan {plan.to_string()} does not match the "
+                        f"given mesh {dict(mesh.shape)}: pass one "
+                        f"source of truth (the plan builds its own "
+                        f"mesh when mesh=None)")
+            if plan.pp > 1:
+                raise ValueError(
+                    f"plan {plan.to_string()} has pp>1: pipeline "
+                    "parallelism runs through parallel.pipeline "
+                    "(gpipe / interleaved_1f1b inside shard_map), not "
+                    "the train step — the step compiles "
+                    "dp/fsdp/tp/ep/sp plans")
+            if mode == "shard_map" and plan.model_axes:
+                raise ValueError(
+                    f"plan {plan.to_string()} has model axes "
+                    f"{plan.model_axes}: mode='shard_map' compiles "
+                    "data-only plans (dp/fsdp) — model-parallel plans "
+                    "need mode='pjit', where GSPMD places the "
+                    "tp/ep/sp shardings the model's modules declare")
+            norm_axes = (data_axes,) if isinstance(data_axes, str) \
+                else tuple(data_axes)
+            if norm_axes == tuple(GLOBAL_AXES):
+                data_axes = plan.data_axes
+            elif norm_axes != plan.data_axes:
+                raise ValueError(
+                    f"data_axes {norm_axes} conflicts with plan "
+                    f"{plan.to_string()} (data axes "
+                    f"{plan.data_axes}): the plan owns the exchange "
+                    "scope — drop the explicit data_axes")
+            if mode == "pjit" and plan.fsdp > 1 and fsdp_axis is None:
+                fsdp_axis = "fsdp"
+        self._plan = plan
         self._mesh = mesh or state.global_state().mesh
         self._mode = mode
         self._optimizer = optimizer
@@ -209,6 +277,18 @@ class DistributedTrainStep:
                 "fused_collectives schedules the sharded exchange's "
                 "final bucket; pass shard_optimizer_states=True to "
                 "enable it")
+        if error_feedback:
+            if not shard_optimizer_states:
+                raise ValueError(
+                    "error_feedback carries the sharded exchange's "
+                    "quantization residual; pass "
+                    "shard_optimizer_states=True to enable it")
+            if compression is None:
+                raise ValueError(
+                    "error_feedback compensates quantization rounding; "
+                    "it needs a wire-reduction compression "
+                    "(Compression.int8)")
+        self._error_feedback = bool(error_feedback)
         if shard_optimizer_states and state.is_initialized():
             # env-contract defaults (HOROVOD_EXCHANGE_*): explicit
             # arguments rule; unset knobs fall back to runtime config
@@ -391,7 +471,8 @@ class DistributedTrainStep:
                     bucket_bytes=exchange_bucket_bytes,
                     world=world,
                     hierarchy=hierarchy,
-                    fused_collectives=self._fused_collectives)
+                    fused_collectives=self._fused_collectives,
+                    error_feedback=self._error_feedback)
                 from horovod_tpu.runtime.topology import resolve_hierarchy
 
                 # the mode the compiled step will actually run (the
@@ -524,6 +605,14 @@ class DistributedTrainStep:
         return self._donate_batch
 
     @property
+    def plan(self):
+        """The resolved :class:`~horovod_tpu.parallel.plan.ShardingPlan`
+        this step was compiled for (None when built from raw
+        mesh/data_axes arguments) — ``bench.py`` emits its canonical
+        string as the ``plan`` BENCH field."""
+        return self._plan
+
+    @property
     def exchange_hierarchy(self):
         """The exchange topology this step runs: ``"two_level"``/
         ``"flat"`` once resolved against the mesh (sharded exchange),
@@ -561,6 +650,8 @@ class DistributedTrainStep:
             "steps_per_call": self._steps_per_call,
             "donate_batch": self._donate_batch,
             "guard": self._guard is not None,
+            "plan": None if self._plan is None else self._plan.to_string(),
+            "error_feedback": self._error_feedback,
         }
 
     def init(self, params):

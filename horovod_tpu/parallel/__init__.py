@@ -9,6 +9,13 @@ package goes from that primitive to the strategies themselves, TPU-first:
 * :mod:`~horovod_tpu.parallel.mesh` — multi-axis mesh factory
   (dp/fsdp/pp/ep/sp/tp) laid out so the most communication-intensive axes
   ride ICI neighbors;
+* :mod:`~horovod_tpu.parallel.plan` — the declarative
+  :class:`~horovod_tpu.parallel.plan.ShardingPlan` (``HOROVOD_PLAN``
+  grammar) driving the train step, the exchange scope, checkpoint
+  resharding and the AOT cache key (docs/parallelism.md);
+* :mod:`~horovod_tpu.parallel.pipeline` — GPipe and interleaved-1F1B
+  pipeline schedules (``lax.scan`` + ``ppermute``, bubbles as masked
+  compute);
 * :mod:`~horovod_tpu.parallel.ring_attention` — blockwise ring attention
   over a sequence axis (``lax.ppermute`` rotation + online softmax);
 * :mod:`~horovod_tpu.parallel.ulysses` — all-to-all sequence↔head
@@ -37,7 +44,13 @@ from horovod_tpu.parallel.fsdp import (
     shard_params,
     sharding_specs,
 )
-from horovod_tpu.parallel.pipeline import gpipe
+from horovod_tpu.parallel.pipeline import (
+    bubble_fraction,
+    gpipe,
+    interleaved_1f1b,
+    pipeline_ticks,
+)
+from horovod_tpu.parallel.plan import ShardingPlan, as_plan
 from horovod_tpu.parallel.ring_attention import ring_attention
 from horovod_tpu.parallel.ulysses import ulysses_attention
 from horovod_tpu.parallel.tensor_parallel import (
@@ -48,7 +61,9 @@ from horovod_tpu.parallel.tensor_parallel import (
 __all__ = [
     "make_parallel_mesh",
     "AXIS_DP", "AXIS_FSDP", "AXIS_PP", "AXIS_EP", "AXIS_SP", "AXIS_TP",
-    "ring_attention", "ulysses_attention", "gpipe",
+    "ShardingPlan", "as_plan",
+    "ring_attention", "ulysses_attention", "gpipe", "interleaved_1f1b",
+    "pipeline_ticks", "bubble_fraction",
     "expert_parallel_ffn", "top1_routing",
     "ColumnParallelDense", "RowParallelDense",
     "fsdp_sharding", "shard_params", "sharding_specs", "resident_bytes",

@@ -1,4 +1,5 @@
-"""GPipe-style pipeline parallelism over the ``pp`` mesh axis.
+"""Pipeline parallelism over the ``pp`` mesh axis: GPipe and
+interleaved-1F1B schedules.
 
 Extension beyond the reference (SURVEY §2.3: no pipeline code exists
 there).  TPU-first formulation: every stage is one mesh shard holding
@@ -8,6 +9,16 @@ pipeline ticks.  All shards execute the same program every tick —
 bubbles are masked computation, not control flow — which is exactly
 what SPMD compilation wants.  Autodiff through the scan + ppermute
 yields the reverse pipeline schedule for the backward pass.
+
+:func:`gpipe` fills the pipe once: ``m + s - 1`` ticks for ``m``
+microbatches over ``s`` stages, bubble fraction ``(s-1)/(m+s-1)``.
+:func:`interleaved_1f1b` cuts the bubble by giving every rank ``v``
+*virtual* stage chunks (rank ``r`` owns global chunks ``j*s + r``):
+each microbatch now crosses the ring ``v`` times doing ``1/v``-sized
+chunks of work, so the same ``s - 1`` warm-up ticks amortize over
+``v*m`` work ticks — bubble ``(s-1)/(v*m+s-1)``, the interleaved-1F1B
+schedule (docs/parallelism.md derives the tick algebra).  ``v=1``
+reduces exactly to GPipe.
 
 Call inside ``shard_map`` with stage parameters sharded over ``axis``
 (stacked on a leading stage dimension) and the input replicated.
@@ -22,6 +33,21 @@ import jax.numpy as jnp
 from jax import lax
 
 from horovod_tpu.parallel.mesh import AXIS_PP
+
+
+def pipeline_ticks(stages: int, microbatches: int,
+                   virtual_stages: int = 1) -> int:
+    """Scan length of the schedule: ``v*m + s - 1`` ticks (``v=1`` is
+    GPipe's ``m + s - 1``)."""
+    return virtual_stages * microbatches + stages - 1
+
+
+def bubble_fraction(stages: int, microbatches: int,
+                    virtual_stages: int = 1) -> float:
+    """Idle share of the schedule, ``(s-1)/(v*m+s-1)`` — the quantity
+    the cost model prices and the bench pipeline probe reports."""
+    return (stages - 1) / pipeline_ticks(stages, microbatches,
+                                         virtual_stages)
 
 
 def gpipe(stage_fn: Callable, stage_params, x: jax.Array,
@@ -78,6 +104,99 @@ def gpipe(stage_fn: Callable, stage_params, x: jax.Array,
     outputs0 = jnp.zeros_like(mbs)
     (_, outputs), _ = lax.scan(tick, (state0, outputs0), jnp.arange(ticks))
     # outputs are only valid on the last stage; fan them out
+    outputs = lax.psum(
+        jnp.where(idx == world - 1, outputs, jnp.zeros_like(outputs)), axis)
+    return outputs.reshape((b,) + x.shape[1:])
+
+
+def interleaved_1f1b(stage_fn: Callable, stage_params, x: jax.Array,
+                     num_microbatches: int, virtual_stages: int = 1,
+                     axis: str = AXIS_PP) -> jax.Array:
+    """Interleaved pipeline schedule: rank ``r`` runs the ``v`` virtual
+    chunks ``{j*s + r}`` of a ``v*s``-stage pipeline.
+
+    Tick algebra (each quantity per rank ``r``): microbatch ``i``
+    (group ``g = i // s``, slot ``k = i % s``) reaches chunk ``j`` on
+    rank ``r`` at tick ``t = g*v*s + j*s + k + r``.  Decoding
+    ``tr = t - r`` recovers ``(g, j, k)`` uniquely, so every rank does
+    exactly one chunk of one microbatch per tick — collision-free —
+    and both hops cost exactly one tick (rank ``r → r+1`` same chunk;
+    the ring wrap ``s-1 → 0`` carries the activation into chunk
+    ``j+1``).  Wall-clock is ``v*m + s - 1`` ticks, bubble
+    ``(s-1)/(v*m+s-1)``.
+
+    Args:
+      stage_fn: ``f(chunk_params, h) -> h`` — one *virtual* chunk
+        (``1/(v*s)`` of the model); activation shapes must be identical
+        across chunks.
+      stage_params: this rank's ``v`` chunk parameter trees, stacked on
+        a leading ``virtual_stages`` dimension (chunk ``j`` of rank
+        ``r`` is global stage ``j*s + r``).
+      x: ``(batch, ...)`` input, replicated across the axis.
+      num_microbatches: pipeline depth ``m``; must divide the batch and
+        be a multiple of the stage count ``s`` (the interleave pattern
+        tiles microbatches in groups of ``s``).
+      virtual_stages: chunks per rank ``v``; ``v=1`` is exactly
+        :func:`gpipe`'s schedule.
+
+    Returns:
+      ``(batch, ...)`` output of the final chunk, replicated across the
+      axis.
+    """
+    world = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    m, v = num_microbatches, virtual_stages
+    if v < 1:
+        raise ValueError(f"virtual_stages must be >= 1, got {v}")
+    b = x.shape[0]
+    if b % m != 0:
+        raise ValueError(f"batch {b} not divisible by "
+                         f"num_microbatches={m}")
+    if m % world != 0:
+        raise ValueError(
+            f"interleaved schedule needs num_microbatches ({m}) "
+            f"divisible by the stage count ({world}): microbatches "
+            f"tile in groups of s across the v chunks")
+    mb = b // m
+    mbs = x.reshape((m, mb) + x.shape[1:])
+
+    fwd_perm = [(i, (i + 1) % world) for i in range(world)]
+    ticks = pipeline_ticks(world, m, v)
+    groups = m // world
+
+    def tick(carry, t):
+        state, outputs = carry
+        tr = t - idx
+        g = tr // (v * world)
+        j = (tr % (v * world)) // world
+        k = tr % world
+        i = g * world + k               # microbatch at this rank now
+        active = (tr >= 0) & (g < groups)
+        # rank 0 injects a fresh microbatch whenever it starts chunk 0;
+        # every other (rank, chunk) consumes what the ring delivered
+        inject = mbs[jnp.clip(i, 0, m - 1)]
+        h_in = jnp.where((idx == 0) & (j == 0), inject, state)
+        params_j = jax.tree_util.tree_map(
+            lambda p: lax.dynamic_index_in_dim(
+                p, jnp.clip(j, 0, v - 1), axis=0, keepdims=False),
+            stage_params)
+        h_out = stage_fn(params_j, h_in)
+        h_out = jnp.where(active, h_out, jnp.zeros_like(h_out))
+        # the last rank's last chunk banks the finished microbatch
+        done = active & (idx == world - 1) & (j == v - 1)
+        slot = jnp.clip(i, 0, m - 1)
+        cur = lax.dynamic_slice_in_dim(outputs, slot, 1, axis=0)
+        outputs = lax.dynamic_update_slice_in_dim(
+            outputs, jnp.where(done, h_out[None], cur), slot, axis=0)
+        # one ring hop per tick; the s-1 → 0 wrap lands exactly when
+        # rank 0 re-injects (j == 0), so a finished microbatch's wrap
+        # value is always ignored
+        state = lax.ppermute(h_out, axis, fwd_perm)
+        return (state, outputs), None
+
+    state0 = jnp.zeros((mb,) + mbs.shape[2:], x.dtype)
+    outputs0 = jnp.zeros_like(mbs)
+    (_, outputs), _ = lax.scan(tick, (state0, outputs0), jnp.arange(ticks))
     outputs = lax.psum(
         jnp.where(idx == world - 1, outputs, jnp.zeros_like(outputs)), axis)
     return outputs.reshape((b,) + x.shape[1:])

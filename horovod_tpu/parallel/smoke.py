@@ -1,0 +1,163 @@
+"""Seeded parallelism-plan smoke for ``hvdci`` (analysis/ci.py gate 6).
+
+A sub-second, CPU-only, virtual-device walk of the sharding-plan
+compiler: one :class:`~horovod_tpu.parallel.plan.ShardingPlan`
+(``dp=2,tp=2,pp=2,v=2``) is parsed, resolved against an 8-rank
+virtual grid, and executed as a numpy lockstep simulation of every
+extent it drives — column-parallel tensor shards (bit-exact vs the
+dense matmul), a fixed-order data-parallel gradient average over the
+plan's :attr:`data_axes`, and the interleaved-1F1B tick schedule
+(bit-exact vs stacked sequential apply, closing in exactly
+``pipeline_ticks`` ticks with every microbatch visiting its v*s
+stages in order).  Run twice and required bit-identical, so plan
+determinism itself is gated.
+
+Returns error strings (empty = pass) in the same idiom as
+``guard.smoke`` / ``serve.smoke`` so ci.py folds it straight into its
+exit code.  Budget: well under a second — pure numpy, 8 virtual
+ranks, four microbatches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from horovod_tpu.parallel.plan import ShardingPlan
+
+PLAN = "dp=2,tp=2,pp=2,v=2"
+WORLD = 8
+MICROBATCHES = 4   # per pipeline group; must divide by pp
+WIDTH = 6          # activation/feature width of the toy stages
+SEED = 4242
+
+
+def _stage(params: Tuple[np.ndarray, np.ndarray],
+           x: np.ndarray) -> np.ndarray:
+    w, b = params
+    return np.tanh(x @ w + b).astype(np.float32)
+
+
+def _pipeline_1f1b(params: List[Tuple[np.ndarray, np.ndarray]],
+                   x: List[np.ndarray], m: int, s: int,
+                   v: int) -> Dict[str, Any]:
+    """Lockstep interleaved-1F1B over ``s`` virtual ranks: rank ``r``
+    holds global chunks ``{j*s + r}``; microbatch ``i`` (``g=i//s``,
+    ``k=i%s``) fires at chunk ``j`` on rank ``r`` at tick
+    ``g*v*s + j*s + k + r`` — the same algebra
+    ``parallel/pipeline.interleaved_1f1b`` runs under ``lax.scan``."""
+    groups = m // s
+    state = [xi.copy() for xi in x]
+    visits: List[List[int]] = [[] for _ in range(m)]
+    last_fire = -1
+    for t in range(v * m + s - 1):
+        for r in range(s):
+            tr = t - r
+            if tr < 0:
+                continue
+            g = tr // (v * s)
+            if g >= groups:
+                continue
+            j = (tr % (v * s)) // s
+            k = tr % s
+            i = g * s + k
+            stage = j * s + r
+            state[i] = _stage(params[stage], state[i])
+            visits[i].append(stage)
+            last_fire = t
+    return {"state": state, "visits": visits, "ticks": last_fire + 1}
+
+
+def _scenario() -> Dict[str, Any]:
+    from horovod_tpu.parallel import bubble_fraction, pipeline_ticks
+
+    plan = ShardingPlan.from_string(PLAN).resolve(WORLD)
+    s, v, m = plan.pp, plan.virtual_stages, MICROBATCHES
+    rng = np.random.RandomState(SEED)
+
+    # -- tensor extent: column-parallel matmul, bit-exact vs dense ----
+    xt = rng.rand(3, WIDTH).astype(np.float32)
+    wt = rng.rand(WIDTH, 2 * WIDTH).astype(np.float32)
+    cols = 2 * WIDTH // plan.tp
+    shards = [xt @ wt[:, r * cols:(r + 1) * cols]
+              for r in range(plan.tp)]
+    tp_exact = bool(np.array_equal(np.concatenate(shards, axis=1),
+                                   xt @ wt))
+
+    # -- data extent: fixed-rank-order gradient average ---------------
+    grads = [np.sin(np.arange(WIDTH, dtype=np.float32) * (1.0 + 0.1 * r))
+             for r in range(plan.dp)]
+    acc = grads[0].copy()
+    for g in grads[1:]:
+        acc = acc + g
+    dp_avg = acc / plan.dp
+
+    # -- pipeline extent: 1F1B schedule vs stacked sequential apply ---
+    params = [(rng.rand(WIDTH, WIDTH).astype(np.float32) * 0.5,
+               rng.rand(WIDTH).astype(np.float32))
+              for _ in range(v * s)]
+    micro = [rng.rand(2, WIDTH).astype(np.float32) for _ in range(m)]
+    pipe = _pipeline_1f1b(params, micro, m, s, v)
+    seq = []
+    for xi in micro:
+        y = xi.copy()
+        for p in params:
+            y = _stage(p, y)
+        seq.append(y)
+    pipe_exact = all(np.array_equal(a, b)
+                     for a, b in zip(pipe["state"], seq))
+    visits_ok = all(vs == list(range(v * s)) for vs in pipe["visits"])
+
+    return {
+        "plan": plan.to_string(),
+        "data_axes": plan.data_axes,
+        "model_axes": plan.model_axes,
+        "total": plan.total,
+        "tp_exact": tp_exact,
+        "dp_avg": [round(float(x), 6) for x in dp_avg],
+        "pipe_exact": pipe_exact,
+        "visits_ok": visits_ok,
+        "ticks": pipe["ticks"],
+        "ticks_expected": pipeline_ticks(s, m, virtual_stages=v),
+        "ticks_gpipe": pipeline_ticks(s, m),
+        "bubble_1f1b": round(bubble_fraction(s, m, virtual_stages=v), 6),
+        "bubble_gpipe": round(bubble_fraction(s, m), 6),
+        "final": [round(float(y.sum()), 6) for y in pipe["state"]],
+    }
+
+
+def run_smoke() -> List[str]:
+    """Run the seeded plan scenario twice; returns a list of error
+    strings (empty = pass)."""
+    errors: List[str] = []
+    try:
+        r1, r2 = _scenario(), _scenario()
+    except Exception as e:          # noqa: BLE001 — a crash IS a failure
+        return [f"plan-smoke: scenario crashed: "
+                f"{type(e).__name__}: {e}"]
+    if r1["plan"] != "dp=2,pp=2,tp=2,v=2":
+        errors.append(f"plan-smoke: canonical plan string is "
+                      f"{r1['plan']!r}, expected 'dp=2,pp=2,tp=2,v=2'")
+    if r1["total"] != WORLD:
+        errors.append(f"plan-smoke: plan covers {r1['total']} devices, "
+                      f"expected {WORLD}")
+    if r1["data_axes"] != ("dp",) or "tp" not in r1["model_axes"]:
+        errors.append(f"plan-smoke: axis split data={r1['data_axes']} "
+                      f"model={r1['model_axes']} does not isolate the "
+                      f"exchange to the data extent")
+    if not r1["tp_exact"]:
+        errors.append("plan-smoke: column-parallel tensor shards do not "
+                      "reproduce the dense matmul bit-exactly")
+    if not r1["pipe_exact"] or not r1["visits_ok"]:
+        errors.append("plan-smoke: interleaved-1F1B schedule diverged "
+                      "from stacked sequential apply")
+    if r1["ticks"] != r1["ticks_expected"]:
+        errors.append(f"plan-smoke: schedule closed in {r1['ticks']} "
+                      f"ticks, cost model says {r1['ticks_expected']}")
+    if not r1["bubble_1f1b"] < r1["bubble_gpipe"]:
+        errors.append(f"plan-smoke: 1F1B bubble {r1['bubble_1f1b']} not "
+                      f"below the GPipe bubble {r1['bubble_gpipe']}")
+    if r1 != r2:
+        errors.append("plan-smoke: two seeded runs were not identical")
+    return errors

@@ -39,6 +39,9 @@ KNOWN_KNOBS = frozenset({
     "HOROVOD_EXCHANGE_WIRE_DTYPE", "HOROVOD_FUSED_COLLECTIVES",
     "HOROVOD_ADASUM_NUM_CHUNKS", "HOROVOD_DEBUG_SPARSE",
     "HOROVOD_TPU_MESH_SHAPE",
+    # -- parallelism plan (parallel/plan.py, docs/parallelism.md):
+    # the ShardingPlan grammar, e.g. "dp=4,tp=2" or "dp=2,pp=2,v=2"
+    "HOROVOD_PLAN",
     # -- warm-start compile cache
     "HOROVOD_COMPILE_CACHE", "HOROVOD_COMPILE_CACHE_DIR",
     # -- input pipeline
@@ -239,6 +242,13 @@ class Config:
     # -- mesh overrides: "8" or "2,4" → (dcn, ici) axis sizes
     mesh_shape: Optional[str] = None
 
+    # -- parallelism plan (HOROVOD_PLAN, parallel/plan.py): the
+    # declarative ShardingPlan grammar ("dp=4,tp=2", "dp=2,pp=2,v=2");
+    # None = data-parallel over the runtime mesh, as before.
+    # DistributedTrainStep picks this up when no explicit plan/mesh is
+    # passed (docs/parallelism.md)
+    plan: Optional[str] = None
+
     # knobs the user set explicitly must not be autotuned
     # (reference "fixed" flag, operations.cc:436)
     fixed_knobs: frozenset = frozenset()
@@ -260,6 +270,7 @@ class Config:
         mark("HOROVOD_EXCHANGE_HIERARCHY", "exchange_hierarchy")
         mark("HOROVOD_EXCHANGE_WIRE_DTYPE", "exchange_wire_dtype")
         mark("HOROVOD_FUSED_COLLECTIVES", "fused_collectives")
+        mark("HOROVOD_PLAN", "plan")
 
         def opt_int(name: str) -> Optional[int]:
             v = os.environ.get(name)
@@ -342,5 +353,6 @@ class Config:
             guard_preempt=_env_bool("HOROVOD_GUARD_PREEMPT", True),
             fault_plan=os.environ.get("HOROVOD_FAULT_PLAN"),
             mesh_shape=os.environ.get("HOROVOD_TPU_MESH_SHAPE"),
+            plan=os.environ.get("HOROVOD_PLAN"),
             fixed_knobs=frozenset(fixed),
         )

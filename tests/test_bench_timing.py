@@ -152,3 +152,53 @@ class TestJsonOut:
     def test_emit_without_path_only_prints(self, capsys):
         bench.emit({"a": 1})
         assert "\"a\": 1" in capsys.readouterr().out
+
+
+class TestPlanProbeFields:
+    """``--plan`` BENCH fields (ISSUE 13): the resolved plan string and
+    the pipeline schedule geometry the acceptance check reads —
+    ``pipeline_bubble_1f1b < pipeline_bubble_gpipe``."""
+
+    class FakeHvd:
+        def __init__(self, n):
+            self._n = n
+
+        def size(self):
+            return self._n
+
+    @staticmethod
+    def _args(plan):
+        import types
+
+        return types.SimpleNamespace(plan=plan)
+
+    def test_no_plan_no_fields(self):
+        assert bench.plan_probe_fields(self._args(None),
+                                       self.FakeHvd(8)) == {}
+
+    def test_non_pipeline_plan_emits_only_the_plan(self):
+        f = bench.plan_probe_fields(self._args("tp=2"), self.FakeHvd(8))
+        assert f == {"plan": "dp=4,tp=2"}   # dp resolved to 8/2
+
+    def test_pipeline_plan_probe_geometry(self):
+        f = bench.plan_probe_fields(self._args("pp=2,v=2"),
+                                    self.FakeHvd(8))
+        assert f["plan"] == "dp=4,pp=2,v=2"   # dp resolved to 8/2
+        assert f["pipeline_stages"] == 2
+        assert f["pipeline_virtual"] == 2
+        assert f["pipeline_microbatches"] == 8
+        # s=2, m=8: GPipe 9 ticks, 1F1B v=2 17 ticks over 2x the work
+        assert f["pipeline_ticks_gpipe"] == 9
+        assert f["pipeline_ticks_1f1b"] == 17
+        # the acceptance inequality, straight off the artifact fields
+        assert f["pipeline_bubble_1f1b"] < f["pipeline_bubble_gpipe"]
+
+    def test_probe_depth_rounds_up_to_stage_multiple(self):
+        f = bench.plan_probe_fields(self._args("dp=1,pp=3,fsdp=2"),
+                                    self.FakeHvd(6))
+        assert f["pipeline_microbatches"] % 3 == 0
+
+    def test_plan_axis_values_enumerate_data_factorizations(self):
+        assert bench._plan_axis_values(8) == \
+            ["dp=8", "dp=4,fsdp=2", "dp=2,fsdp=4", "dp=1,fsdp=8"]
+        assert bench._plan_axis_values(1) == ["dp=1"]

@@ -257,3 +257,44 @@ class TestFusedCollectivesKey:
                                               auto._aot_extras())
         assert k_on != k_off
         assert k_auto == k_off
+
+
+class TestPlanKey:
+    """ISSUE 13 tentpole pin: the sharding plan is an AOT-key field —
+    a plan change is an executable-cache miss, so a warm start never
+    serves a program compiled for a different parallelism layout."""
+
+    def test_key_differs_on_plan_field(self):
+        base = compile_cache.executable_key("module @m {}",
+                                            {"plan": "dp=8"})
+        assert compile_cache.executable_key(
+            "module @m {}", {"plan": "dp=4,fsdp=2"}) != base
+        assert compile_cache.executable_key(
+            "module @m {}", {"plan": None}) != base
+
+    def test_step_extras_carry_canonical_plan(self, cache_dir):
+        step = _make_step(mode="shard_map", plan="dp=8")
+        assert step._aot_extras()["plan"] == "dp=8"
+        bare = _make_step()
+        assert bare._aot_extras()["plan"] is None
+        k_plan = compile_cache.executable_key("module @m {}",
+                                              step._aot_extras())
+        k_bare = compile_cache.executable_key("module @m {}",
+                                              bare._aot_extras())
+        assert k_plan != k_bare
+
+    def test_error_feedback_is_a_key_field(self, cache_dir):
+        """The EF satellite rides the same contract: a residual-
+        carrying executable must not serve an uncompensated config."""
+        def build(ef):
+            return hvd.DistributedTrainStep(
+                _loss, optax.sgd(0.1), mode="shard_map",
+                shard_optimizer_states=True,
+                compression=hvd.Compression.int8, error_feedback=ef)
+
+        on, off = build(True), build(False)
+        assert on._aot_extras()["error_feedback"] is True
+        assert compile_cache.executable_key(
+            "module @m {}", on._aot_extras()) != \
+            compile_cache.executable_key("module @m {}",
+                                         off._aot_extras())

@@ -319,3 +319,101 @@ class TestScoreExchangeSchedule:
             {"hierarchy": "flat", "fused_collectives": "off",
              "steps_per_call": 40}, 1e8, n_dcn=2, n_ici=4)
         assert a == b
+
+    def test_wire_dtype_narrow_scores_at_least_fp32(self):
+        """The codec-width axis ranks: fewer wire bits, less serial
+        exchange time, higher score (int8 and fp8 tie — both 8-bit)."""
+        def score(wd):
+            return CM.score_exchange_schedule(
+                {"hierarchy": "flat", "wire_dtype": wd}, 1e9,
+                n_dcn=2, n_ici=4)
+
+        assert score("int8") > score("fp32")
+        assert score("fp8_e4m3") > score("fp32")
+        assert score("int8") == score("fp8_e4m3")
+
+
+class TestParsePlan:
+    """The analysis-layer mirror of ``ShardingPlan.from_string``
+    (ISSUE 13): a stdlib parser so the cost model prices plan strings
+    without importing the jax-facing parallel package."""
+
+    def test_full_extent_dict(self):
+        ext = CM.parse_plan("dp=2,tp=4")
+        assert ext["dp"] == 2 and ext["tp"] == 4
+        # absent axes fill at 1, every grammar key present
+        assert ext["pp"] == ext["fsdp"] == ext["ep"] == ext["sp"] \
+            == ext["v"] == 1
+
+    def test_dict_passthrough_and_unresolved_dp(self):
+        assert CM.parse_plan({"dp": 4, "pp": 2})["pp"] == 2
+        assert CM.parse_plan("dp=?,tp=8")["dp"] == 1   # prices as dp=1
+
+    def test_errors(self):
+        with pytest.raises(ValueError, match="bad plan term"):
+            CM.parse_plan("dp:2")
+        with pytest.raises(ValueError, match="bad plan term"):
+            CM.parse_plan("zz=2")
+        with pytest.raises(ValueError, match="duplicate plan axis"):
+            CM.parse_plan("dp=2,dp=4")
+        with pytest.raises(ValueError, match=">= 1"):
+            CM.parse_plan("dp=0")
+
+    def test_bubble_matches_pipeline_module(self):
+        """One formula, two layers: the analysis mirror agrees with
+        ``parallel/pipeline.bubble_fraction`` everywhere it's used."""
+        from horovod_tpu.parallel import bubble_fraction
+
+        for s, m, v in [(4, 8, 1), (4, 8, 2), (8, 16, 4), (2, 4, 1)]:
+            assert CM.pipeline_bubble_fraction(s, m, v) == \
+                pytest.approx(bubble_fraction(s, m, virtual_stages=v))
+
+
+class TestPlanCost:
+    """Plan-space pricing (ISSUE 13 tentpole): the cost model ranks
+    parallelism plans so the autotuner prunes the plan axis, and the
+    interleaved-1F1B acceptance pin reads off the bubble term."""
+
+    def test_1f1b_beats_gpipe_in_cost_model(self):
+        """Acceptance pin: same plan with v=2 virtual stages predicts
+        strictly less step time than the v=1 (GPipe) schedule whenever
+        compute dominates — the bubble shrinks (s-1)/(m+s-1) ->
+        (s-1)/(v*m+s-1) and nothing else changes."""
+        kw = dict(payload_bytes=1e9, n_dcn=2, n_ici=4, compute_s=1.0)
+        assert CM.plan_cost_s("dp=2,pp=2,v=2", **kw) < \
+            CM.plan_cost_s("dp=2,pp=2", **kw)
+        one = CM.score_exchange_schedule(
+            {"plan": "dp=2,pp=2"}, 1e9, n_dcn=2, n_ici=4, compute_s=1.0)
+        two = CM.score_exchange_schedule(
+            {"plan": "dp=2,pp=2,v=2"}, 1e9, n_dcn=2, n_ici=4,
+            compute_s=1.0)
+        assert two > one
+
+    def test_model_axes_shrink_the_exchange(self):
+        """tp shards the parameters, so each data replica exchanges
+        1/tp of the payload — a dp=2,tp=4 plan prices below pure
+        dp=8 on the same single-slice fabric (on the 2x4 fabric the
+        two plans coincidentally tie: dp=8's two-level 1/n_ici DCN
+        codec saves exactly what tp=4's payload shrink saves)."""
+        kw = dict(payload_bytes=1e9, n_dcn=1, n_ici=8)
+        assert CM.plan_cost_s("dp=2,tp=4", **kw) < \
+            CM.plan_cost_s("dp=8", **kw)
+
+    def test_plan_wire_bytes_follow_axis_order(self):
+        """dp absorbs the DCN extent first (AXIS_ORDER DCN-outer):
+        dp=2,fsdp=4 on a 2x4 fabric goes two-level with the 1/n_ici
+        DCN hop; dp=8 on one slice (n_dcn=1) stays flat with zero
+        DCN bytes."""
+        two = CM.plan_exchange_wire_bytes("dp=2,fsdp=4", 1e9,
+                                          n_dcn=2, n_ici=4)
+        assert two.dcn > 0 and two.ici > 0
+        flat = CM.plan_exchange_wire_bytes("dp=8", 1e9, n_dcn=1,
+                                           n_ici=8)
+        assert flat.dcn == 0
+
+    def test_pp_only_plan_still_scores(self):
+        """A pipeline-only plan has no gradient exchange to price but
+        the bubble term still ranks it — score is not None."""
+        s = CM.score_exchange_schedule({"plan": "pp=4"}, 1e9,
+                                       compute_s=1.0)
+        assert s is not None and s < 0

@@ -176,6 +176,64 @@ class TestOverlapAndWire:
         assert "resnet_" in findings[0].message
 
 
+class TestPlanComparability:
+    """ISSUE 13 satellite: the plan string guards every throughput/
+    latency comparability key — a dp=8 number against a dp=4,fsdp=2
+    number measures two exchange schedules, not a regression."""
+
+    def _art(self, name, value, plan=None):
+        parsed = {"metric": "resnet50_img_sec_per_chip", "value": value}
+        if plan is not None:
+            parsed["plan"] = plan
+        return PG._validate(name, parsed)
+
+    def test_plan_change_not_diffed(self):
+        base = self._art("base", 3000.0, plan="dp=8")
+        cand = self._art("cand", 1000.0, plan="dp=4,fsdp=2")
+        assert PG.diff([base], cand, PG.Tolerances()) == []
+
+    def test_same_plan_regression_fires(self):
+        base = self._art("base", 3000.0, plan="dp=8")
+        cand = self._art("cand", 1000.0, plan="dp=8")
+        assert [f.rule for f in PG.diff([base], cand,
+                                        PG.Tolerances())] == ["PERF001"]
+
+    def test_planless_artifacts_still_gate(self):
+        """Legacy artifacts carry no plan field; None matches None, so
+        the trajectory keeps gating."""
+        base = self._art("base", 3000.0)
+        cand = self._art("cand", 1000.0)
+        assert [f.rule for f in PG.diff([base], cand,
+                                        PG.Tolerances())] == ["PERF001"]
+
+    def test_plan_is_comparability_not_identity(self):
+        """A plan change skips the diff silently — it is NOT a device-
+        identity mismatch, which refuses with a GateError (the refusal
+        stays reserved for category errors like v5e-vs-v4)."""
+        meta = dict(TestSchema.META)
+        base = PG._validate("base", dict(meta, value=3000.0,
+                                         plan="dp=8"))
+        cand = PG._validate("cand", dict(meta, value=10.0,
+                                         plan="dp=4,fsdp=2"))
+        PG.check_comparable([base], cand)      # no raise
+        assert PG.diff([base], cand, PG.Tolerances()) == []
+        # device identity still refuses, plan or no plan
+        other = PG._validate("other", dict(meta, value=10.0,
+                                           plan="dp=8",
+                                           device_kind="TPU v4"))
+        with pytest.raises(PG.GateError, match="not comparable"):
+            PG.check_comparable([base], other)
+
+    def test_serve_latency_fields_plan_guarded(self):
+        base = PG._validate("base", {"serve_offered_rps": 100,
+                                     "serve_p99_latency_s": 0.010,
+                                     "plan": "dp=8"})
+        cand = PG._validate("cand", {"serve_offered_rps": 100,
+                                     "serve_p99_latency_s": 0.100,
+                                     "plan": "dp=2,fsdp=4"})
+        assert PG.diff([base], cand, PG.Tolerances()) == []
+
+
 class TestSchema:
     META = {"schema_version": 1, "jax_version": "0.4.37",
             "jaxlib_version": "0.4.36", "platform": "tpu",

@@ -1,4 +1,5 @@
-"""Pipeline (gpipe) and expert-parallel (MoE) vs dense oracles."""
+"""Pipeline (gpipe + interleaved-1F1B) and expert-parallel (MoE) vs
+dense oracles."""
 
 import jax
 import jax.numpy as jnp
@@ -8,9 +9,12 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from horovod_tpu.parallel import (
+    bubble_fraction,
     expert_parallel_ffn,
     gpipe,
+    interleaved_1f1b,
     make_parallel_mesh,
+    pipeline_ticks,
     top1_routing,
 )
 
@@ -78,6 +82,119 @@ class TestGPipe:
         gd = jax.grad(loss_dense)(ws, x)
         np.testing.assert_allclose(np.asarray(gp), np.asarray(gd),
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestInterleaved1F1B:
+    """ISSUE 13 satellite: the interleaved schedule's outputs AND
+    grads pinned against stacked sequential apply across several
+    microbatch/virtual-stage shapes; v=1 reduces exactly to GPipe."""
+
+    S = 4                       # pipeline ranks on the 8-device mesh
+
+    def _mesh(self):
+        return make_parallel_mesh(pp=self.S, dp=2,
+                                  devices=jax.devices("cpu")[:8])
+
+    def _stages(self, v, d=16, seed=0):
+        # v*s global stages; rank r holds chunks {j*s + r} stacked on
+        # a leading v dim — reshape (v*s, d, d) -> (v, s, d, d) and
+        # shard the s axis over pp
+        key = jax.random.PRNGKey(seed)
+        ws = jax.random.normal(key, (v * self.S, d, d)) \
+            * (1.0 / np.sqrt(d))
+        x = jax.random.normal(jax.random.fold_in(key, 1), (16, d))
+        return ws, x
+
+    @staticmethod
+    def _stage_fn(w, h):
+        return jnp.tanh(h @ w)
+
+    def _run_pipe(self, ws, x, m, v):
+        def f(w_local, x_local):
+            # (v, 1, d, d) shard -> this rank's (v, d, d) chunk stack
+            return interleaved_1f1b(
+                self._stage_fn, w_local[:, 0], x_local,
+                num_microbatches=m, virtual_stages=v)
+
+        stacked = ws.reshape((v, self.S) + ws.shape[1:])
+        return jax.jit(jax.shard_map(
+            f, mesh=self._mesh(),
+            in_specs=(P(None, "pp"), P("dp")),
+            out_specs=P("dp"), check_vma=False))(stacked, x)
+
+    def _sequential(self, ws, x):
+        h = x
+        for s in range(ws.shape[0]):
+            h = self._stage_fn(ws[s], h)
+        return h
+
+    @pytest.mark.parametrize("m,v", [(4, 1), (8, 1), (4, 2), (8, 2),
+                                     (8, 4)])
+    def test_matches_sequential(self, m, v):
+        ws, x = self._stages(v)
+        out = self._run_pipe(ws, x, m, v)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(self._sequential(ws, x)),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_v1_is_gpipe(self):
+        """virtual_stages=1 runs GPipe's exact schedule — same ticks,
+        same numbers."""
+        ws, x = self._stages(v=1)
+        one = self._run_pipe(ws, x, m=8, v=1)
+
+        def f(w_local, x_local):
+            return gpipe(self._stage_fn, w_local[0], x_local,
+                         num_microbatches=8)
+
+        gp = jax.jit(jax.shard_map(
+            f, mesh=self._mesh(), in_specs=(P("pp"), P("dp")),
+            out_specs=P("dp"), check_vma=False))(ws, x)
+        np.testing.assert_allclose(np.asarray(one), np.asarray(gp),
+                                   rtol=1e-6, atol=1e-6)
+
+    @pytest.mark.parametrize("m,v", [(4, 2), (8, 2)])
+    def test_grads_match_sequential(self, m, v):
+        ws, x = self._stages(v, d=8, seed=2)
+        mesh = self._mesh()
+
+        def loss_pipe(ws, x):
+            stacked = ws.reshape((v, self.S) + ws.shape[1:])
+
+            def f(w_local, x_local):
+                y = interleaved_1f1b(
+                    self._stage_fn, w_local[:, 0], x_local,
+                    num_microbatches=m, virtual_stages=v)
+                return lax.pmean(lax.psum(jnp.sum(y ** 2), "dp"),
+                                 "pp")[None]
+
+            return jax.shard_map(
+                f, mesh=mesh, in_specs=(P(None, "pp"), P("dp")),
+                out_specs=P(), check_vma=False)(stacked, x)[0]
+
+        def loss_dense(ws, x):
+            return jnp.sum(self._sequential(ws, x) ** 2)
+
+        gp = jax.jit(jax.grad(loss_pipe))(ws, x)
+        gd = jax.grad(loss_dense)(ws, x)
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gd),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_microbatch_divisibility_error(self):
+        ws, x = self._stages(v=2)
+        with pytest.raises(ValueError, match="divisible"):
+            self._run_pipe(ws, x, m=6, v=2)
+
+    def test_tick_and_bubble_algebra(self):
+        assert pipeline_ticks(4, 8) == 11
+        assert pipeline_ticks(4, 8, virtual_stages=2) == 19
+        assert bubble_fraction(4, 8) == pytest.approx(3 / 11)
+        assert bubble_fraction(4, 8, virtual_stages=2) == \
+            pytest.approx(3 / 19)
+        # the interleave strictly shrinks the bubble in v
+        bubbles = [bubble_fraction(4, 8, virtual_stages=v)
+                   for v in (1, 2, 4, 8)]
+        assert all(a > b for a, b in zip(bubbles, bubbles[1:]))
 
 
 class TestTop1Routing:
