@@ -891,6 +891,15 @@ def run_chaos(args, hvd):
     * ``chaos_deterministic`` — the whole scenario runs twice from
       scratch; crash point, restored step and the full loss trajectory
       must match exactly.
+
+    With ``--degrade`` the probe additionally runs the plan-aware
+    degradation scenario (docs/elastic.md "Degraded mode"): a ``dp=4``
+    world loses half its devices mid-interval, the resolver shrinks
+    the plan to ``dp=2``, the sharded state reshards to the survivors,
+    the lost steps replay, and the next checkpoint boundary promotes
+    back — emitting ``degrade_from_plan`` / ``degrade_to_plan`` /
+    ``degrade_transition_s`` / ``promoted_step`` and a two-run
+    ``degrade_deterministic`` verdict.
     """
     import shutil
     import tempfile
@@ -1044,7 +1053,7 @@ def run_chaos(args, hvd):
         f"checksum {checksum_s * 1e3:.2f} ms/check, disabled hook "
         f"{disabled_s * 1e9:.0f} ns/step; two-run determinism: "
         f"{guard_deterministic}")
-    return {
+    out = {
         "metric": "chaos_probe",
         "chaos_seed": seed,
         "chaos_steps": steps,
@@ -1064,6 +1073,58 @@ def run_chaos(args, hvd):
         "guard_checksum_seconds": round(checksum_s, 6),
         "guard_disabled_overhead_seconds": round(disabled_s, 9),
     }
+
+    # -- plan-aware degradation: kill a slice -> shrink -> replay -> ----
+    # -- promote (docs/elastic.md "Degraded mode") ----------------------
+    if getattr(args, "degrade", False):
+        from horovod_tpu.elastic import smoke as degrade_smoke
+
+        droot = tempfile.mkdtemp(prefix="bench_degrade_")
+        try:
+            # the seeded scenario runs on a fake clock, so the whole
+            # result dict (events, history, trajectory) is comparable
+            # bit-for-bit across the two runs — no wall-clock exclusion
+            # needed
+            d1 = degrade_smoke._scenario(os.path.join(droot, "run1"))
+            d2 = degrade_smoke._scenario(os.path.join(droot, "run2"))
+            # wall-clock the transition's restore leg against a real
+            # checkpointer: re-slice the 4-way sharded state (momentum
+            # + error-feedback residuals) to the 2-way survivors
+            tckpt = hvd.checkpoint.Checkpointer(
+                os.path.join(droot, "time"), use_orbax=False)
+            width = degrade_smoke.WIDTH
+            degrade_smoke._save(
+                tckpt, 1, np.full((width,), 1.5, np.float32),
+                np.zeros((width,), np.float32),
+                np.zeros((width,), np.float32), degrade_smoke.WORLD)
+            t0 = _time.perf_counter()
+            degrade_smoke._restore(tckpt, 1, degrade_smoke.SHRUNK)
+            transition_s = _time.perf_counter() - t0
+        finally:
+            shutil.rmtree(droot, ignore_errors=True)
+        degrade_deterministic = d1 == d2
+        shrink = next(e for e in d1["history"] if e["kind"] == "shrink")
+        log(f"bench[chaos]: degrade {d1['from_plan']} -> "
+            f"{shrink['to_plan']} at step {shrink['step']} "
+            f"(grad_accum={shrink['grad_accum']}, reshard "
+            f"{transition_s * 1e3:.1f} ms), replayed "
+            f"{d1['steps_lost']} step(s) "
+            f"<= checkpoint_every={degrade_smoke.EVERY}, promoted back "
+            f"to {d1['final_plan']} at step {d1['promoted_step']}; "
+            f"matches fault-free: {d1['final_matches_fault_free']}; "
+            f"two-run determinism: {degrade_deterministic}")
+        out.update({
+            "degrade_from_plan": d1["from_plan"],
+            "degrade_to_plan": shrink["to_plan"],
+            "degrade_step": shrink["step"],
+            "degrade_grad_accum": shrink["grad_accum"],
+            "degrade_steps_lost": d1["steps_lost"],
+            "degrade_transition_s": round(transition_s, 4),
+            "promoted_step": d1["promoted_step"],
+            "degrade_matches_fault_free": d1["final_matches_fault_free"],
+            "degrade_deterministic": degrade_deterministic,
+        })
+    return out
 
 
 def run_serve(args, hvd):
@@ -1544,6 +1605,15 @@ def main():
                         "bounded by this")
     p.add_argument("--chaos-seed", type=int, default=42,
                    help="FaultPlan / data seed for the chaos scenario")
+    p.add_argument("--degrade", action="store_true",
+                   help="with --chaos: also run the plan-aware "
+                        "degradation scenario — kill half the dp=4 "
+                        "world mid-interval, shrink to dp=2 via "
+                        "reshard-restore, replay, promote back at the "
+                        "next checkpoint boundary; emits "
+                        "degrade_from_plan / degrade_to_plan / "
+                        "degrade_transition_s / promoted_step "
+                        "(docs/elastic.md)")
     p.add_argument("--serve", action="store_true",
                    help="run the serving-plane SLO probe instead of the "
                         "training bench: a seeded open-loop generator "

@@ -1,7 +1,7 @@
 """``python -m horovod_tpu.analysis ci`` / ``hvdci`` — the one-shot CI
 entry point.
 
-Six gates, one invocation, one exit code (docs/perf_gate.md):
+Seven gates, one invocation, one exit code (docs/perf_gate.md):
 
 1. **hvdlint** over the pre-commit scope (``--changed``: staged +
    unstaged + untracked files under ``horovod_tpu/``; falls back to the
@@ -19,7 +19,12 @@ Six gates, one invocation, one exit code (docs/perf_gate.md):
 6. the **plan smoke** (``parallel/smoke.py``): a seeded dp×tp×pp
    virtual-device walk of the sharding-plan compiler — tensor shards,
    data-extent exchange and the interleaved-1F1B tick schedule, run
-   twice and required bit-identical (docs/parallelism.md).
+   twice and required bit-identical (docs/parallelism.md);
+7. the **degrade smoke** (``elastic/smoke.py``): the plan-aware
+   degradation loop — seeded kill → dp-shrink reshard → replay →
+   promote at the next checkpoint boundary, bit-exact against a
+   never-degraded run, run twice and required bit-identical
+   (docs/elastic.md "Degraded mode").
 
 The whole run is a tier-1 test with the same <30 s budget as the
 hvdlint self-run, so "CI passed" and "the analysis suite passed" are
@@ -129,12 +134,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     except Exception as e:          # noqa: BLE001 — a crash IS a failure
         plan_errors = [f"plan-smoke crashed: {type(e).__name__}: {e}"]
 
+    # 7 — degrade smoke: the plan-aware degradation loop's kill →
+    # shrink → replay → promote round trip, seeded and deterministic
+    try:
+        from horovod_tpu.elastic.smoke import run_smoke as \
+            run_degrade_smoke
+
+        degrade_errors = run_degrade_smoke()
+    except Exception as e:          # noqa: BLE001 — a crash IS a failure
+        degrade_errors = [f"degrade-smoke crashed: "
+                          f"{type(e).__name__}: {e}"]
+
     elapsed = time.perf_counter() - t0
     gate_findings = gate.findings if gate is not None else []
     rc = 2 if (art_error or gate_error) else (
         1 if (lint.findings or art_findings or gate_findings
               or metrics_errors or guard_errors or serve_errors
-              or plan_errors) else 0)
+              or plan_errors or degrade_errors) else 0)
 
     if args.json_out:
         print(json.dumps({
@@ -144,6 +160,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "guard_smoke_errors": guard_errors,
             "serve_smoke_errors": serve_errors,
             "plan_smoke_errors": plan_errors,
+            "degrade_smoke_errors": degrade_errors,
             "perf_gate": gate.as_json() if gate is not None else None,
             "errors": [e for e in (art_error, gate_error) if e],
             "elapsed_s": round(elapsed, 3),
@@ -163,6 +180,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"hvdci: serve-smoke: {e}")
     for e in plan_errors:
         print(f"hvdci: plan-smoke: {e}")
+    for e in degrade_errors:
+        print(f"hvdci: degrade-smoke: {e}")
     for f in gate_findings:
         print(f.format())
     for err in (art_error, gate_error):
@@ -174,7 +193,8 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"perf-gate {len(gate_findings)} · "
           f"guard-smoke {len(guard_errors)} · "
           f"serve-smoke {len(serve_errors)} · "
-          f"plan-smoke {len(plan_errors)} finding(s) "
+          f"plan-smoke {len(plan_errors)} · "
+          f"degrade-smoke {len(degrade_errors)} finding(s) "
           f"in {elapsed:.2f}s — {'FAIL' if rc else 'ok'}")
     return rc
 

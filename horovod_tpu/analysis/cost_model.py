@@ -274,6 +274,30 @@ def plan_cost_s(plan: Union[str, Dict],
     return float(compute_s) / (1.0 - bubble) + exchange_time_s(wire, hw)
 
 
+def rank_plans(plans: Sequence[Union[str, Dict]],
+               payload_bytes: float,
+               n_dcn: int = 1,
+               n_ici: int = 1,
+               compute_s: float = 0.0,
+               microbatches: int = PLAN_SCORE_MICROBATCHES,
+               hw: HardwareModel = V5E,
+               wire_bits_dcn: int = 8
+               ) -> List[Tuple[float, Union[str, Dict]]]:
+    """Score each plan with :func:`plan_cost_s` and return
+    ``(cost_s, plan)`` pairs sorted cheapest-first.  The sort is
+    stable, so a caller that pre-orders its candidates by preference
+    (``ShardingPlan.degrade_candidates`` puts dp-shrink before
+    fsdp-shrink at equal world size) gets that preference as the
+    tie-break for free."""
+    scored = [(plan_cost_s(p, payload_bytes, n_dcn=n_dcn, n_ici=n_ici,
+                           compute_s=compute_s,
+                           microbatches=microbatches, hw=hw,
+                           wire_bits_dcn=wire_bits_dcn), p)
+              for p in plans]
+    scored.sort(key=lambda cp: cp[0])
+    return scored
+
+
 def score_exchange_schedule(point: Dict,
                             payload_bytes: float,
                             n_dcn: int = 1,

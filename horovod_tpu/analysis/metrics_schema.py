@@ -64,6 +64,40 @@ SERVE_SERIES = frozenset({
     "hvd_serve_scale_events_total",
 })
 
+# the elastic plane's closed series vocabulary (docs/elastic.md,
+# docs/faults.md): generation lifecycle, commit/restore bookkeeping and
+# per-worker health verdicts in the hvd_elastic_* namespace
+ELASTIC_SERIES = frozenset({
+    "hvd_elastic_generations_ready_total",
+    "hvd_elastic_recovery_seconds",
+    "hvd_elastic_generation_detect_seconds",
+    "hvd_elastic_generation_steps_lost",
+    "hvd_elastic_generation",
+    "hvd_elastic_world_size",
+    "hvd_elastic_commits_total",
+    "hvd_elastic_steps_committed",
+    "hvd_elastic_restore_seconds",
+    "hvd_elastic_restored_step",
+    "hvd_elastic_steps_lost",
+    "hvd_elastic_worker_suspect_total",
+    "hvd_elastic_worker_deaths_total",
+    "hvd_elastic_detect_seconds",
+    "hvd_elastic_straggler_ratio",
+})
+
+# the graceful-degradation plane's closed series vocabulary
+# (docs/elastic.md "Degraded mode"): plan transitions, wait verdicts and
+# the degraded-world gauges in the hvd_degrade_* namespace
+DEGRADE_SERIES = frozenset({
+    "hvd_degrade_transitions_total",
+    "hvd_degrade_waits_total",
+    "hvd_degrade_active",
+    "hvd_degrade_data_extent",
+    "hvd_degrade_grad_accum",
+    "hvd_degrade_transition_seconds",
+    "hvd_degrade_promoted_step",
+})
+
 
 def _check_guard_series(errors: List[str], obj, field: str) -> None:
     if not isinstance(obj, dict):
@@ -87,6 +121,30 @@ def _check_serve_series(errors: List[str], obj, field: str) -> None:
                 errors.append(
                     f"{field}[{k!r}]: unknown serve series {base!r} — "
                     f"not in metrics_schema.SERVE_SERIES")
+
+
+def _check_elastic_series(errors: List[str], obj, field: str) -> None:
+    if not isinstance(obj, dict):
+        return      # shape error already reported by _check_series_map
+    for k in obj:
+        if isinstance(k, str) and k.startswith("hvd_elastic"):
+            base = k.split("{", 1)[0]
+            if base not in ELASTIC_SERIES:
+                errors.append(
+                    f"{field}[{k!r}]: unknown elastic series {base!r} — "
+                    f"not in metrics_schema.ELASTIC_SERIES")
+
+
+def _check_degrade_series(errors: List[str], obj, field: str) -> None:
+    if not isinstance(obj, dict):
+        return      # shape error already reported by _check_series_map
+    for k in obj:
+        if isinstance(k, str) and k.startswith("hvd_degrade"):
+            base = k.split("{", 1)[0]
+            if base not in DEGRADE_SERIES:
+                errors.append(
+                    f"{field}[{k!r}]: unknown degrade series {base!r} — "
+                    f"not in metrics_schema.DEGRADE_SERIES")
 
 
 def _check_series_map(errors: List[str], obj, field: str) -> None:
@@ -161,6 +219,12 @@ def validate_snapshot(obj: Dict) -> List[str]:
     _check_serve_series(errors, obj.get("counters", {}), "counters")
     _check_serve_series(errors, obj.get("gauges", {}), "gauges")
     _check_serve_series(errors, obj.get("histograms", {}), "histograms")
+    _check_elastic_series(errors, obj.get("counters", {}), "counters")
+    _check_elastic_series(errors, obj.get("gauges", {}), "gauges")
+    _check_elastic_series(errors, obj.get("histograms", {}), "histograms")
+    _check_degrade_series(errors, obj.get("counters", {}), "counters")
+    _check_degrade_series(errors, obj.get("gauges", {}), "gauges")
+    _check_degrade_series(errors, obj.get("histograms", {}), "histograms")
     return errors
 
 
@@ -176,6 +240,8 @@ def validate_bench_metrics(obj: Dict) -> List[str]:
     _check_series_map(errors, obj.get("counters", {}), "metrics.counters")
     _check_guard_series(errors, obj.get("counters", {}), "metrics.counters")
     _check_serve_series(errors, obj.get("counters", {}), "metrics.counters")
+    _check_elastic_series(errors, obj.get("counters", {}), "metrics.counters")
+    _check_degrade_series(errors, obj.get("counters", {}), "metrics.counters")
     return errors
 
 

@@ -486,6 +486,25 @@ class Checkpointer:
         return ocp.StandardCheckpointer().restore(
             os.path.join(self._dir, str(step), "default"), host_target)
 
+    def saved_plan(self, step: Optional[int] = None) -> Optional[str]:
+        """The parallelism plan stamped into ``step``'s sharded
+        checkpoint (``save_sharded`` ``plan=``), or None when the step
+        holds no shard files or an unstamped legacy one.  The degrade
+        resolver reads this before a transition: the restoring plan's
+        model extent must match the stamp or ``restore_sharded`` will
+        refuse (elastic/degrade.py, docs/elastic.md)."""
+        self.wait()
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        path = os.path.join(self._dir, f"step_{step}")
+        try:
+            shards = _load_shards(path)
+        except (FileNotFoundError, ValueError):
+            return None
+        return shards[0].get("plan")
+
     def restore_sharded(self, target: Any, shard_rank: int,
                         shard_count: int,
                         step: Optional[int] = None,

@@ -11,10 +11,18 @@ re-initialized against the new rendezvous, meshes rebuilt, and all jitted
 collectives recompile on first use (caches are invalidated here).
 """
 
+from horovod_tpu.elastic.degrade import (
+    DegradeController,
+    DegradeDecision,
+    DegradedPlanResolver,
+    preserve_global_batch,
+)
 from horovod_tpu.elastic.state import ObjectState, State, TpuState, run
 from horovod_tpu.exceptions import HorovodInternalError, HostsUpdatedInterrupt
 
 __all__ = [
     "State", "ObjectState", "TpuState", "run",
     "HorovodInternalError", "HostsUpdatedInterrupt",
+    "DegradeController", "DegradeDecision", "DegradedPlanResolver",
+    "preserve_global_batch",
 ]

@@ -16,7 +16,12 @@ Robustness changes over the reference (docs/faults.md):
   of a permanent blacklist: a flapping host stops churning generations
   (each relapse doubles its cooldown) but a genuinely recovered host
   rejoins without operator action.  The permanent :meth:`HostManager.
-  blacklist` remains for explicit operator blacklisting.
+  blacklist` remains for explicit operator blacklisting;
+* **starvation escape**: when a discovery pass finds hosts but every
+  one of them is excluded, the earliest-eligible quarantined host is
+  readmitted on probation instead of reporting an empty cluster — an
+  all-flapping fleet must degrade to "keep trying the least-bad host",
+  never to a discovery loop that stalls forever.
 """
 
 from __future__ import annotations
@@ -227,6 +232,20 @@ class HostQuarantine:
         rec = self._hosts.get(host)
         return None if rec is None else rec["state"]
 
+    def force_probation(self, host: str) -> bool:
+        """Readmit a quarantined host before its cooldown expires —
+        the anti-starvation escape hatch (:meth:`HostManager.
+        update_available_hosts`): the failure count is retained, so a
+        relapse still gets the doubled cooldown.  Returns False when
+        the host has no quarantine record to lift."""
+        rec = self._hosts.get(host)
+        if rec is None or rec["state"] != _QUARANTINED:
+            return False
+        rec["state"] = _PROBATION
+        rec["until"] = self._clock() + self.probation_s
+        _TEL_QUARANTINE.inc(event="probation")
+        return True
+
     def failures(self, host: str) -> int:
         rec = self._hosts.get(host)
         return 0 if rec is None else rec["failures"]
@@ -263,9 +282,36 @@ class HostManager:
         the pass after its cooldown ends."""
         found = self._discovery.find_available_hosts_and_slots()
         with self._lock:
-            found = {h: s for h, s in found.items()
-                     if h not in self._blacklist
-                     and not self._quarantine.is_excluded(h)}
+            usable = {h: s for h, s in found.items()
+                      if h not in self._blacklist
+                      and not self._quarantine.is_excluded(h)}
+            if not usable and found and not self._quarantine.disabled:
+                # starvation escape: every discovered host is excluded
+                # (quarantine ∪ blacklist), so without intervention the
+                # discovery loop would report an empty cluster until a
+                # cooldown happens to expire — potentially forever with
+                # flapping hosts re-doubling their cooldowns.  Readmit
+                # the earliest-eligible quarantined host on probation
+                # (failure count retained); permanently-blacklisted
+                # hosts stay out, and HOROVOD_QUARANTINE_DISABLE=1
+                # keeps the reference's exclude-forever behavior.
+                cands = [h for h in found
+                         if h not in self._blacklist
+                         and self._quarantine.status(h) == _QUARANTINED]
+                if cands:
+                    pick = min(cands, key=lambda h: (
+                        self._quarantine.remaining_s(h), h))
+                    waived = self._quarantine.remaining_s(pick)
+                    self._quarantine.force_probation(pick)
+                    usable[pick] = found[pick]
+                    hvd_logging.warning(
+                        "elastic: every discovered host is excluded "
+                        "(quarantine/blacklist) — readmitting host %s "
+                        "early on probation (%.0fs of cooldown waived, "
+                        "%d prior failure(s)) to avoid discovery "
+                        "starvation", pick, waived,
+                        self._quarantine.failures(pick))
+            found = usable
             prev = self._available
             res = HostUpdateResult.no_update
             if any(h not in found or found[h] < prev[h] for h in prev):

@@ -51,10 +51,16 @@ class GetRankAndSizeRequest:
 
 class RankAndSizeResponse:
     def __init__(self, slot: Optional[SlotInfo], coordinator_addr: str,
-                 generation: int):
+                 generation: int, plan: Optional[str] = None):
         self.slot = slot
         self.coordinator_addr = coordinator_addr
         self.generation = generation
+        # the parallelism plan of this generation's world (canonical
+        # HOROVOD_PLAN string) when a degrade controller is attached:
+        # a worker rejoining after a degrade/promote transition must
+        # rebuild its mesh for the CURRENT plan, not the one it was
+        # launched with (elastic/degrade.py)
+        self.plan = plan
 
 
 class ElasticDriver:
@@ -121,6 +127,11 @@ class ElasticDriver:
         # departure (guard/preempt.py): their exit — any code — is
         # graceful, so no blacklist, no quarantine, no sibling abort
         self._planned_departures: set = set()
+        # plan-aware graceful degradation (elastic/degrade.py): when a
+        # DegradeController is attached, a world-size change re-resolves
+        # the ShardingPlan to the survivors instead of blocking on full
+        # capacity, and promotion grows it back when hosts return
+        self._degrade = None
         self._create_worker_fn: Optional[Callable] = None
         self._shutdown = threading.Event()
         self._resume_lock = threading.Lock()   # serialize concurrent resumes
@@ -152,6 +163,23 @@ class ElasticDriver:
     def generation(self) -> int:
         with self._lock:
             return self._generation
+
+    @property
+    def degrade_controller(self):
+        return self._degrade
+
+    def set_degrade_controller(self, controller) -> None:
+        """Attach a :class:`~horovod_tpu.elastic.degrade.
+        DegradeController`: reassignment consults it for the plan the
+        surviving world should run, ``resume`` waits only for its
+        minimum world (the model extent) instead of ``min_np``, and
+        workers receive the current plan with their assignment."""
+        with self._lock:
+            self._degrade = controller
+
+    def _plan_string(self) -> Optional[str]:
+        ctl = self._degrade
+        return None if ctl is None else ctl.current_plan.to_string()
 
     @property
     def health_monitor(self) -> HealthMonitor:
@@ -236,7 +264,8 @@ class ElasticDriver:
                         self._update_host_assignments()
                     slot = self._assignments.get((req.host, req.local_rank))
                 resp = RankAndSizeResponse(slot, self._coordinator_addr,
-                                           self._generation)
+                                           self._generation,
+                                           plan=self._plan_string())
             if slot is not None:
                 # a worker fetching its assignment has a live control loop
                 # — the reference records READY at the rendezvous GET
@@ -294,6 +323,10 @@ class ElasticDriver:
                 "steps_lost": (max(step_at_detect - step_now, 0)
                                if step_at_detect is not None
                                and step_now >= 0 else None),
+                # the plan this generation's world runs (None without a
+                # degrade controller): ties the recovery record to the
+                # shrink/promote transitions in docs/elastic.md
+                "plan": self._plan_string(),
             }
             self._generation_history.append(entry)
         # registry mirror of the history entry (generation-labeled so a
@@ -432,15 +465,19 @@ class ElasticDriver:
 
     def wait_for_available_slots(self, min_np: int,
                                  fallback_min: Optional[int] = None,
-                                 fallback_after: Optional[float] = None
+                                 fallback_after: Optional[float] = None,
+                                 deadline_s: Optional[float] = None
                                  ) -> None:
         """Block until discovery supplies ≥ min_np slots (reference
         ``wait_for_available_slots:145``).  With a fallback, accept
         ``fallback_min`` slots once ``fallback_after`` seconds have
         passed — start-small-grow-later elasticity when the requested
-        world doesn't fully materialize."""
+        world doesn't fully materialize.  ``deadline_s`` overrides the
+        driver timeout (the degrade path's ``HOROVOD_DEGRADE_WAIT_S``
+        bound on waiting for a lost model extent to return)."""
         start = time.monotonic()
-        deadline = start + self._timeout
+        deadline = start + (self._timeout if deadline_s is None
+                            else deadline_s)
         while not self._shutdown.is_set():
             avail = self._host_manager.available_slots
             if avail >= min_np:
@@ -521,6 +558,13 @@ class ElasticDriver:
                 self._max_np or sum(h.slots for h in hosts))
             self._assignments = {(s.hostname, s.local_rank): s
                                  for s in assignments}
+            if self._degrade is not None:
+                # re-resolve the plan to the new world BEFORE workers
+                # fetch their assignment: shrink when capacity was
+                # lost, promote when it came back (a "wait" verdict
+                # leaves the current plan standing — resume() already
+                # blocked for at least the model extent)
+                self._degrade.on_world_change(len(self._assignments))
             self._registry.purge_unassigned(set(self._assignments))
             self._health.purge(set(self._assignments))
             self._worker_metrics.purge(
@@ -755,7 +799,16 @@ class ElasticDriver:
                 self.stop(1)
                 return
             try:
-                self.wait_for_available_slots(self._min_np)
+                if self._degrade is not None:
+                    # degraded continuation: only the model extent is
+                    # load-bearing — any world that hosts it can train
+                    # (at a shrunk dp/fsdp).  Bound the wait with the
+                    # degrade deadline, not the full elastic timeout.
+                    self.wait_for_available_slots(
+                        max(1, self._degrade.min_world()),
+                        deadline_s=self._degrade.wait_s)
+                else:
+                    self.wait_for_available_slots(self._min_np)
             except TimeoutError as e:
                 hvd_logging.warning("elastic: %s", e)
                 self.stop(1)

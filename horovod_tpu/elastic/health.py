@@ -17,7 +17,14 @@ step counter), and the monitor applies two detectors:
 * **progress**: a worker whose beats keep arriving but whose step
   counter has not advanced for ``progress_timeout_s`` is declared hung
   (:class:`~horovod_tpu.utils.stall.ProgressWatchdog` per worker) —
-  the hung-but-alive case liveness alone cannot see.
+  the hung-but-alive case liveness alone cannot see;
+* **stragglers** (observability-only): each worker's step-rate EWMA
+  (off the same heartbeat step piggyback) is compared to the fleet
+  median; one falling to ``1/straggler_ratio`` of the median gets a
+  ``suspect_slow`` verdict — a worker-labeled
+  ``hvd_elastic_straggler_ratio`` gauge and a one-shot warning, never
+  a regeneration (a slow worker still makes progress; killing it
+  trades throughput for a recovery stall).
 
 Workers appear here only after their first heartbeat: never-started
 workers are the startup watchdog's job (``driver._check_started``).
@@ -28,14 +35,17 @@ Knobs: ``HOROVOD_ELASTIC_HEARTBEAT_INTERVAL`` (seconds between worker
 beats, 0 disables the subsystem), ``HOROVOD_ELASTIC_HEARTBEAT_SUSPECT_
 MISSES``, ``HOROVOD_ELASTIC_HEARTBEAT_DEAD_S``,
 ``HOROVOD_ELASTIC_PROGRESS_TIMEOUT_S`` (0 disables the progress
-detector), and ``HOROVOD_ELASTIC_DEPART_GRACE_S`` (how long an
+detector), ``HOROVOD_ELASTIC_DEPART_GRACE_S`` (how long an
 announced planned departure may linger before the wedged worker falls
-back to the normal dead-worker path).  See docs/running.md.
+back to the normal dead-worker path), and
+``HOROVOD_ELASTIC_STRAGGLER_RATIO`` (suspect_slow threshold, 0
+disables the straggler detector).  See docs/running.md.
 """
 
 from __future__ import annotations
 
 import os
+import statistics
 import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
@@ -48,6 +58,8 @@ DEFAULT_INTERVAL_S = 2.0
 DEFAULT_SUSPECT_MISSES = 3
 DEFAULT_DEAD_MULTIPLE = 10     # dead_s default = interval * this
 DEFAULT_DEPART_GRACE_MULTIPLE = 3   # depart_grace_s default = dead_s * this
+DEFAULT_STRAGGLER_RATIO = 3.0  # suspect_slow at median/rate >= this
+STRAGGLER_EWMA_ALPHA = 0.3     # smoothing of the per-worker step rate
 
 # health-plane telemetry (docs/metrics.md): what used to exist only as
 # log lines.  Heartbeat age + progress stall are the precursors
@@ -66,6 +78,10 @@ _TEL_DEATHS = telemetry.counter(
 _TEL_DETECT = telemetry.gauge(
     "hvd_elastic_detect_seconds",
     "silence/stagnation span of the most recent death declaration")
+_TEL_STRAGGLER = telemetry.gauge(
+    "hvd_elastic_straggler_ratio",
+    "fleet-median step rate over this worker's EWMA step rate "
+    "(1.0 = keeping pace; >= the straggler threshold = suspect_slow)")
 
 
 def heartbeat_interval_s() -> float:
@@ -74,7 +90,8 @@ def heartbeat_interval_s() -> float:
 
 
 class _WorkerHealth:
-    __slots__ = ("last_beat", "suspect", "progress")
+    __slots__ = ("last_beat", "suspect", "progress",
+                 "rate", "last_step", "last_step_t", "slow")
 
     def __init__(self, now: float, clock, name: str = ""):
         self.last_beat = now
@@ -82,6 +99,26 @@ class _WorkerHealth:
         # named: the per-worker progress watchdog publishes its
         # stagnation gauge, the scrapeable hung-worker precursor
         self.progress = ProgressWatchdog(clock=clock, name=name or None)
+        # straggler detector state: EWMA steps/s off the heartbeat's
+        # step piggyback, compared to the fleet median in check()
+        self.rate: Optional[float] = None
+        self.last_step: Optional[int] = None
+        self.last_step_t: Optional[float] = None
+        self.slow = False
+
+    def observe_step(self, step: int, now: float) -> None:
+        """Fold a step report into the EWMA rate (advances only — a
+        repeated step is the progress watchdog's business)."""
+        if self.last_step is None:
+            self.last_step, self.last_step_t = step, now
+            return
+        if step <= self.last_step or now <= self.last_step_t:
+            return
+        inst = (step - self.last_step) / (now - self.last_step_t)
+        self.rate = inst if self.rate is None else (
+            STRAGGLER_EWMA_ALPHA * inst
+            + (1.0 - STRAGGLER_EWMA_ALPHA) * self.rate)
+        self.last_step, self.last_step_t = step, now
 
 
 class HealthMonitor:
@@ -91,6 +128,7 @@ class HealthMonitor:
                  dead_s: Optional[float] = None,
                  progress_timeout_s: float = 0.0,
                  depart_grace_s: Optional[float] = None,
+                 straggler_ratio: float = DEFAULT_STRAGGLER_RATIO,
                  clock: Callable[[], float] = time.monotonic,
                  start_thread: bool = True):
         self._on_dead = on_dead
@@ -102,6 +140,7 @@ class HealthMonitor:
         self.depart_grace_s = float(depart_grace_s) \
             if depart_grace_s is not None \
             else self.dead_s * DEFAULT_DEPART_GRACE_MULTIPLE
+        self.straggler_ratio = float(straggler_ratio)  # 0 disables
         self._clock = clock
         self._start_thread = start_thread
         self._lock = threading.Lock()
@@ -130,7 +169,10 @@ class HealthMonitor:
             dead_s=float(dead_env) if dead_env else None,
             progress_timeout_s=float(os.environ.get(
                 "HOROVOD_ELASTIC_PROGRESS_TIMEOUT_S", 0.0)),
-            depart_grace_s=float(grace_env) if grace_env else None)
+            depart_grace_s=float(grace_env) if grace_env else None,
+            straggler_ratio=float(os.environ.get(
+                "HOROVOD_ELASTIC_STRAGGLER_RATIO",
+                DEFAULT_STRAGGLER_RATIO)))
 
     @property
     def enabled(self) -> bool:
@@ -185,6 +227,7 @@ class HealthMonitor:
                 w.suspect = False
             if step >= 0:
                 w.progress.update(step, now=now)
+                w.observe_step(step, now)
 
     def mark_departing(self, host: str, local_rank: int) -> None:
         """A planned (preemption-grace) departure was announced: stop
@@ -221,6 +264,48 @@ class HealthMonitor:
             vals = [w.progress.value for w in self._workers.values()
                     if w.progress.value is not None]
         return max(vals) if vals else -1
+
+    def stragglers(self) -> list:
+        """``(host, local_rank)`` keys currently under a
+        ``suspect_slow`` verdict (observability-only: no regeneration,
+        no quarantine — docs/elastic.md)."""
+        with self._lock:
+            return [k for k, w in self._workers.items() if w.slow]
+
+    def _check_stragglers(self) -> None:
+        """Per-worker EWMA step rate vs the fleet median (caller holds
+        the lock).  A worker whose rate falls to ``1/straggler_ratio``
+        of the median gets a one-shot ``suspect_slow`` warning and a
+        worker-labeled gauge; the verdict clears when it catches back
+        up.  Needs >= 2 rated workers — a fleet of one has no median
+        worth trusting."""
+        if self.straggler_ratio <= 0:
+            return
+        rated = [(k, w) for k, w in self._workers.items()
+                 if w.rate is not None and w.rate > 0]
+        if len(rated) < 2:
+            return
+        med = statistics.median(w.rate for _, w in rated)
+        if med <= 0:
+            return
+        for (host, lr), w in rated:
+            ratio = med / w.rate
+            _TEL_STRAGGLER.set(ratio, worker=f"{host}:{lr}")
+            if ratio >= self.straggler_ratio:
+                if not w.slow:
+                    w.slow = True
+                    hvd_logging.warning(
+                        "elastic: worker %s:%d is suspect_slow — "
+                        "stepping at %.3g/s vs fleet median %.3g/s "
+                        "(%.1fx slower; threshold %.1fx). "
+                        "Observability-only: not a death verdict",
+                        host, lr, w.rate, med, ratio,
+                        self.straggler_ratio)
+            elif w.slow:
+                w.slow = False
+                hvd_logging.info(
+                    "elastic: worker %s:%d caught back up "
+                    "(%.1fx the fleet median)", host, lr, ratio)
 
     # -- detection ----------------------------------------------------------
 
@@ -260,6 +345,7 @@ class HealthMonitor:
                         "heartbeat(s) (%.1fs silent; declared dead at "
                         "%.1fs)", key[0], key[1],
                         age / self.interval_s, age, self.dead_s)
+            self._check_stragglers()
             if self.depart_grace_s > 0:
                 # bounded exemption: an announced departure that never
                 # became a process exit is a wedged worker, not a

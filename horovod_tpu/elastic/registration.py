@@ -33,6 +33,14 @@ class WorkerStateRegistry:
     def reset_count(self) -> int:
         return self._reset_count
 
+    @property
+    def failure_count(self) -> int:
+        """Total FAILURE records this job — the degrade plane's cheap
+        capacity-churn signal (a world that keeps failing should stay
+        shrunk rather than promote into the same flaky hosts)."""
+        with self._lock:
+            return self._failure_count
+
     def get_state(self, host: str, local_rank: int) -> str:
         with self._lock:
             return self._states.get((host, local_rank), "")

@@ -1,0 +1,224 @@
+"""Seeded degrade-chaos smoke for ``hvdci`` (analysis/ci.py gate 7).
+
+A sub-second, CPU-only, pure-sim walk of the plan-aware degradation
+story (docs/elastic.md "Degraded mode"): a ``dp=4`` world trains with
+ZeRO-style sharded optimizer state (momentum + error-feedback
+residual, flat fusion-buffer slices), loses half its devices at a
+non-boundary step, and the :class:`~horovod_tpu.elastic.degrade.
+DegradedPlanResolver` shrinks the plan to ``dp=2``:
+``checkpoint.restore_sharded`` re-slices the 4-way shards to 2-way
+(residuals included), gradient accumulation doubles to preserve the
+global batch, and the lost steps replay.  At the next checkpoint
+boundary capacity returns and the controller promotes back to
+``dp=4`` — the 2-way shards re-slice to 4-way.  The update math is
+elementwise over the flat buffers, so every decomposition is
+bit-exact against a never-degraded run: the final state must match
+fault-free exactly, and the whole scenario runs twice and must be
+bit-identical, so degrade determinism itself is gated.
+
+The three degradation chaos sites fire on their normal no-plan no-op
+path here (``degrade.resolve``, ``degrade.reshard``,
+``elastic.promote`` — docs/faults.md); fault-plan-driven kills of the
+transition are exercised in ``tests/test_degrade.py``.
+
+Returns error strings (empty = pass) in the same idiom as
+``guard.smoke`` / ``serve.smoke`` / ``parallel.smoke`` so ci.py folds
+it straight into its exit code.  Budget: well under a second — pure
+numpy, a tempdir checkpointer, 12 simulated steps.
+"""
+
+from __future__ import annotations
+
+import tempfile
+from typing import Any, Dict, List
+
+import numpy as np
+
+from horovod_tpu.elastic.degrade import (
+    DegradeController, DegradedPlanResolver, preserve_global_batch,
+    reshard_restore,
+)
+from horovod_tpu.parallel.plan import ShardingPlan
+
+PLAN = "dp=4"
+WORLD = 4
+SHRUNK = 2         # surviving devices after the kill
+STEPS = 12
+EVERY = 3          # checkpoint_every
+KILL_AT = 8        # capacity loss strikes after this step's update
+WIDTH = 16         # flat fusion-buffer length (divisible by 4 and 2)
+GLOBAL_BATCH = 8
+PER_REPLICA_BATCH = 2
+SEED = 777
+
+
+def _grad(step: int) -> np.ndarray:
+    # derived from the global step alone so replay sees identical data
+    return np.sin(np.arange(WIDTH, dtype=np.float32)
+                  * (1.0 + 0.1 * step)).astype(np.float32)
+
+
+def _train_step(w: np.ndarray, m: np.ndarray, r: np.ndarray,
+                step: int):
+    """One elementwise optimizer step over the flat buffers: quantize
+    grad + residual (error feedback), momentum, apply.  Elementwise,
+    so any equal slicing of the buffers reproduces it bit-exactly."""
+    g = _grad(step)
+    q = (np.round(8.0 * (g + r)) / 8.0).astype(np.float32)
+    r = (g + r - q).astype(np.float32)
+    m = (0.9 * m + q).astype(np.float32)
+    w = (w - 0.1 * m).astype(np.float32)
+    return w, m, r
+
+
+def _fault_free() -> Dict[str, np.ndarray]:
+    w = np.full((WIDTH,), 1.5, np.float32)
+    m = np.zeros((WIDTH,), np.float32)
+    r = np.zeros((WIDTH,), np.float32)
+    for s in range(1, STEPS + 1):
+        w, m, r = _train_step(w, m, r, s)
+    return {"w": w, "m": m, "r": r}
+
+
+def _save(ckpt, step: int, w, m, r, ranks: int) -> None:
+    """Replicated params on rank 0 + one sharded-state file per rank,
+    plan-stamped — both layouts in the same step dir, the production
+    ZeRO checkpoint shape."""
+    ckpt.save(step, {"w": w, "step": step})
+    size = WIDTH // ranks
+    for rank in range(ranks):
+        sl = slice(rank * size, (rank + 1) * size)
+        ckpt.save_sharded(step, {"m": m[sl].copy(), "r": r[sl].copy()},
+                          rank, ranks, plan=f"dp={ranks}")
+    ckpt.wait()
+
+
+def _restore(ckpt, step: int, ranks: int):
+    """Reassemble full buffers from a reshard to ``ranks`` shards —
+    the per-rank restore every survivor runs, concatenated so the sim
+    keeps training on full vectors."""
+    plan = ShardingPlan.from_string(f"dp={ranks}")
+    size = WIDTH // ranks
+    template = {"m": np.zeros((size,), np.float32),
+                "r": np.zeros((size,), np.float32)}
+    parts = [reshard_restore(ckpt, template, rank, plan, step=step)
+             for rank in range(ranks)]
+    rep = ckpt.restore(None, step=step)
+    m = np.concatenate([p["m"] for p in parts])
+    r = np.concatenate([p["r"] for p in parts])
+    return np.asarray(rep["w"]), m, r
+
+
+def _scenario(root: str) -> Dict[str, Any]:
+    resolver = DegradedPlanResolver(PLAN, WORLD, payload_bytes=4 * WIDTH,
+                                    compute_s=1e-3)
+    ctl = DegradeController(resolver, global_batch=GLOBAL_BATCH,
+                            per_replica_batch=PER_REPLICA_BATCH,
+                            promote=True, clock=lambda: 0.0)
+    from horovod_tpu.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(root, use_orbax=False)
+    w = np.full((WIDTH,), 1.5, np.float32)
+    m = np.zeros((WIDTH,), np.float32)
+    r = np.zeros((WIDTH,), np.float32)
+    ranks = WORLD
+    accums: List[int] = []
+    events: List[str] = []
+    last_commit = 0
+    steps_lost = None
+    restored_step = None
+
+    step = 1
+    while step <= STEPS:
+        w, m, r = _train_step(w, m, r, step)
+        if step % EVERY == 0:
+            _save(ckpt, step, w, m, r, ranks)
+            last_commit = step
+            if ctl.degraded and step > KILL_AT:
+                # checkpoint boundary with capacity back: promote
+                decision = ctl.on_world_change(WORLD, step=step)
+                if decision.action == "promote":
+                    ranks = decision.plan.total
+                    w, m, r = _restore(ckpt, step, ranks)
+                    events.append(f"promote@{step}->{ranks}")
+        if step == KILL_AT and not ctl.degraded:
+            # half the world dies mid-interval: resolve, shrink,
+            # reshard-restore the last commit, replay from there
+            decision = ctl.on_world_change(SHRUNK, step=step)
+            events.append(f"{decision.action}@{step}->"
+                          f"{decision.plan_string}")
+            ranks = decision.plan.total
+            restored_step = last_commit
+            steps_lost = step - last_commit
+            w, m, r = _restore(ckpt, restored_step, ranks)
+            step = restored_step
+        accums.append(ctl.grad_accum())
+        step += 1
+
+    ref = _fault_free()
+    ga = preserve_global_batch(GLOBAL_BATCH,
+                               ctl.current_plan, PER_REPLICA_BATCH)
+    return {
+        "from_plan": ctl.base_plan.to_string(),
+        "history": [
+            {k: (round(v, 9) if isinstance(v, float) else v)
+             for k, v in e.items()} for e in ctl.history],
+        "events": events,
+        "steps_lost": steps_lost,
+        "restored_step": restored_step,
+        "promoted_step": ctl.promoted_step,
+        "final_plan": ctl.current_plan.to_string(),
+        "degraded_at_end": ctl.degraded,
+        "grad_accums": accums,
+        "grad_accum_final": ga[0],
+        "achieved_global_batch": ga[1],
+        "final_matches_fault_free": bool(
+            np.array_equal(w, ref["w"]) and np.array_equal(m, ref["m"])
+            and np.array_equal(r, ref["r"])),
+        "final": [round(float(x), 6) for x in w],
+    }
+
+
+def run_smoke() -> List[str]:
+    """Run the seeded degrade scenario twice; returns a list of error
+    strings (empty = pass)."""
+    errors: List[str] = []
+    try:
+        with tempfile.TemporaryDirectory(
+                prefix="hvd_degrade_smoke_") as d1:
+            r1 = _scenario(d1)
+        with tempfile.TemporaryDirectory(
+                prefix="hvd_degrade_smoke_") as d2:
+            r2 = _scenario(d2)
+    except Exception as e:          # noqa: BLE001 — a crash IS a failure
+        return [f"degrade-smoke: scenario crashed: "
+                f"{type(e).__name__}: {e}"]
+    if r1["events"] != [f"shrink@{KILL_AT}->dp={SHRUNK}",
+                        f"promote@9->{WORLD}"]:
+        errors.append(f"degrade-smoke: transition sequence was "
+                      f"{r1['events']}, expected a shrink at the kill "
+                      f"and a promote at the next boundary")
+    if not r1["final_matches_fault_free"]:
+        errors.append("degrade-smoke: shrink->replay->promote state "
+                      "diverged from the never-degraded run")
+    if r1["steps_lost"] is None or r1["steps_lost"] > EVERY:
+        errors.append(f"degrade-smoke: lost {r1['steps_lost']} steps, "
+                      f"bound is checkpoint_every={EVERY}")
+    if r1["final_plan"] != r1["from_plan"] or r1["degraded_at_end"]:
+        errors.append(f"degrade-smoke: ended at {r1['final_plan']} "
+                      f"(degraded={r1['degraded_at_end']}), expected "
+                      f"promotion back to {r1['from_plan']}")
+    if r1["promoted_step"] != 9:
+        errors.append(f"degrade-smoke: promoted_step="
+                      f"{r1['promoted_step']}, expected 9 (the first "
+                      f"checkpoint boundary after the kill)")
+    if max(r1["grad_accums"]) != 2 or r1["grad_accum_final"] != 1 \
+            or r1["achieved_global_batch"] != GLOBAL_BATCH:
+        errors.append(f"degrade-smoke: grad-accum trajectory "
+                      f"{r1['grad_accums']} -> {r1['grad_accum_final']} "
+                      f"does not preserve the global batch "
+                      f"{GLOBAL_BATCH}")
+    if r1 != r2:
+        errors.append("degrade-smoke: two seeded runs were not "
+                      "identical")
+    return errors

@@ -213,6 +213,23 @@ class TpuState(ObjectState):
         if self._checkpointer is not None:
             self._checkpointer.wait()
 
+    def priority_commit(self) -> int:
+        """A commit that bypasses ``checkpoint_every`` — the degrade
+        transition's drain leg (and the preemption-grace ``commit_fn``;
+        guard/preempt.py): whatever the interval, THIS commit reaches
+        durable storage, so the post-reshard restore replays zero
+        steps from the drain point.  Uses :meth:`save`, not
+        :meth:`commit`: the world is already changing, so the
+        host-update check would raise mid-drain.  Returns the
+        committed step; blocks until the writer has it durable."""
+        every, self._checkpoint_every = self._checkpoint_every, 1
+        try:
+            self.save()
+        finally:
+            self._checkpoint_every = every
+        self.wait()
+        return self._commit_count
+
     def restore_from_checkpoint(self, step=None) -> bool:
         """Load the latest (or ``step``-th) durable commit into this
         state's attributes — the cold-restart path when no surviving

@@ -42,6 +42,12 @@ Sites currently instrumented (grep ``faults.inject`` for ground truth):
                             models a wedged queue feeder
 ``serve.drain``             replica drain completion — ``raise``/``hang``
                             models a drain wedged past its grace window
+``degrade.resolve``         each degraded-plan resolution verdict
+                            (elastic/degrade.py)
+``degrade.reshard``         degrade-transition reshard restore, before any
+                            shard is read — the transition's fragile point
+``elastic.promote``         plan promotion back toward the base plan when
+                            capacity returns
 ==========================  =================================================
 
 (Coverage is enforced statically: hvdlint rule HVD006 fails on any

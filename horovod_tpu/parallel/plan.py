@@ -188,6 +188,43 @@ class ShardingPlan:
         return tuple(ax for ax in ("pp", "ep", "sp", "tp")
                      if getattr(self, ax) > 1)
 
+    @property
+    def model_extent(self) -> int:
+        """Product of the model-parallel extents — the load-bearing
+        factor a degrade transition must never change (checkpoint
+        resharding only covers the data extent; docs/elastic.md)."""
+        return self.pp * self.ep * self.sp * self.tp
+
+    def degrade_candidates(self, n_devices: int
+                           ) -> Tuple["ShardingPlan", ...]:
+        """Feasible plans for ``n_devices`` surviving devices, keeping
+        every model-parallel extent (and the pipeline schedule) fixed.
+
+        Only the data extents move: ``dp' <= dp`` and ``fsdp' <= fsdp``
+        with ``dp' * fsdp' * model_extent <= n_devices``.  Ordered
+        best-first: largest surviving world wins, and among equal
+        worlds the plan that shrinks ``dp`` (cheap — replicas are
+        interchangeable) is preferred over one that shrinks ``fsdp``
+        (re-slices every parameter shard).  Empty when even
+        ``dp=1,fsdp=1`` does not fit — the model extent itself needs
+        the lost capacity, so the caller must wait for it to return
+        rather than degrade (docs/elastic.md wait-vs-shrink table).
+        """
+        if self.dp is None:
+            raise ValueError(
+                "plan has dp=None (unresolved): call resolve(n_devices) "
+                "before enumerating degrade candidates")
+        model = self.model_extent
+        out = []
+        for dp in range(1, self.dp + 1):
+            for fsdp in range(1, self.fsdp + 1):
+                if dp * fsdp * model <= int(n_devices):
+                    out.append(dataclasses.replace(self, dp=dp,
+                                                   fsdp=fsdp))
+        out.sort(key=lambda p: (-p.total, self.fsdp - p.fsdp,
+                                self.dp - p.dp))
+        return tuple(out)
+
     # -- consumers ----------------------------------------------------------
 
     def build_mesh(self, devices=None):

@@ -70,6 +70,11 @@ KNOWN_KNOBS = frozenset({
     "HOROVOD_ELASTIC_HEARTBEAT_DEAD_S",
     "HOROVOD_ELASTIC_PROGRESS_TIMEOUT_S",
     "HOROVOD_ELASTIC_DEPART_GRACE_S",
+    "HOROVOD_ELASTIC_STRAGGLER_RATIO",
+    # -- plan-aware graceful degradation (elastic/degrade.py,
+    #    docs/elastic.md "Degraded mode")
+    "HOROVOD_DEGRADE", "HOROVOD_DEGRADE_WAIT_S",
+    "HOROVOD_DEGRADE_MIN_DATA_EXTENT", "HOROVOD_DEGRADE_PROMOTE",
     # -- serving plane (horovod_tpu/serve, docs/serving.md)
     "HOROVOD_SERVE_QUEUE_DEPTH", "HOROVOD_SERVE_MAX_REQUEUES",
     "HOROVOD_SERVE_MAX_BATCH", "HOROVOD_SERVE_DRAIN_TIMEOUT_S",

@@ -1,0 +1,264 @@
+"""Plan-aware graceful degradation (elastic/degrade.py, docs/elastic.md
+"Degraded mode"): candidate enumeration, resolver verdicts (shrink /
+wait / keep / promote), the controller's transition state machine and
+global-batch preservation, the reshard edge cases (error-feedback
+residuals, model-extent refusal, 4→2→4 round trip), the three chaos
+sites, and the hvdci gate-7 smoke — all CPU-only and deterministic.
+"""
+
+import numpy as np
+import pytest
+
+from horovod_tpu import faults
+from horovod_tpu.checkpoint import Checkpointer
+from horovod_tpu.elastic.degrade import (
+    DegradeController,
+    DegradedPlanResolver,
+    preserve_global_batch,
+    reshard_restore,
+)
+from horovod_tpu.parallel.plan import ShardingPlan
+
+
+def plan(s):
+    return ShardingPlan.from_string(s)
+
+
+class TestDegradeCandidates:
+    def test_largest_world_first_then_fsdp_preserved(self):
+        cands = plan("dp=2,fsdp=2").degrade_candidates(3)
+        # world size 2 beats 1; among the 2-device splits the one
+        # keeping fsdp (dp shrinks first) is preferred
+        assert [p.to_string() for p in cands] == \
+            ["dp=1,fsdp=2", "dp=2", "dp=1"]
+
+    def test_model_extent_never_moves(self):
+        base = plan("dp=4,tp=2")
+        cands = base.degrade_candidates(4)
+        assert cands and all(p.model_extent == 2 for p in cands)
+        assert cands[0].to_string() == "dp=2,tp=2"
+
+    def test_too_few_devices_yields_nothing(self):
+        assert plan("dp=2,tp=4").degrade_candidates(3) == ()
+
+    def test_unresolved_dp_refuses(self):
+        with pytest.raises(ValueError):
+            plan("tp=2").degrade_candidates(2)
+
+
+class TestResolver:
+    def make(self, p="dp=4", n=4, **kw):
+        kw.setdefault("payload_bytes", 1e6)
+        return DegradedPlanResolver(p, n, **kw)
+
+    def test_keep_when_plan_still_fits(self):
+        d = self.make().resolve(4)
+        assert d.action == "keep"
+        assert d.plan_string == "dp=4"
+
+    def test_shrink_to_largest_surviving_world(self):
+        d = self.make().resolve(3)
+        assert (d.action, d.plan_string) == ("shrink", "dp=3")
+
+    def test_zero_compute_does_not_shrink_to_one(self):
+        # regression: with compute_s=0 the cost model prices a
+        # 1-replica world cheapest (zero exchange); world size must
+        # dominate the sort, not cost
+        d = self.make(compute_s=0.0).resolve(2)
+        assert (d.action, d.plan_string) == ("shrink", "dp=2")
+
+    def test_wait_names_the_model_axes(self):
+        r = self.make("dp=2,tp=4", 8)
+        d = r.resolve(3)                   # 3 < model_extent 4
+        assert d.action == "wait"
+        assert d.plan is None
+        assert d.wait_s == r.wait_s
+        assert "tp=4" in d.reason
+
+    def test_min_data_extent_forces_wait(self):
+        r = self.make(min_data_extent=2)
+        assert r.resolve(2).action == "shrink"
+        assert r.resolve(1).action == "wait"
+        assert r.min_world() == 2
+
+    def test_promote_verdict_when_capacity_returns(self):
+        r = self.make()
+        shrunk = r.resolve(2).plan
+        d = r.resolve(4, current=shrunk)
+        assert (d.action, d.plan_string) == ("promote", "dp=4")
+
+    def test_from_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_DEGRADE_WAIT_S", "7")
+        monkeypatch.setenv("HOROVOD_DEGRADE_MIN_DATA_EXTENT", "2")
+        r = DegradedPlanResolver.from_env("dp=4", 4)
+        assert (r.wait_s, r.min_data_extent) == (7.0, 2)
+
+
+class TestController:
+    def make(self, p="dp=4", n=4, **kw):
+        kw.setdefault("clock", lambda: 0.0)
+        r = DegradedPlanResolver(p, n, payload_bytes=64, compute_s=1e-3)
+        return DegradeController(r, **kw)
+
+    def test_shrink_then_promote_cycle(self):
+        ctl = self.make(global_batch=8, per_replica_batch=2,
+                        promote=True)
+        d = ctl.on_world_change(2, step=5)
+        assert d.action == "shrink"
+        assert ctl.degraded
+        assert ctl.current_plan.to_string() == "dp=2"
+        assert ctl.grad_accum() == 2       # global batch preserved
+        assert ctl.history[-1]["kind"] == "shrink"
+        assert ctl.history[-1]["step"] == 5
+        d2 = ctl.on_world_change(4, step=6)
+        assert d2.action == "promote"
+        assert not ctl.degraded
+        assert ctl.grad_accum() == 1
+        assert ctl.promoted_step == 6
+
+    def test_promote_disabled_pins_the_degraded_plan(self):
+        ctl = self.make(promote=False)
+        ctl.on_world_change(2, step=1)
+        d = ctl.on_world_change(4, step=2)
+        assert d.action == "keep"
+        assert ctl.degraded
+        assert ctl.promoted_step is None
+
+    def test_promote_env_default(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_DEGRADE_PROMOTE", "0")
+        ctl = self.make()
+        ctl.on_world_change(2, step=1)
+        assert ctl.on_world_change(4, step=2).action == "keep"
+
+    def test_wait_leaves_current_plan_standing(self):
+        ctl = self.make("dp=2,tp=2", 4)
+        d = ctl.on_world_change(1, step=3)
+        assert d.action == "wait"
+        assert ctl.current_plan.to_string() == "dp=2,tp=2"
+        assert ctl.history == []
+
+    def test_record_transition_s_overwrites_bookkeeping(self):
+        ctl = self.make()
+        ctl.on_world_change(2, step=1)
+        ctl.record_transition_s(1.5)
+        assert ctl.history[-1]["transition_s"] == 1.5
+
+
+class TestPreserveGlobalBatch:
+    def test_exact_division(self):
+        assert preserve_global_batch(8, plan("dp=2"), 2) == (2, 8)
+        assert preserve_global_batch(8, plan("dp=4"), 2) == (1, 8)
+
+    def test_rounds_up_never_down(self):
+        # 10 / (4 replicas * 1) = 2.5 -> accumulate 3, train on 12:
+        # at least the configured batch, never silently smaller
+        assert preserve_global_batch(10, plan("dp=4"), 1) == (3, 12)
+
+    def test_rejects_degenerate_inputs(self):
+        with pytest.raises(ValueError):
+            preserve_global_batch(0, plan("dp=2"), 1)
+        with pytest.raises(ValueError):
+            preserve_global_batch(8, plan("dp=2"), 0)
+
+
+class TestChaosSites:
+    """The three degradation sites (docs/faults.md) under a sim-mode
+    FaultPlan: a crash surfaces as WorkerCrash (a BaseException) and
+    must leave retryable state behind."""
+
+    def sim(self, site):
+        faults.set_plan(faults.FaultPlan(seed=11, sim=True)
+                        .add(site, "crash", at=1))
+
+    def teardown_method(self, _):
+        faults.clear_plan()
+
+    def test_resolve_crash_leaves_plan_unchanged(self):
+        ctl = DegradeController(
+            DegradedPlanResolver("dp=4", 4, payload_bytes=64),
+            clock=lambda: 0.0)
+        self.sim("degrade.resolve")
+        with pytest.raises(faults.WorkerCrash):
+            ctl.on_world_change(2, step=1)
+        faults.clear_plan()
+        assert ctl.current_plan.to_string() == "dp=4"   # verdict died
+        assert ctl.on_world_change(2, step=1).action == "shrink"
+
+    def test_reshard_crash_leaves_checkpoint_intact(self, tmp_path):
+        ckpt = Checkpointer(str(tmp_path), use_orbax=False)
+        v = np.arange(8, dtype=np.float32)
+        for rank in range(4):
+            ckpt.save_sharded(1, {"m": v[rank * 2:(rank + 1) * 2]},
+                              rank, 4, plan="dp=4")
+        ckpt.wait()
+        template = {"m": np.zeros((4,), np.float32)}
+        self.sim("degrade.reshard")
+        with pytest.raises(faults.WorkerCrash):
+            reshard_restore(ckpt, template, 0, plan("dp=2"), step=1)
+        faults.clear_plan()
+        out = reshard_restore(ckpt, template, 0, plan("dp=2"), step=1)
+        assert np.array_equal(out["m"], v[:4])          # retry works
+
+    def test_promote_crash_pins_degraded_plan(self):
+        ctl = DegradeController(
+            DegradedPlanResolver("dp=4", 4, payload_bytes=64),
+            clock=lambda: 0.0)
+        ctl.on_world_change(2, step=1)
+        self.sim("elastic.promote")
+        with pytest.raises(faults.WorkerCrash):
+            ctl.on_world_change(4, step=2)
+        faults.clear_plan()
+        assert ctl.degraded                             # still shrunk
+        assert ctl.on_world_change(4, step=3).action == "promote"
+
+
+class TestReshardEdgeCases:
+    def test_dp_shrink_carries_error_feedback_residuals(self, tmp_path):
+        """A 4-way sharded optimizer state (momentum + EF residual)
+        reshards to the 2-way survivors bit-exactly."""
+        ckpt = Checkpointer(str(tmp_path), use_orbax=False)
+        m = np.arange(16, dtype=np.float32)
+        r = np.linspace(-1, 1, 16).astype(np.float32)
+        for rank in range(4):
+            sl = slice(rank * 4, (rank + 1) * 4)
+            ckpt.save_sharded(3, {"m": m[sl].copy(), "r": r[sl].copy()},
+                              rank, 4, plan="dp=4")
+        ckpt.wait()
+        assert ckpt.saved_plan(3) == "dp=4"
+        template = {"m": np.zeros((8,), np.float32),
+                    "r": np.zeros((8,), np.float32)}
+        parts = [reshard_restore(ckpt, template, rank, plan("dp=2"),
+                                 step=3) for rank in range(2)]
+        assert np.array_equal(np.concatenate([p["m"] for p in parts]), m)
+        assert np.array_equal(np.concatenate([p["r"] for p in parts]), r)
+
+    def test_model_extent_refusal_names_the_axis(self, tmp_path):
+        ckpt = Checkpointer(str(tmp_path), use_orbax=False)
+        for rank in range(2):
+            ckpt.save_sharded(1, {"m": np.zeros((2,), np.float32)},
+                              rank, 2, plan="dp=2,tp=2")
+        ckpt.wait()
+        with pytest.raises(ValueError, match="tp"):
+            reshard_restore(ckpt, {"m": np.zeros((2,), np.float32)},
+                            0, plan("dp=2"), step=1)
+
+    def test_round_trip_4_2_4_matches_never_degraded(self, tmp_path):
+        """The full kill → shrink → replay → promote walk: final
+        weights, momentum and residuals bit-identical to a run that
+        never degraded."""
+        from horovod_tpu.elastic import smoke
+
+        res = smoke._scenario(str(tmp_path))
+        assert res["events"] == ["shrink@8->dp=2", "promote@9->4"]
+        assert res["final_matches_fault_free"]
+        assert res["steps_lost"] <= smoke.EVERY
+        assert res["final_plan"] == res["from_plan"] == "dp=4"
+        assert max(res["grad_accums"]) == 2
+        assert res["grad_accum_final"] == 1
+
+
+class TestSmokeGate:
+    def test_hvdci_gate7_green(self):
+        from horovod_tpu.elastic.smoke import run_smoke
+
+        assert run_smoke() == []

@@ -163,6 +163,59 @@ class TestHostManagerQuarantine:
         assert "h2" not in hm.current_hosts
         assert hm.is_blacklisted("h2")
 
+    def test_starvation_readmits_earliest_eligible_on_probation(
+            self, monkeypatch):
+        """Regression: with every discovered host quarantined, the
+        discovery loop used to report an empty cluster until a cooldown
+        happened to expire — potentially forever with flapping hosts.
+        The escape readmits the earliest-eligible host on probation,
+        retaining its failure count, and names it in the log."""
+        # the hvd logger sets propagate=False, so caplog can't see it;
+        # intercept at the module seam instead (test_health.py idiom)
+        from horovod_tpu.elastic import discovery as discovery_mod
+
+        warnings = []
+        monkeypatch.setattr(
+            discovery_mod.hvd_logging, "warning",
+            lambda msg, *a: warnings.append(msg % a if a else msg))
+        clk = Clock()
+        disc, hm = self.make({"h1": 1, "h2": 1}, clk)
+        hm.quarantine("h1")                        # cooldown 10
+        hm.quarantine("h2")
+        hm.quarantine("h2")                        # relapse: cooldown 20
+        clk.t = 5.0                                # both still cooling
+        assert hm.update_available_hosts() == HostUpdateResult.added
+        # h1 has the least cooldown remaining (5 s vs 15 s) -> picked
+        assert hm.current_hosts == {"h1": 1}
+        assert hm.host_quarantine.status("h1") == "probation"
+        assert any("readmitting host h1" in w for w in warnings)
+        # failure count retained: a relapse still doubles
+        assert hm.quarantine("h1") == 20.0
+
+    def test_starvation_escape_skips_blacklist_and_disabled(self):
+        clk = Clock()
+        disc, hm = self.make({"h1": 1, "h2": 1}, clk)
+        hm.blacklist("h1")                         # permanent: never picked
+        hm.quarantine("h2")
+        hm.update_available_hosts()
+        assert hm.current_hosts == {"h2": 1}       # escape picked h2
+        # with only blacklisted hosts discovered, no escape fires
+        disc2 = FixedHosts({"h1": 1})
+        hm2 = HostManager(disc2, quarantine=HostQuarantine(
+            base_s=10.0, max_s=100.0, probation_s=30.0, disabled=False,
+            clock=clk))
+        hm2.blacklist("h1")
+        hm2.update_available_hosts()
+        assert hm2.current_hosts == {}
+        # HOROVOD_QUARANTINE_DISABLE keeps the reference exclude-forever
+        hm3 = HostManager(FixedHosts({"h9": 1}), quarantine=HostQuarantine(
+            base_s=10.0, max_s=100.0, probation_s=30.0, disabled=True,
+            clock=clk))
+        hm3.update_available_hosts()
+        hm3.quarantine("h9")
+        hm3.update_available_hosts()
+        assert hm3.current_hosts == {}
+
     def test_readmission_preserves_stable_order_append(self):
         clk = Clock()
         disc, hm = self.make({"h1": 1, "h2": 1, "h3": 1}, clk)

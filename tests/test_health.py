@@ -289,3 +289,64 @@ class TestDepartGrace:
         monkeypatch.setenv("HOROVOD_ELASTIC_DEPART_GRACE_S", "45")
         mon = HealthMonitor.from_env(lambda *a: None)
         assert mon.depart_grace_s == 45.0
+
+
+class TestStraggler:
+    """Observability-only straggler detection: per-worker step-rate
+    EWMA vs the fleet median, a one-shot ``suspect_slow`` verdict that
+    clears when the worker catches back up — never a death."""
+
+    def run_fleet(self, mon, clk, until, slow_every=10, start=0):
+        for t in range(start, until):
+            clk.t = float(t)
+            mon.record_heartbeat("fast", 0, step=t)
+            mon.record_heartbeat("slow", 1, step=t // slow_every)
+            mon.check()
+
+    def test_slow_worker_flagged_once_then_clears(self, monkeypatch):
+        from horovod_tpu.elastic import health as health_mod
+
+        warnings, infos = [], []
+        monkeypatch.setattr(
+            health_mod.hvd_logging, "warning",
+            lambda msg, *a: warnings.append(msg % a if a else msg))
+        monkeypatch.setattr(
+            health_mod.hvd_logging, "info",
+            lambda msg, *a: infos.append(msg % a if a else msg))
+        clk, deaths = Clock(), []
+        mon = make_monitor(clk, deaths, straggler_ratio=3.0)
+        # fast steps at 1/s, slow at 0.1/s: median 0.55, ratio 5.5x
+        self.run_fleet(mon, clk, 31)
+        assert mon.stragglers() == [("slow", 1)]
+        assert deaths == []                 # observability-only
+        slow_warnings = [w for w in warnings if "suspect_slow" in w]
+        assert len(slow_warnings) == 1      # one-shot, not per-check
+        assert "slow:1" in slow_warnings[0]
+        # the slow worker catches up to full rate: verdict clears
+        for t in range(31, 40):
+            clk.t = float(t)
+            mon.record_heartbeat("fast", 0, step=t)
+            mon.record_heartbeat("slow", 1, step=3 + (t - 30))
+            mon.check()
+        assert mon.stragglers() == []
+        assert any("caught back up" in i for i in infos)
+
+    def test_single_worker_has_no_median(self):
+        clk, deaths = Clock(), []
+        mon = make_monitor(clk, deaths, straggler_ratio=3.0)
+        for t in range(20):
+            clk.t = float(t)
+            mon.record_heartbeat("only", 0, step=t // 10)
+            mon.check()
+        assert mon.stragglers() == []
+
+    def test_zero_ratio_disables(self):
+        clk, deaths = Clock(), []
+        mon = make_monitor(clk, deaths, straggler_ratio=0.0)
+        self.run_fleet(mon, clk, 31)
+        assert mon.stragglers() == []
+
+    def test_ratio_knob_from_env(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_ELASTIC_STRAGGLER_RATIO", "5.5")
+        mon = HealthMonitor.from_env(lambda *a: None)
+        assert mon.straggler_ratio == 5.5
