@@ -1386,6 +1386,38 @@ def run_autotune(args, hvd):
             point, payload, n_dcn=n_dcn, n_ici=n_ici,
             compute_s=compute_s)
 
+    def hbm_feasible():
+        """Hard HBM-budget gate for the autotuner (docs/memory.md):
+        under HOROVOD_HBM_BUDGET_BYTES every candidate is priced by
+        plan_memory_bytes before it is allowed to race, so the tuner
+        returns the fastest *feasible* point.  Unset budget = no gate
+        (the pre-memory-plane behavior)."""
+        budget = _env_budget_bytes()
+        if budget is None:
+            return None
+        from horovod_tpu.analysis.cost_model import (
+            plan_fits,
+            plan_memory_bytes,
+        )
+
+        if model == "transformer":
+            d, layers = args.tf_d_model, args.tf_layers
+            param_bytes = 4.0 * (12 * layers * d * d + 32_000 * d)
+            act_bytes = 4.0 * args.tf_batch_size * args.tf_seq_len \
+                * d * layers * 14.0
+        else:
+            param_bytes = 4.0 * 25.6e6
+            act_bytes = 4.0 * args.batch_size * 16.8e6
+        default_plan = f"dp={hvd.size()}"
+        return lambda point: plan_fits(
+            plan_memory_bytes(
+                point.get("plan", default_plan),
+                param_bytes=param_bytes, activation_bytes=act_bytes,
+                shard_optimizer_states=args.shard_optimizer_states,
+                exchange_bucket_bytes=(
+                    point.get("exchange_bucket_bytes") or None)),
+            budget)
+
     if model == "transformer":
         axes = {"steps_per_call": [1, 5, 10, 20, 40],
                 "flash_block": [128, 256, 512, 1024],
@@ -1412,13 +1444,189 @@ def run_autotune(args, hvd):
 
     log_path = args.autotune_log or f"autotune_{model}.csv"
     tuner = ThroughputAutotuner(measure, axes, log_path=log_path,
-                                predict=exchange_predictor())
+                                predict=exchange_predictor(),
+                                feasible=hbm_feasible())
     best, rate = tuner.run()
     return {"metric": f"autotune_{model}", "value": round(rate, 1),
             "unit": ("img/sec/chip" if model == "resnet"
                      else "tokens/sec/chip"),
             "vs_baseline": None, "best_point": best,
             "autotune_log": log_path}
+
+
+def run_hbm_budget(args, hvd):
+    """``--hbm-budget``: the memory plane's measurement loop
+    (docs/memory.md).  Runs an activation-dominated transformer twin —
+    NOT the default smoke twin, whose 32k-vocab logits head dominates
+    the high-water and hides remat entirely — at remat ``none`` and
+    ``full``, and reports:
+
+    * the donation-aware static HBM high-water of each compiled step
+      (``utils/hlo.memory_high_water``) and the cost model's
+      ``plan_memory_bytes`` prediction, with their relative error (the
+      25% validation bar);
+    * the measured recompute-overhead delta (tokens/sec none vs full);
+    * the HBM-budgeted planner's winner over the candidate plan space
+      for this workload (``HOROVOD_HBM_BUDGET_BYTES``; default 80% of
+      the remat-none high-water, so the budget provably bites), run
+      twice with a determinism verdict;
+    * a live host-offload round-trip of the real optimizer state —
+      bit-exactness and the measured ``offload_stall_s``.
+    """
+    from horovod_tpu import telemetry
+    from horovod_tpu.analysis import cost_model as CM
+    from horovod_tpu.memory import HostOffloadEngine, search_memory_plans
+    from horovod_tpu.models import TransformerConfig, TransformerLM
+    from horovod_tpu.parallel.plan import candidate_plans
+    from horovod_tpu.utils import hlo as H
+
+    n_chips = hvd.size()
+    layers, d_model, heads, seq, batch = 4, 256, 4, 512, 8
+    vocab = 512          # small head: activations, not logits, dominate
+    plan_str = f"dp={n_chips}"
+    log(f"bench[hbm]: {n_chips} chip(s), {layers}L/{d_model}d, "
+        f"seq {seq}, batch {batch}/chip, vocab {vocab}")
+
+    global_bs = batch * n_chips
+    rng = np.random.RandomState(0)
+    raw = rng.randint(0, vocab, (global_bs, seq + 1))
+
+    measured = {}        # policy -> {"hw": bytes, "rate": tok/s, ...}
+    nparams = None
+    final_opt_state = None
+    for policy in ("none", "full"):
+        cfg = TransformerConfig(
+            vocab_size=vocab, num_layers=layers, num_heads=heads,
+            d_model=d_model, d_ff=4 * d_model, max_seq_len=seq,
+            dtype=jnp.float32, attention_impl="dense",
+            remat_policy=policy)
+        model = TransformerLM(cfg)
+
+        def loss_fn(params, batch, model=model):
+            logits = model.apply(params, batch["inputs"])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["labels"]).mean()
+
+        step = hvd.DistributedTrainStep(loss_fn, optax.adamw(3e-4))
+        variables = jax.jit(model.init)(
+            jax.random.PRNGKey(0), jnp.zeros((1, seq), jnp.int32))
+        nparams = sum(x.size
+                      for x in jax.tree_util.tree_leaves(variables))
+        params, opt_state = step.init(variables)
+        batch_data = step.shard_batch({
+            "inputs": jnp.asarray(raw[:, :-1], jnp.int32),
+            "labels": jnp.asarray(raw[:, 1:], jnp.int32),
+        })
+        hw = H.memory_high_water(
+            step.compiled_text(params, opt_state, batch_data))
+        rate, _, final_state = median_rate(
+            lambda s: step(s[0], s[1], batch_data),
+            (params, opt_state, None), 1, 3, 2,
+            global_bs * seq, f"hbm:{policy}")
+        measured[policy] = {"hw": hw, "rate": rate}
+        final_opt_state = final_state[1]
+        telemetry.gauge(
+            "hvd_memory_hbm_high_water_bytes",
+            "donation-aware static HBM high-water of the compiled "
+            "step").labels(policy=policy).set(hw)
+        log(f"bench[hbm:{policy}]: high_water "
+            f"{hw / 1e6:.1f} MB, {rate:.0f} tok/s")
+
+    # the roofline's inputs, derived from the remat-none dump: the
+    # static residents (params + grads + 2 adam slots, fp32) are known
+    # exactly, everything above them is the activation footprint
+    param_bytes = 4.0 * nparams
+    static_bytes = 4.0 * param_bytes
+    act_bytes = max(measured["none"]["hw"] - static_bytes, 1.0)
+    out = {
+        "metric": "hbm_budget",
+        "unit": "tokens/sec/chip",
+        "plan": plan_str,
+        "hbm_param_bytes": param_bytes,
+        "hbm_activation_bytes": act_bytes,
+    }
+    for policy, m in measured.items():
+        pred = CM.plan_memory_bytes(
+            plan_str, param_bytes=param_bytes,
+            activation_bytes=act_bytes, remat_policy=policy).total
+        rel_err = abs(pred - m["hw"]) / m["hw"]
+        telemetry.gauge(
+            "hvd_memory_plan_bytes",
+            "plan_memory_bytes roofline prediction").labels(
+            policy=policy).set(pred)
+        if rel_err > 0.25:
+            log(f"bench[hbm:{policy}]: WARNING plan_memory_bytes "
+                f"{pred / 1e6:.1f} MB is {rel_err * 100:.0f}% off the "
+                f"measured {m['hw'] / 1e6:.1f} MB (25% bar)")
+        out.update({
+            f"hbm_high_water_bytes_{policy}": m["hw"],
+            f"plan_memory_bytes_{policy}": round(pred, 1),
+            f"plan_memory_rel_err_{policy}": round(rel_err, 4),
+            f"hbm_tokens_per_sec_{policy}": round(m["rate"] / n_chips,
+                                                  1),
+        })
+    out["recompute_overhead"] = round(
+        measured["none"]["rate"] / measured["full"]["rate"] - 1.0, 4)
+
+    # HBM-budgeted planner over the candidate plan space of this
+    # workload — default budget 80% of the remat-none high-water so
+    # the unconstrained winner cannot fit and the budget provably
+    # steers; run twice, determinism is part of the artifact
+    budget = _env_budget_bytes() or 0.8 * measured["none"]["hw"]
+    world = max(n_chips, 8)
+    step_s = global_bs * seq / measured["none"]["rate"]
+
+    def _search():
+        return search_memory_plans(
+            [p.to_string() for p in candidate_plans(world)],
+            param_bytes=param_bytes, activation_bytes=act_bytes,
+            budget_bytes=budget, remat_policies=("none", "full"),
+            shard_optimizer_states=True, compute_s=step_s,
+            n_ici=world)
+
+    winner, winner2 = _search(), _search()
+    out.update({
+        "hbm_budget_bytes": budget,
+        "remat_policy": winner.remat_policy,
+        "hbm_high_water_bytes":
+            measured[winner.remat_policy]["hw"],
+        "plan_memory_bytes": out[
+            f"plan_memory_bytes_{winner.remat_policy}"],
+        "value": out[f"hbm_tokens_per_sec_{winner.remat_policy}"],
+        "budget_plan": winner.plan,
+        "budget_microbatches": winner.microbatches,
+        "budget_offload_optimizer": winner.offload_optimizer,
+        "budget_predicted_bytes": round(winner.predicted_bytes.total, 1),
+        "budget_deterministic": winner == winner2,
+    })
+    log(f"bench[hbm]: budget {budget / 1e6:.1f} MB -> "
+        f"{winner.summary()}")
+
+    # live host-offload round-trip of the real optimizer state: the
+    # stall is the H2D wait (~0 when the D2H hid under the step), and
+    # the restore must be bit-exact
+    with HostOffloadEngine(name="bench", depth=2) as engine:
+        engine.offload(0, final_opt_state)
+        restored = engine.fetch(0, final_opt_state)
+        exact = all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(
+                jax.tree_util.tree_leaves(final_opt_state),
+                jax.tree_util.tree_leaves(restored)))
+        out.update({
+            "offload_stall_s": round(engine.stall_s, 6),
+            "offload_roundtrip_exact": exact,
+            "offload_fallbacks": engine.fallbacks,
+        })
+    if not exact:
+        log("bench[hbm]: WARNING offload round-trip was NOT bit-exact")
+    return out
+
+
+def _env_budget_bytes():
+    """HOROVOD_HBM_BUDGET_BYTES as a float, or None when unset."""
+    raw = os.environ.get("HOROVOD_HBM_BUDGET_BYTES")
+    return float(raw) if raw not in (None, "") else None
 
 
 def telemetry_fields():
@@ -1633,6 +1841,14 @@ def main():
     p.add_argument("--serve-p99-inflation-max", type=float, default=5.0,
                    help="chaos-variant p99 may inflate at most this "
                         "factor over the fault-free pass")
+    p.add_argument("--hbm-budget", action="store_true",
+                   help="memory-plane measurement loop: remat "
+                        "none-vs-full high-water + recompute delta on "
+                        "an activation-dominated twin, the "
+                        "plan_memory_bytes 25%% validation, the "
+                        "HBM-budgeted planner winner "
+                        "(HOROVOD_HBM_BUDGET_BYTES) and a live offload "
+                        "round-trip (docs/memory.md)")
     p.add_argument("--autotune", action="store_true",
                    help="tune the jit-path throughput knobs "
                         "(steps_per_call; flash block for the "
@@ -1675,6 +1891,11 @@ def main():
         return
     if args.serve:
         emit(dict(run_serve(args, hvd), **artifact_metadata(hvd),
+                  **telemetry_fields()),
+             args.json_out)
+        return
+    if args.hbm_budget:
+        emit(dict(run_hbm_budget(args, hvd), **artifact_metadata(hvd),
                   **telemetry_fields()),
              args.json_out)
         return

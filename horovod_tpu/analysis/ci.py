@@ -1,7 +1,7 @@
 """``python -m horovod_tpu.analysis ci`` / ``hvdci`` — the one-shot CI
 entry point.
 
-Seven gates, one invocation, one exit code (docs/perf_gate.md):
+Eight gates, one invocation, one exit code (docs/perf_gate.md):
 
 1. **hvdlint** over the pre-commit scope (``--changed``: staged +
    unstaged + untracked files under ``horovod_tpu/``; falls back to the
@@ -24,7 +24,12 @@ Seven gates, one invocation, one exit code (docs/perf_gate.md):
    degradation loop — seeded kill → dp-shrink reshard → replay →
    promote at the next checkpoint boundary, bit-exact against a
    never-degraded run, run twice and required bit-identical
-   (docs/elastic.md "Degraded mode").
+   (docs/elastic.md "Degraded mode");
+8. the **memory smoke** (``memory/smoke.py``): the HBM-budgeted
+   planner — unconstrained vs budgeted search must pick different
+   feasible winners, an infeasible budget must raise naming the
+   tightest axis, run twice and required bit-identical
+   (docs/memory.md).
 
 The whole run is a tier-1 test with the same <30 s budget as the
 hvdlint self-run, so "CI passed" and "the analysis suite passed" are
@@ -145,12 +150,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         degrade_errors = [f"degrade-smoke crashed: "
                           f"{type(e).__name__}: {e}"]
 
+    # 8 — memory smoke: the HBM-budgeted planner's free → budgeted →
+    # infeasible walk, seeded and deterministic (sub-second, no JAX)
+    try:
+        from horovod_tpu.memory.smoke import run_smoke as \
+            run_memory_smoke
+
+        memory_errors = run_memory_smoke()
+    except Exception as e:          # noqa: BLE001 — a crash IS a failure
+        memory_errors = [f"memory-smoke crashed: "
+                         f"{type(e).__name__}: {e}"]
+
     elapsed = time.perf_counter() - t0
     gate_findings = gate.findings if gate is not None else []
     rc = 2 if (art_error or gate_error) else (
         1 if (lint.findings or art_findings or gate_findings
               or metrics_errors or guard_errors or serve_errors
-              or plan_errors or degrade_errors) else 0)
+              or plan_errors or degrade_errors or memory_errors)
+        else 0)
 
     if args.json_out:
         print(json.dumps({
@@ -161,6 +178,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "serve_smoke_errors": serve_errors,
             "plan_smoke_errors": plan_errors,
             "degrade_smoke_errors": degrade_errors,
+            "memory_smoke_errors": memory_errors,
             "perf_gate": gate.as_json() if gate is not None else None,
             "errors": [e for e in (art_error, gate_error) if e],
             "elapsed_s": round(elapsed, 3),
@@ -182,6 +200,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"hvdci: plan-smoke: {e}")
     for e in degrade_errors:
         print(f"hvdci: degrade-smoke: {e}")
+    for e in memory_errors:
+        print(f"hvdci: memory-smoke: {e}")
     for f in gate_findings:
         print(f.format())
     for err in (art_error, gate_error):
@@ -194,7 +214,8 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"guard-smoke {len(guard_errors)} · "
           f"serve-smoke {len(serve_errors)} · "
           f"plan-smoke {len(plan_errors)} · "
-          f"degrade-smoke {len(degrade_errors)} finding(s) "
+          f"degrade-smoke {len(degrade_errors)} · "
+          f"memory-smoke {len(memory_errors)} finding(s) "
           f"in {elapsed:.2f}s — {'FAIL' if rc else 'ok'}")
     return rc
 

@@ -55,15 +55,19 @@ class HardwareModel:
     hbm_bytes_per_s: float      # achievable HBM bandwidth
     ici_bytes_per_s: float      # per-chip ICI link budget
     dcn_bytes_per_s: float      # per-host DCN budget
+    #: per-chip HBM capacity — the default feasibility ceiling when
+    #: HOROVOD_HBM_BUDGET_BYTES is unset; None = unconstrained (the
+    #: pre-memory-plane behavior, docs/memory.md)
+    hbm_capacity_bytes: Optional[float] = None
 
 
 #: v5e figures: 197 bf16 TFLOP/s, ~810 GB/s measured HBM
 #: (PERF_NOTES.md hardware-envelope round), 1,600 Gbps ICI per chip,
 #: ~200 Gbps DCN per host — the same constants docs/scaling.md tables
-#: use.
+#: use.  16 GB HBM per chip.
 V5E = HardwareModel(name="v5e", peak_flops_per_s=197e12,
                     hbm_bytes_per_s=810e9, ici_bytes_per_s=200e9,
-                    dcn_bytes_per_s=25e9)
+                    dcn_bytes_per_s=25e9, hbm_capacity_bytes=16e9)
 
 
 # -- exchange wire bytes per level ------------------------------------------
@@ -296,6 +300,150 @@ def rank_plans(plans: Sequence[Union[str, Dict]],
               for p in plans]
     scored.sort(key=lambda cp: cp[0])
     return scored
+
+
+# -- plan memory: the HBM roofline ------------------------------------------
+
+
+#: Remat policy vocabulary — mirrors ``memory/remat.REMAT_POLICIES`` by
+#: value (this module stays stdlib-only, like :data:`PLAN_GRAMMAR_KEYS`).
+REMAT_POLICIES = ("none", "dots", "full", "offload")
+
+#: Share of the remat-none activation footprint still resident in HBM
+#: under each policy.  Calibrated against the linear-scan
+#: ``utils/hlo.memory_high_water`` estimate of the CPU-twin
+#: transformer's compiled dumps (the same estimator
+#: :func:`plan_memory_bytes` is validated against, so model and
+#: measurement share one definition of "activation bytes"): ``full``
+#: keeps the per-block backward-recompute peak plus the saved block
+#: inputs; ``dots`` additionally keeps every matmul output; ``offload``
+#: streams the dots residuals to pinned host memory, leaving roughly
+#: the ``full`` residency on-device.
+REMAT_ACTIVATION_FRACTION = {
+    "none": 1.0, "dots": 0.82, "full": 0.31, "offload": 0.41,
+}
+
+#: Fractional step-time penalty of the policy's recomputation (plus,
+#: for ``offload``, the un-hidden share of the D2H/H2D stream):
+#: ``dots`` replays the cheap elementwise ops only, ``full`` replays
+#: each block's forward (~1/3 of the fwd+bwd budget).
+REMAT_RECOMPUTE_OVERHEAD = {
+    "none": 0.0, "dots": 0.12, "full": 0.30, "offload": 0.34,
+}
+
+#: Resident share of an offloaded optimizer shard: the double-buffered
+#: stream (``memory/offload.py``, HOROVOD_OFFLOAD_DEPTH=2) keeps two of
+#: a 16-bucket rotation in HBM at a time.
+OFFLOAD_RESIDENT_FRACTION = 0.125
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryBytes:
+    """Per-device HBM high-water decomposition of one plan — the four
+    components the budget trades against each other, plus the exchange
+    staging.  ``tightest`` names the dominant component, the axis an
+    infeasibility error points at (``memory/planner.py``)."""
+
+    params: float
+    grads: float
+    optimizer: float
+    activations: float
+    exchange: float
+
+    @property
+    def total(self) -> float:
+        return (self.params + self.grads + self.optimizer
+                + self.activations + self.exchange)
+
+    @property
+    def tightest(self) -> str:
+        """Name of the largest component (deterministic field-order
+        tie-break)."""
+        return max(dataclasses.asdict(self).items(),
+                   key=lambda kv: (kv[1], kv[0]))[0]
+
+
+def plan_memory_bytes(plan: Union[str, Dict], *,
+                      param_bytes: float,
+                      activation_bytes: float,
+                      remat_policy: str = "none",
+                      microbatches: int = 1,
+                      optimizer_slots: int = 2,
+                      shard_optimizer_states: bool = False,
+                      offload_optimizer: bool = False,
+                      exchange_bucket_bytes: Optional[float] = None
+                      ) -> MemoryBytes:
+    """Predicted per-device HBM high-water of one plan — the memory
+    twin of :func:`plan_cost_s`, and the quantity the feasibility
+    predicate (:func:`plan_fits`) holds under ``HOROVOD_HBM_BUDGET_BYTES``.
+
+    Inputs are *unsharded single-replica* quantities: ``param_bytes``
+    the whole model's parameters, ``activation_bytes`` the whole
+    network's activation footprint for one device's batch shard at
+    ``remat_policy="none"`` and ``microbatches=1``.  The plan then
+    shards them:
+
+    * params/grads divide over the parameter-sharding axes
+      (``tp·pp·ep·fsdp`` — ``ep`` idealized as sharding every layer,
+      ``sp`` replicates parameters);
+    * optimizer state is ``optimizer_slots`` × the param shard,
+      further ÷ ``dp`` under the ZeRO sharded exchange, and reduced to
+      :data:`OFFLOAD_RESIDENT_FRACTION` residency when streamed to
+      host (``memory/offload.py``);
+    * activations scale by the policy's residency fraction
+      (:data:`REMAT_ACTIVATION_FRACTION`), divide over ``sp`` and the
+      microbatch count, and a pipeline holds ``min(pp, m)`` in-flight
+      microbatches of its ``1/pp`` layer slice (the 1F1B steady
+      state);
+    * exchange staging is the double-buffered bucket pair when the
+      bucketed exchange is on, else one grad-shard-sized fused buffer
+      whenever a data axis exists.
+
+    Validated against ``utils/hlo.memory_high_water`` on compiled
+    CPU-twin dumps by ``bench.py --hbm-budget`` (within 25%;
+    docs/memory.md lists the approximations).
+    """
+    if remat_policy not in REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat policy {remat_policy!r}: expected one of "
+            f"{', '.join(REMAT_POLICIES)}")
+    ext = parse_plan(plan)
+    microbatches = max(1, int(microbatches))
+    param_shard_axes = ext["tp"] * ext["pp"] * ext["ep"] * ext["fsdp"]
+    params = float(param_bytes) / param_shard_axes
+    grads = params
+    optimizer = max(0, int(optimizer_slots)) * params
+    if shard_optimizer_states:
+        optimizer /= ext["dp"]
+    if offload_optimizer:
+        optimizer *= OFFLOAD_RESIDENT_FRACTION
+    frac = REMAT_ACTIVATION_FRACTION[remat_policy]
+    act_per_mb = float(activation_bytes) * frac \
+        / (microbatches * ext["sp"])
+    in_flight = min(ext["pp"], microbatches)
+    activations = act_per_mb / ext["pp"] * in_flight
+    data_world = ext["dp"] * ext["fsdp"]
+    if exchange_bucket_bytes:
+        exchange = 2.0 * float(exchange_bucket_bytes)
+    else:
+        exchange = grads if data_world > 1 else 0.0
+    return MemoryBytes(params=params, grads=grads, optimizer=optimizer,
+                       activations=activations, exchange=exchange)
+
+
+def plan_fits(mem: Union[MemoryBytes, float],
+              budget_bytes: Optional[float] = None,
+              hw: HardwareModel = V5E) -> bool:
+    """Feasibility predicate: does the predicted high-water fit the
+    budget?  ``budget_bytes`` (the HOROVOD_HBM_BUDGET_BYTES knob) rules
+    when given; otherwise the hardware model's capacity; no capacity
+    anywhere = everything fits (the pre-memory-plane behavior)."""
+    total = mem.total if isinstance(mem, MemoryBytes) else float(mem)
+    cap = budget_bytes if budget_bytes is not None \
+        else hw.hbm_capacity_bytes
+    if cap is None:
+        return True
+    return total <= float(cap)
 
 
 def score_exchange_schedule(point: Dict,

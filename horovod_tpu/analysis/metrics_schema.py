@@ -98,6 +98,18 @@ DEGRADE_SERIES = frozenset({
     "hvd_degrade_promoted_step",
 })
 
+# the memory plane's closed series vocabulary (docs/memory.md): host
+# offload traffic/stalls/degrades plus the HBM accounting gauges the
+# budget autotuner reports, in the hvd_memory_* namespace
+MEMORY_SERIES = frozenset({
+    "hvd_memory_offload_bytes_total",
+    "hvd_memory_offload_stall_seconds",
+    "hvd_memory_offload_inflight",
+    "hvd_memory_offload_fallbacks_total",
+    "hvd_memory_hbm_high_water_bytes",
+    "hvd_memory_plan_bytes",
+})
+
 
 def _check_guard_series(errors: List[str], obj, field: str) -> None:
     if not isinstance(obj, dict):
@@ -145,6 +157,18 @@ def _check_degrade_series(errors: List[str], obj, field: str) -> None:
                 errors.append(
                     f"{field}[{k!r}]: unknown degrade series {base!r} — "
                     f"not in metrics_schema.DEGRADE_SERIES")
+
+
+def _check_memory_series(errors: List[str], obj, field: str) -> None:
+    if not isinstance(obj, dict):
+        return      # shape error already reported by _check_series_map
+    for k in obj:
+        if isinstance(k, str) and k.startswith("hvd_memory"):
+            base = k.split("{", 1)[0]
+            if base not in MEMORY_SERIES:
+                errors.append(
+                    f"{field}[{k!r}]: unknown memory series {base!r} — "
+                    f"not in metrics_schema.MEMORY_SERIES")
 
 
 def _check_series_map(errors: List[str], obj, field: str) -> None:
@@ -225,6 +249,9 @@ def validate_snapshot(obj: Dict) -> List[str]:
     _check_degrade_series(errors, obj.get("counters", {}), "counters")
     _check_degrade_series(errors, obj.get("gauges", {}), "gauges")
     _check_degrade_series(errors, obj.get("histograms", {}), "histograms")
+    _check_memory_series(errors, obj.get("counters", {}), "counters")
+    _check_memory_series(errors, obj.get("gauges", {}), "gauges")
+    _check_memory_series(errors, obj.get("histograms", {}), "histograms")
     return errors
 
 
@@ -242,6 +269,7 @@ def validate_bench_metrics(obj: Dict) -> List[str]:
     _check_serve_series(errors, obj.get("counters", {}), "metrics.counters")
     _check_elastic_series(errors, obj.get("counters", {}), "metrics.counters")
     _check_degrade_series(errors, obj.get("counters", {}), "metrics.counters")
+    _check_memory_series(errors, obj.get("counters", {}), "metrics.counters")
     return errors
 
 

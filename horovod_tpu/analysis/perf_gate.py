@@ -34,13 +34,18 @@ PERF003    per-level exchange wire bytes grew more than the wire
            tolerance at the same hierarchy (de-fusion/de-quantization
            shows up here before a pod does)
 PERF004    candidate artifact reports a failed run (``rc``/``ok``)
+PERF006    measured HBM high-water grew more than the memory tolerance
+           at the same remat policy + plan (a remat or donation
+           regression shows up here before an OOM does)
 =========  ==============================================================
 
 Tolerances come from ``HOROVOD_PERF_GATE_TOLERANCE`` (relative
 throughput drop, default 0.10), ``HOROVOD_PERF_GATE_OVERLAP_TOLERANCE``
-(absolute overlap drop, default 0.10) and
+(absolute overlap drop, default 0.10),
 ``HOROVOD_PERF_GATE_WIRE_TOLERANCE`` (relative wire growth, default
-0.10) — registered knobs (docs/running.md).  Blessing an intentional
+0.10) and ``HOROVOD_PERF_GATE_MEMORY_TOLERANCE`` (relative HBM
+high-water growth, default 0.10) — registered knobs
+(docs/running.md).  Blessing an intentional
 regression = updating the trajectory the gate reads
 (docs/perf_gate.md walks the procedure).
 """
@@ -88,6 +93,14 @@ LATENCY_FIELDS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("serve_p99_latency_s", ("serve_offered_rps", "plan")),
 )
 
+#: memory (lower-is-better) fields and their comparability keys —
+#: PERF006 fails on growth beyond the memory tolerance.  ``remat_policy``
+#: guards the diff: a none-vs-full comparison measures two different
+#: recompute trades, not a leak (bench.py --hbm-budget; docs/memory.md)
+MEMORY_FIELDS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("hbm_high_water_bytes", ("remat_policy", "plan")),
+)
+
 
 class GateError(Exception):
     """Artifact unusable (unreadable, unknown schema, identity
@@ -113,11 +126,13 @@ class Tolerances:
     throughput: float = 0.10     # relative drop allowed
     overlap: float = 0.10        # absolute overlap_fraction drop
     wire: float = 0.10           # relative wire-byte growth allowed
+    memory: float = 0.10         # relative HBM high-water growth allowed
 
     @staticmethod
     def from_env(throughput: Optional[float] = None,
                  overlap: Optional[float] = None,
-                 wire: Optional[float] = None) -> "Tolerances":
+                 wire: Optional[float] = None,
+                 memory: Optional[float] = None) -> "Tolerances":
         def knob(name: str, override: Optional[float],
                  default: float) -> float:
             if override is not None:
@@ -135,7 +150,9 @@ class Tolerances:
                             throughput, 0.10),
             overlap=knob("HOROVOD_PERF_GATE_OVERLAP_TOLERANCE",
                          overlap, 0.10),
-            wire=knob("HOROVOD_PERF_GATE_WIRE_TOLERANCE", wire, 0.10))
+            wire=knob("HOROVOD_PERF_GATE_WIRE_TOLERANCE", wire, 0.10),
+            memory=knob("HOROVOD_PERF_GATE_MEMORY_TOLERANCE",
+                        memory, 0.10))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -307,6 +324,31 @@ def diff(baseline: Sequence[Artifact], candidate: Artifact,
                 f"{ref_name}; tolerance "
                 f"{tol.throughput * 100:.0f}%) — tail latency "
                 f"regressed under the same offered load"))
+
+    # PERF006 — HBM high-water (lower is better): growth beyond the
+    # memory tolerance vs the best (lowest) comparable baseline
+    for field, keys in MEMORY_FIELDS:
+        cand_v = _numeric(candidate.get(field))
+        if cand_v is None:
+            continue
+        best = None
+        for base in baseline:
+            base_v = _numeric(base.get(field))
+            if base_v is None or not _keys_match(base, candidate, keys):
+                continue
+            if best is None or base_v < best[0]:
+                best = (base_v, base.name)
+        if best is None:
+            continue
+        ref, ref_name = best
+        if ref > 0 and cand_v > (1.0 + tol.memory) * ref:
+            growth = (cand_v - ref) / ref
+            findings.append(GateFinding(
+                "PERF006",
+                f"{candidate.name}: {field} grew "
+                f"{growth * 100:.1f}% ({cand_v:g} vs {ref:g} in "
+                f"{ref_name}; tolerance {tol.memory * 100:.0f}%) — "
+                f"more HBM at the same remat policy and plan"))
 
     # PERF002 — measured overlap
     for key in sorted(candidate.fields):

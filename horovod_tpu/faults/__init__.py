@@ -48,6 +48,10 @@ Sites currently instrumented (grep ``faults.inject`` for ground truth):
                             shard is read — the transition's fragile point
 ``elastic.promote``         plan promotion back toward the base plan when
                             capacity returns
+``offload.d2h``             host-offload D2H copy (worker thread) — a fault
+                            degrades to the retained device state
+``offload.h2d``             host-offload H2D restore in ``fetch()`` — same
+                            degrade contract (memory/offload.py)
 ==========================  =================================================
 
 (Coverage is enforced statically: hvdlint rule HVD006 fails on any

@@ -1,0 +1,54 @@
+"""hvdmem — the memory-aware execution plane (docs/memory.md).
+
+The memory twin of the parallelism-plan compiler (``parallel/plan.py``):
+where the plan decides how work is *sharded*, this package decides how
+it *fits* —
+
+* ``memory/remat.py`` — the remat policy compiler: the per-block
+  ``none | dots | full | offload`` tiers behind the models' ``remat``
+  flag and the ``HOROVOD_REMAT_POLICY`` knob;
+* ``memory/planner.py`` — HBM-budgeted search over
+  (plan × remat × microbatch × offload), returning the *fastest
+  feasible* config under ``HOROVOD_HBM_BUDGET_BYTES``;
+* ``memory/offload.py`` — double-buffered async host offload of ZeRO
+  optimizer-state shards (chaos sites ``offload.d2h``/``offload.h2d``);
+* ``memory/smoke.py`` — the pure-sim planner scenario hvdci runs as
+  gate 8.
+
+``remat``/``planner``/``smoke`` import no JAX at module scope (the
+analysis CLI stays runtime-free); ``offload`` needs a device runtime
+and is therefore re-exported lazily.
+"""
+
+from horovod_tpu.memory.planner import (
+    InfeasibleError,
+    MemoryCandidate,
+    search_memory_plans,
+)
+from horovod_tpu.memory.remat import (
+    ENV_REMAT_POLICY,
+    REMAT_POLICIES,
+    remat_block,
+    remat_fn,
+    resolve_remat_policy,
+)
+
+__all__ = [
+    "ENV_REMAT_POLICY",
+    "HostOffloadEngine",
+    "InfeasibleError",
+    "MemoryCandidate",
+    "REMAT_POLICIES",
+    "remat_block",
+    "remat_fn",
+    "resolve_remat_policy",
+    "search_memory_plans",
+]
+
+
+def __getattr__(name):
+    if name == "HostOffloadEngine":     # lazy: offload.py imports JAX
+        from horovod_tpu.memory.offload import HostOffloadEngine
+
+        return HostOffloadEngine
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,201 @@
+"""Async host offload of optimizer-state shards (docs/memory.md).
+
+The ZeRO exchange (``optim/sharded_distributed_update``) already cuts
+optimizer memory to ``1/N`` per rank; on HBM-starved configs even the
+shard is too much.  :class:`HostOffloadEngine` streams a pytree —
+typically the shard-sized optimizer state, optionally checkpointed
+activations — to host RAM after the step that produced it and back
+just before the step that needs it, on the PrefetchIterator
+thread/queue pattern (``data/prefetch.py``): one worker issues the
+D2H copies in the background, a bounded ring
+(``HOROVOD_OFFLOAD_DEPTH``, default 2 — double buffering) applies
+backpressure, and the H2D restore is a blocking ``fetch`` whose wait
+time is the *stall* the telemetry histogram records — zero when the
+transfer hid under compute.
+
+Crash/consistency contract (the ``offload.*`` chaos sites pin it):
+
+* the engine retains the **device** reference until the host copy has
+  round-tripped; an injected or real transfer fault degrades to that
+  retained reference — the caller gets its state back, bit-identical,
+  and loses no step (``hvd_memory_offload_fallbacks_total`` counts);
+* the round-trip itself is bit-exact: ``jax.device_get`` /
+  ``jax.device_put`` move raw buffers, no dtype laundering;
+* ``close()`` is idempotent, joins the worker, and leaves nothing
+  running (the shutdown-without-leak discipline of the input
+  pipeline).
+
+Telemetry series (``analysis/metrics_schema.MEMORY_SERIES``):
+``hvd_memory_offload_bytes_total{direction=d2h|h2d}``,
+``hvd_memory_offload_stall_seconds``, ``hvd_memory_offload_inflight``,
+``hvd_memory_offload_fallbacks_total``.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from horovod_tpu import faults, telemetry
+from horovod_tpu.runtime.config import _env_int
+
+_THREAD_PREFIX = "hvd-offload"
+_DEFAULT_DEPTH = 2
+
+
+def default_offload_depth() -> int:
+    """HOROVOD_OFFLOAD_DEPTH — in-flight D2H transfers (2 = classic
+    double buffering), resolved config-first like the prefetch knobs."""
+    from horovod_tpu.runtime import state
+
+    if state.is_initialized():
+        return max(int(state.global_state().config.offload_depth), 1)
+    return max(_env_int("HOROVOD_OFFLOAD_DEPTH", _DEFAULT_DEPTH), 1)
+
+
+class HostOffloadEngine:
+    """Double-buffered D2H/H2D streaming of pytrees.
+
+    ::
+
+        engine = HostOffloadEngine(name="optimizer")
+        for step_i in range(steps):
+            opt_state = engine.fetch(step_i - 1, opt_state)  # H2D (no-op
+            params, opt_state, loss = step(params, opt_state, batch)
+            engine.offload(step_i, opt_state)                # async D2H
+        engine.close()
+
+    ``offload(tag, tree)`` issues the background D2H copy and blocks
+    only when ``depth`` copies are already in flight (backpressure).
+    ``fetch(tag, fallback)`` joins the copy and restores to device,
+    returning ``fallback`` untouched when the tag was never offloaded
+    (the cold first step) or when the transfer faulted (the degrade
+    path).  Tags are opaque; a step counter is the usual choice.
+    """
+
+    def __init__(self, name: str = "optimizer",
+                 depth: Optional[int] = None):
+        self.name = name
+        self.depth = max(int(depth), 1) if depth is not None \
+            else default_offload_depth()
+        self._pending = collections.OrderedDict()   # tag -> (future, ref)
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"{_THREAD_PREFIX}-{name}")
+        self._closed = False
+        self.stall_s = 0.0
+        self.fallbacks = 0
+        self._tel_bytes = telemetry.counter(
+            "hvd_memory_offload_bytes_total",
+            "bytes streamed by the host-offload engine, per direction")
+        self._tel_stall = telemetry.histogram(
+            "hvd_memory_offload_stall_seconds",
+            "seconds fetch() blocked on the host round-trip")
+        self._tel_inflight = telemetry.gauge(
+            "hvd_memory_offload_inflight",
+            "offloaded pytrees currently parked on the host")
+        self._tel_fallbacks = telemetry.counter(
+            "hvd_memory_offload_fallbacks_total",
+            "offload faults degraded to the retained device reference")
+
+    # -- D2H ----------------------------------------------------------------
+
+    def _d2h(self, tree):
+        import jax
+
+        faults.inject("offload.d2h")
+        host = jax.device_get(tree)
+        nbytes = sum(getattr(x, "nbytes", 0)
+                     for x in jax.tree_util.tree_leaves(host))
+        self._tel_bytes.labels(
+            engine=self.name, direction="d2h").inc(nbytes)
+        return host
+
+    def offload(self, tag, tree) -> None:
+        """Issue the async D2H copy of ``tree`` under ``tag``.
+
+        Keeps the device reference alongside the future — the degrade
+        contract — and applies backpressure at ``depth`` in-flight
+        copies by joining the oldest (its buffers then live on host
+        only, which is the point)."""
+        if self._closed:
+            raise RuntimeError(f"offload engine {self.name!r} is closed")
+        if tag in self._pending:
+            raise ValueError(f"tag {tag!r} already offloaded — fetch it "
+                             "before offloading it again")
+        while len(self._pending) >= self.depth:
+            _, (oldest, _ref) = next(iter(self._pending.items()))
+            try:
+                oldest.result()
+            except Exception:       # noqa: BLE001 — surfaced at fetch()
+                break
+        self._pending[tag] = (self._executor.submit(self._d2h, tree),
+                              tree)
+        self._tel_inflight.labels(engine=self.name).set(
+            len(self._pending))
+
+    # -- H2D ----------------------------------------------------------------
+
+    def fetch(self, tag, fallback):
+        """Restore ``tag``'s pytree to device, or degrade.
+
+        Blocks on the host copy (the measured stall), re-places it with
+        ``jax.device_put`` and returns the restored tree.  Returns
+        ``fallback`` as-is when ``tag`` was never offloaded, or when
+        the D2H/H2D path faulted — the retained device state, so the
+        training loop continues without losing the step."""
+        import jax
+
+        entry = self._pending.pop(tag, None)
+        self._tel_inflight.labels(engine=self.name).set(
+            len(self._pending))
+        if entry is None:
+            return fallback
+        future, device_ref = entry
+        t0 = time.perf_counter()
+        try:
+            host = future.result()
+            faults.inject("offload.h2d")
+            # restore to each leaf's ORIGINAL placement (the retained
+            # ref's sharding), then detach with an on-device copy: a
+            # compiled step consumes the restored state DONATED, and
+            # device_put from numpy may hand back a zero-copy buffer
+            # aliasing host memory the executable must not free
+            import jax.numpy as jnp
+
+            out = jax.tree_util.tree_map(
+                lambda h, d: jnp.copy(jax.device_put(
+                    h, getattr(d, "sharding", None))),
+                host, device_ref)
+            nbytes = sum(getattr(x, "nbytes", 0)
+                         for x in jax.tree_util.tree_leaves(host))
+            self._tel_bytes.labels(
+                engine=self.name, direction="h2d").inc(nbytes)
+        except Exception:           # noqa: BLE001 — the degrade path
+            self.fallbacks += 1
+            self._tel_fallbacks.labels(engine=self.name).inc()
+            out = device_ref
+        dt = time.perf_counter() - t0
+        self.stall_s += dt
+        self._tel_stall.labels(engine=self.name).observe(dt)
+        return out
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self) -> None:
+        """Idempotent: drop pending copies, join the worker."""
+        if self._closed:
+            return
+        self._closed = True
+        for future, _ref in self._pending.values():
+            future.cancel()
+        self._pending.clear()
+        self._executor.shutdown(wait=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

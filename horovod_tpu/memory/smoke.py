@@ -1,0 +1,104 @@
+"""Seeded memory-planner smoke for ``hvdci`` (analysis/ci.py gate 8).
+
+A sub-second, pure-sim (no JAX, no devices) walk of the HBM-budgeted
+planner: a synthetic 8-rank workload is searched unconstrained and
+under a budget chosen to exclude the unconstrained winner, the
+budgeted winner must actually fit and differ from the free one, an
+everything-infeasible budget must raise :class:`~horovod_tpu.memory.
+planner.InfeasibleError` naming the tightest axis, and the whole
+scenario runs twice and must be bit-identical — planner determinism
+itself is gated (the autotune acceptance criterion: same budget, same
+config, every run).
+
+Returns error strings (empty = pass) in the same idiom as
+``parallel.smoke`` / ``guard.smoke`` so ci.py folds it straight into
+its exit code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List
+
+from horovod_tpu.analysis import cost_model as CM
+from horovod_tpu.memory.planner import (
+    InfeasibleError,
+    search_memory_plans,
+)
+from horovod_tpu.parallel.plan import candidate_plans
+
+WORLD = 8
+GB = 1e9
+PARAM_BYTES = 8 * GB          # 2B-param model at fp32
+ACTIVATION_BYTES = 24 * GB    # remat-none activations, one batch shard
+BUDGET_BYTES = 6 * GB         # excludes the unconstrained winner
+INFEASIBLE_BYTES = 0.1 * GB   # nothing fits
+COMPUTE_S = 0.1
+
+
+def _search(budget: float) -> Any:
+    plans = [p.to_string() for p in candidate_plans(WORLD)]
+    return search_memory_plans(
+        plans, param_bytes=PARAM_BYTES,
+        activation_bytes=ACTIVATION_BYTES, budget_bytes=budget,
+        shard_optimizer_states=True, compute_s=COMPUTE_S,
+        n_ici=WORLD)
+
+
+def _scenario() -> Dict[str, Any]:
+    free = _search(budget=1e15)
+    tight = _search(budget=BUDGET_BYTES)
+    try:
+        _search(budget=INFEASIBLE_BYTES)
+        infeasible = None
+    except InfeasibleError as e:
+        infeasible = {"axis": e.tightest_axis, "message": str(e)}
+    return {
+        "free": dataclasses.asdict(free),
+        "tight": dataclasses.asdict(tight),
+        "tight_total": tight.total_bytes,
+        "tight_fits": CM.plan_fits(tight.predicted_bytes, BUDGET_BYTES),
+        "free_fits": CM.plan_fits(free.predicted_bytes, BUDGET_BYTES),
+        "infeasible": infeasible,
+    }
+
+
+def run_smoke() -> List[str]:
+    """Run the seeded planner scenario twice; returns a list of error
+    strings (empty = pass)."""
+    errors: List[str] = []
+    try:
+        r1, r2 = _scenario(), _scenario()
+    except Exception as e:          # noqa: BLE001 — a crash IS a failure
+        return [f"memory-smoke: scenario crashed: "
+                f"{type(e).__name__}: {e}"]
+    if r1["free_fits"]:
+        errors.append(
+            "memory-smoke: the unconstrained winner already fits the "
+            f"{BUDGET_BYTES / GB:.0f} GB budget — the scenario no "
+            "longer exercises the budget at all")
+    if not r1["tight_fits"]:
+        errors.append(
+            f"memory-smoke: budgeted winner needs "
+            f"{r1['tight_total'] / GB:.2f} GB, over the "
+            f"{BUDGET_BYTES / GB:.0f} GB budget — plan_fits and the "
+            "search disagree")
+    if r1["free"] == r1["tight"]:
+        errors.append(
+            "memory-smoke: budget did not change the winning config")
+    if r1["infeasible"] is None:
+        errors.append(
+            f"memory-smoke: {INFEASIBLE_BYTES / GB:.1f} GB budget did "
+            "not raise InfeasibleError")
+    elif r1["infeasible"]["axis"] not in (
+            "params", "grads", "optimizer", "activations", "exchange"):
+        errors.append(
+            f"memory-smoke: InfeasibleError names unknown axis "
+            f"{r1['infeasible']['axis']!r}")
+    elif r1["infeasible"]["axis"] not in r1["infeasible"]["message"]:
+        errors.append(
+            "memory-smoke: InfeasibleError message does not name the "
+            f"tightest axis {r1['infeasible']['axis']!r}")
+    if r1 != r2:
+        errors.append("memory-smoke: two seeded runs were not identical")
+    return errors

@@ -58,6 +58,7 @@ class MoEConfig:
     moe_every: int = 2              # every Nth block is MoE (Switch: 2)
     ep_axis: Optional[str] = None   # None: local experts; "ep": sharded
     remat: bool = False
+    remat_policy: Optional[str] = None  # none|dots|full|offload
 
     def transformer(self) -> TransformerConfig:
         return TransformerConfig(
@@ -66,7 +67,7 @@ class MoEConfig:
             d_ff=self.d_ff, max_seq_len=self.max_seq_len,
             dtype=self.dtype, attention_impl=self.attention_impl,
             flash_block=self.flash_block, causal=self.causal,
-            remat=self.remat)
+            remat=self.remat, remat_policy=self.remat_policy)
 
 
 class SwitchFFN(nn.Module):
@@ -202,11 +203,13 @@ class MoETransformerLM(nn.Module):
                        embedding_init=nn.initializers.normal(0.02),
                        name="embed")
         x = emb(tokens)
+        from horovod_tpu.memory.remat import remat_block, \
+            resolve_remat_policy
+
+        policy = resolve_remat_policy(cfg.remat_policy, cfg.remat)
         for i in range(cfg.num_layers):
             moe = cfg.moe_every and (i + 1) % cfg.moe_every == 0
-            cls = MoEBlock if moe else Block
-            if cfg.remat:
-                cls = nn.remat(cls, static_argnums=())
+            cls = remat_block(MoEBlock if moe else Block, policy)
             x = cls(cfg if moe else tcfg, name=f"layer_{i}")(x, positions)
         x = RMSNorm(name="ln_f")(x)
         return emb.attend(x.astype(jnp.float32))

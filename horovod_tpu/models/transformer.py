@@ -58,6 +58,10 @@ class TransformerConfig:
     sp_axis: str = AXIS_SP
     tp_axis: str = AXIS_TP
     remat: bool = False
+    # per-block remat tier (none|dots|full|offload) — overrides the
+    # boolean when set; resolution order and the memory/recompute
+    # trade of each tier: memory/remat.py, docs/memory.md
+    remat_policy: Optional[str] = None
     # tile-fused matmul⊗collective kernels at the tp boundaries
     # (HOROVOD_FUSED_COLLECTIVES, docs/fused_kernels.md) — consumed by
     # :func:`fused_tp_apply`, the explicit shard_map execution mode;
@@ -224,9 +228,11 @@ class TransformerLM(nn.Module):
                        embedding_init=nn.initializers.normal(0.02),
                        name="embed")
         x = emb(tokens)
-        block = Block
-        if cfg.remat:
-            block = nn.remat(Block, static_argnums=())
+        from horovod_tpu.memory.remat import remat_block, \
+            resolve_remat_policy
+
+        block = remat_block(
+            Block, resolve_remat_policy(cfg.remat_policy, cfg.remat))
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"layer_{i}")(x, positions)
         x = RMSNorm(name="ln_f")(x)

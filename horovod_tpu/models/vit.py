@@ -19,7 +19,7 @@ power-of-two-friendly ``(image/patch)²`` for flash-attention tiling).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -40,6 +40,7 @@ class ViTConfig:
     dtype: Any = jnp.bfloat16
     attention_impl: str = "dense"       # dense | flash
     remat: bool = False
+    remat_policy: Optional[str] = None  # none|dots|full|offload
 
     @property
     def num_patches(self) -> int:
@@ -56,7 +57,7 @@ class ViTConfig:
             d_model=self.d_model, d_ff=self.d_ff,
             max_seq_len=self.num_patches, dtype=self.dtype,
             attention_impl=self.attention_impl, causal=False,
-            remat=self.remat)
+            remat=self.remat, remat_policy=self.remat_policy)
 
 
 class VisionTransformer(nn.Module):
@@ -77,9 +78,11 @@ class VisionTransformer(nn.Module):
         b, gh, gw, d = x.shape
         x = x.reshape(b, gh * gw, d)
         positions = jnp.arange(x.shape[1])
-        block = Block
-        if cfg.remat:
-            block = nn.remat(Block, static_argnums=())
+        from horovod_tpu.memory.remat import remat_block, \
+            resolve_remat_policy
+
+        block = remat_block(
+            Block, resolve_remat_policy(cfg.remat_policy, cfg.remat))
         for i in range(cfg.num_layers):
             x = block(tcfg, name=f"layer_{i}")(x, positions)
         x = RMSNorm(name="ln_f")(x)

@@ -88,7 +88,7 @@ class DistributedTrainStep:
                  mode: str = "pjit",
                  op: Optional[ReduceOp] = Average,
                  compression=None,
-                 remat: bool = False,
+                 remat: Union[bool, str] = False,
                  data_axes: AxisSpec = GLOBAL_AXES,
                  donate: bool = True,
                  donate_batch: bool = False,
@@ -329,7 +329,21 @@ class DistributedTrainStep:
         self._fsdp_min = fsdp_min_weight_size
         self._data_axes = tuple(data_axes) if not isinstance(data_axes, str) \
             else (data_axes,)
-        loss_fn = jax.checkpoint(loss_fn) if remat else loss_fn
+        # remat accepts the legacy bool or a policy string (none|dots|
+        # full|offload).  The resolved policy — including the
+        # HOROVOD_REMAT_POLICY env knob, which steers the *models'*
+        # per-block remat — is an AOT-key field so a warm start never
+        # serves a different remat variant (memory/remat.py,
+        # docs/memory.md).  The loss-fn wrap itself only happens when
+        # the caller asked for it: an env-driven model already remats
+        # per block, and checkpointing the whole loss on top would just
+        # replay the forward twice.
+        from horovod_tpu.memory.remat import remat_fn, \
+            resolve_remat_policy
+
+        self._remat_policy = resolve_remat_policy(remat=remat)
+        if remat:
+            loss_fn = remat_fn(loss_fn, self._remat_policy)
         self._loss_fn = loss_fn
         if steps_per_call < 1:
             raise ValueError(
@@ -627,6 +641,15 @@ class DistributedTrainStep:
         return self._fused_collectives
 
     @property
+    def remat_policy(self) -> str:
+        """The resolved remat policy (``none|dots|full|offload``) this
+        step was built under — explicit ``remat=`` argument or the
+        ``HOROVOD_REMAT_POLICY`` knob (memory/remat.py, docs/memory.md).
+        An AOT-key field; ``bench.py --hbm-budget`` emits it as the
+        ``remat_policy`` BENCH field."""
+        return self._remat_policy
+
+    @property
     def compile_cache_hit(self) -> Optional[bool]:
         """Whether this step's most recent XLA compile was served from
         the persistent AOT store (``True``), compiled fresh and
@@ -652,6 +675,7 @@ class DistributedTrainStep:
             "guard": self._guard is not None,
             "plan": None if self._plan is None else self._plan.to_string(),
             "error_feedback": self._error_feedback,
+            "remat": self._remat_policy,
         }
 
     def init(self, params):

@@ -246,6 +246,46 @@ class ShardingPlan:
                    for ax in PLAN_AXES)
 
 
+def candidate_plans(n_devices: int,
+                    axes: Tuple[str, ...] = ("dp", "fsdp", "tp")
+                    ) -> Tuple["ShardingPlan", ...]:
+    """Every exact factorization of ``n_devices`` over ``axes`` —
+    the enumeration the HBM planner (``memory/planner.py``) and the
+    budget-aware autotune walk.
+
+    Deterministic order: dp-heaviest first (the pure-data plan is the
+    presumptive speed winner; the budget search then works toward the
+    sharded-parameter end), then lexicographic on the remaining
+    extents.  ``axes`` must be plan axes; ``n_devices`` must be >= 1.
+    """
+    n = int(n_devices)
+    if n < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    for ax in axes:
+        if ax not in PLAN_AXES:
+            raise ValueError(
+                f"unknown plan axis {ax!r}: expected one of "
+                f"{', '.join(PLAN_AXES)}")
+    out = []
+
+    def factor(remaining: int, idx: int, extents: Dict[str, int]):
+        if idx == len(axes) - 1:
+            out.append(ShardingPlan(**{**extents, axes[idx]: remaining}))
+            return
+        d = 1
+        while d <= remaining:
+            if remaining % d == 0:
+                factor(remaining // d, idx + 1,
+                       {**extents, axes[idx]: d})
+            d += 1
+        return
+
+    factor(n, 0, {})
+    out.sort(key=lambda p: tuple(-getattr(p, ax) if ax == "dp"
+                                 else getattr(p, ax) for ax in axes))
+    return tuple(out)
+
+
 PlanLike = Union[str, ShardingPlan]
 
 

@@ -82,6 +82,11 @@ KNOWN_KNOBS = frozenset({
     # -- perf regression gate (analysis/perf_gate.py, docs/perf_gate.md)
     "HOROVOD_PERF_GATE_TOLERANCE", "HOROVOD_PERF_GATE_OVERLAP_TOLERANCE",
     "HOROVOD_PERF_GATE_WIRE_TOLERANCE",
+    "HOROVOD_PERF_GATE_MEMORY_TOLERANCE",
+    # -- memory plane (horovod_tpu/memory, docs/memory.md): remat tier,
+    #    HBM budget for the plan autotuner, host offload
+    "HOROVOD_REMAT_POLICY", "HOROVOD_HBM_BUDGET_BYTES",
+    "HOROVOD_OFFLOAD_OPTIMIZER", "HOROVOD_OFFLOAD_DEPTH",
     # -- training-state integrity plane (horovod_tpu/guard,
     #    docs/guardian.md)
     "HOROVOD_GUARD", "HOROVOD_GUARD_POLICY",
@@ -254,6 +259,15 @@ class Config:
     # passed (docs/parallelism.md)
     plan: Optional[str] = None
 
+    # -- memory plane (horovod_tpu/memory, docs/memory.md): remat tier
+    # (None = model/step default), HBM budget the plan autotuner must
+    # fit (None = device capacity), host offload of the ZeRO optimizer
+    # shard + the offload ring depth (2 = double buffering)
+    remat_policy: Optional[str] = None
+    hbm_budget_bytes: Optional[int] = None
+    offload_optimizer: bool = False
+    offload_depth: int = 2
+
     # knobs the user set explicitly must not be autotuned
     # (reference "fixed" flag, operations.cc:436)
     fixed_knobs: frozenset = frozenset()
@@ -276,6 +290,8 @@ class Config:
         mark("HOROVOD_EXCHANGE_WIRE_DTYPE", "exchange_wire_dtype")
         mark("HOROVOD_FUSED_COLLECTIVES", "fused_collectives")
         mark("HOROVOD_PLAN", "plan")
+        mark("HOROVOD_REMAT_POLICY", "remat_policy")
+        mark("HOROVOD_OFFLOAD_OPTIMIZER", "offload_optimizer")
 
         def opt_int(name: str) -> Optional[int]:
             v = os.environ.get(name)
@@ -359,5 +375,11 @@ class Config:
             fault_plan=os.environ.get("HOROVOD_FAULT_PLAN"),
             mesh_shape=os.environ.get("HOROVOD_TPU_MESH_SHAPE"),
             plan=os.environ.get("HOROVOD_PLAN"),
+            remat_policy=(os.environ.get("HOROVOD_REMAT_POLICY") or
+                          None),
+            hbm_budget_bytes=opt_int("HOROVOD_HBM_BUDGET_BYTES"),
+            offload_optimizer=_env_bool("HOROVOD_OFFLOAD_OPTIMIZER",
+                                        False),
+            offload_depth=_env_int("HOROVOD_OFFLOAD_DEPTH", 2),
             fixed_knobs=frozenset(fixed),
         )

@@ -48,6 +48,15 @@ class ThroughputAutotuner:
     any candidate, scores every candidate identically, or raises,
     leaves that axis fully measured — the measurement, never the
     model, picks the winner.
+
+    ``feasible`` (optional) is a hard predicate — the HBM-budget
+    contract of :func:`horovod_tpu.analysis.cost_model.plan_fits`: a
+    point it rejects is never compiled or measured (score ``-inf``),
+    so the tuner returns the fastest *feasible* point.  Unlike
+    ``predict`` it is a constraint, not a ranking — a raising
+    predicate fails the run (a budget that cannot be evaluated must
+    not silently become "everything fits").  When every point in the
+    grid is rejected, :meth:`run` raises ``RuntimeError``.
     """
 
     def __init__(self, measure: Callable[[Dict], float],
@@ -57,7 +66,8 @@ class ThroughputAutotuner:
                  max_rounds: int = 3,
                  predict: Optional[Callable[[Dict], Optional[float]]]
                  = None,
-                 prune_to: int = 2):
+                 prune_to: int = 2,
+                 feasible: Optional[Callable[[Dict], bool]] = None):
         self._measure = measure
         self._axes = {k: list(v) for k, v in axes.items()}
         self._seed = dict(seed) if seed else \
@@ -66,6 +76,7 @@ class ThroughputAutotuner:
         self._max_rounds = max_rounds
         self._predict = predict
         self._prune_to = max(1, int(prune_to))
+        self._feasible = feasible
         self._cache: Dict[Tuple, float] = {}
         self._rows: List[dict] = []
 
@@ -100,12 +111,21 @@ class ThroughputAutotuner:
         key = self._key(point)
         if key in self._cache:
             return self._cache[key]
+        if self._feasible is not None and not self._feasible(dict(point)):
+            self._cache[key] = float("-inf")
+            self._rows.append(dict(point, units_per_sec="",
+                                   measure_seconds=0.0,
+                                   infeasible="*"))
+            hvd_logging.info("autotune: %s -> infeasible (skipped)",
+                             point)
+            return float("-inf")
         t0 = time.monotonic()
         rate = float(self._measure(dict(point)))
         self._cache[key] = rate
         self._rows.append(dict(point, units_per_sec=rate,
                                measure_seconds=round(
-                                   time.monotonic() - t0, 1)))
+                                   time.monotonic() - t0, 1),
+                               infeasible=""))
         hvd_logging.info("autotune: %s -> %.1f/sec", point, rate)
         return rate
 
@@ -126,6 +146,10 @@ class ThroughputAutotuner:
             if not moved:
                 break
         best = max(self._cache.items(), key=lambda kv: kv[1])
+        if best[1] == float("-inf"):
+            raise RuntimeError(
+                "autotune: no feasible point in the grid — every "
+                "candidate was rejected by the feasibility predicate")
         point = dict(zip(self._axes, best[0]))
         self._write_log(point, best[1])
         return point, best[1]

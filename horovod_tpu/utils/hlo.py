@@ -319,14 +319,59 @@ def buffer_liveness(hlo_text: str) -> List[Tuple[str, int, int, int]]:
             for name, nbytes, d in defs]
 
 
+# one `{out_index}: (param_number, {param_index}, kind)` entry of the
+# module-header input_output_alias attribute
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{\s*[\d\s,]*\}\s*:\s*\(\s*(\d+)\s*,\s*\{([\d\s,]*)\}")
+_PARAM_NUM_RE = re.compile(r"\bparameter\((\d+)\)")
+
+
+def donated_param_bytes(hlo_text: str) -> int:
+    """Total bytes of donated ENTRY parameters — inputs the module
+    header's ``input_output_alias`` maps onto outputs (``jit``
+    ``donate_argnums``: the step's params/opt_state, and the batch
+    under ``donate_batch``).  A donated input's buffer IS its output's
+    buffer, so a liveness scan that allocates both double-counts
+    exactly these bytes.  Nested alias indices (a donated tuple
+    *element*) contribute the whole parameter — an over-subtraction in
+    theory, but XLA flattens jit arguments to leaf parameters, so the
+    index is ``{}`` in every dump this parser meets."""
+    m = re.search(r"input_output_alias=\{(.*)", hlo_text)
+    if m is None:
+        return 0
+    sizes = {}
+    for line in entry_computation(hlo_text).splitlines():
+        om = _ANY_OP_RE.match(line)
+        if om is None or om.group(3) != "parameter":
+            continue
+        pm = _PARAM_NUM_RE.search(line)
+        if pm is not None:
+            sizes[int(pm.group(1))] = result_bytes(om.group(2))
+    return sum(sizes.get(int(pnum), 0)
+               for pnum, _pidx in _ALIAS_ENTRY_RE.findall(m.group(1)))
+
+
 def memory_high_water(hlo_text: str) -> int:
     """Peak sum of simultaneously-live ENTRY buffers — the static
     per-device memory high-water estimate the cost model reports
-    (docs/perf_gate.md lists the assumptions: no aliasing, no
-    donation, tuple results counted whole)."""
+    (docs/perf_gate.md lists the assumptions: no aliasing between
+    distinct values, tuple results counted whole).  Donated inputs
+    (``input_output_alias``) are accounted: the ROOT's result reuses
+    their buffers, so its allocation is reduced by
+    :func:`donated_param_bytes` — without this every donated train
+    step double-counted params + opt_state at the update point."""
     live = buffer_liveness(hlo_text)
     if not live:
         return 0
+    donated = donated_param_bytes(hlo_text)
+    if donated:
+        lines = entry_computation(hlo_text).splitlines()
+        root = next((i for i, ln in enumerate(lines)
+                     if ln.lstrip().startswith("ROOT ")), None)
+        if root is not None:
+            live = [(name, nbytes - min(nbytes, donated)
+                     if d == root else nbytes, d, last)
+                    for name, nbytes, d, last in live]
     n = max(last for _, _, _, last in live) + 1
     alloc = [0] * n
     free = [0] * n

@@ -125,6 +125,75 @@ ENTRY %main (p: f32[256]) -> f32[64] {
         assert H.memory_high_water(text) == 1024
 
 
+# a donated train step's module shape, as jit emits it: the alias map
+# rides the HloModule header line, ENTRY params are %Arg_N, and a
+# fusion body contributes its own parameter(0/1) lines that the
+# donation parser must NOT pick up (they'd shadow the ENTRY sizes)
+DONATED_MODULE = """\
+HloModule jit_step, is_scheduled=true, \
+input_output_alias={ {0}: (0, {}, may-alias), {1}: (1, {}, may-alias) }
+
+%fused_update (p0: f32[256], p1: f32[256]) -> f32[256] {
+  %param_0.1 = f32[256]{0} parameter(0)
+  %param_1.2 = f32[256]{0} parameter(1)
+  ROOT %a = f32[256]{0} add(f32[256]{0} %param_0.1, f32[256]{0} %param_1.2)
+}
+
+ENTRY %main (Arg_0.1: f32[256], Arg_1.2: f32[256], Arg_2.3: f32[64]) -> (f32[256], f32[256]) {
+  %Arg_0.1 = f32[256]{0} parameter(0)
+  %Arg_1.2 = f32[256]{0} parameter(1)
+  %Arg_2.3 = f32[64]{0} parameter(2)
+  %upd = f32[256]{0} fusion(f32[256]{0} %Arg_0.1, f32[256]{0} %Arg_1.2), kind=kLoop, calls=%fused_update
+  ROOT %out = (f32[256]{0}, f32[256]{0}) tuple(f32[256]{0} %upd, f32[256]{0} %Arg_1.2)
+}
+"""
+
+
+class TestDonationAccounting:
+    def test_donated_param_bytes_reads_the_alias_header(self):
+        """Params 0 and 1 (1024 B each) are donated; param 2 is not."""
+        assert H.donated_param_bytes(DONATED_MODULE) == 2048
+
+    def test_donated_sizes_scope_to_entry_not_fusion_bodies(self):
+        """A fusion body whose parameter(0) is a different size from
+        ENTRY's must not shadow it: shrink the body params to f32[4]
+        and the donated total must still be the ENTRY 2048."""
+        text = DONATED_MODULE.replace(
+            "%param_0.1 = f32[256]{0}", "%param_0.1 = f32[4]{0}").replace(
+            "%param_1.2 = f32[256]{0}", "%param_1.2 = f32[4]{0}").replace(
+            "(p0: f32[256], p1: f32[256])", "(p0: f32[4], p1: f32[4])")
+        assert H.donated_param_bytes(text) == 2048
+
+    def test_high_water_credits_donation_at_the_root(self):
+        """Without the alias header the scan books params AND the ROOT
+        result at the update point — donated steps double-count exactly
+        params+opt_state.  With it, the ROOT alloc is reduced by the
+        donated bytes (clamped at zero) and the peak drops.
+
+        Plain: peak is the ROOT line — Arg_1 (1024) + upd (1024) +
+        out (2048) = 4096.  Donated: the 2048 B out is fully credited
+        (2048 donated), the peak moves to the fusion line — Arg_0 +
+        Arg_1 + upd = 3072."""
+        undonated = "\n".join(
+            ln for ln in DONATED_MODULE.splitlines()
+            if "input_output_alias" not in ln)
+        assert H.memory_high_water(undonated) == 4096
+        assert H.memory_high_water(DONATED_MODULE) == 3072
+
+    def test_missing_alias_header_is_a_no_op(self):
+        assert H.donated_param_bytes(FUSION_MODULE) == 0
+
+    def test_buffer_liveness_is_untouched_by_donation(self):
+        """Donation is a memory_high_water credit only — the liveness
+        list (names, sizes, lifetimes) must be identical with and
+        without the header, so every other consumer is unaffected."""
+        undonated = "\n".join(
+            ln for ln in DONATED_MODULE.splitlines()
+            if "input_output_alias" not in ln)
+        assert H.buffer_liveness(DONATED_MODULE) == \
+            H.buffer_liveness(undonated)
+
+
 class TestWireAttribution:
     RS_ICI = ("  %rs = f32[13]{0} reduce-scatter(%x), "
               "replica_groups=[2,4]<=[8], dimensions={0}, to_apply=%add")

@@ -1,0 +1,315 @@
+"""Memory plane (horovod_tpu/memory/, docs/memory.md): HBM-budgeted
+planner determinism + infeasibility diagnostics, the host-offload
+engine's bit-exact round-trip and chaos degrade contract through a real
+seeded train loop, the autotuner's hard feasibility gate, PERF006, and
+the closed hvd_memory_* telemetry vocabulary."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from horovod_tpu import faults
+from horovod_tpu.analysis import metrics_schema as MS
+from horovod_tpu.analysis import perf_gate as PG
+from horovod_tpu.faults import FaultPlan
+from horovod_tpu.memory import (
+    HostOffloadEngine,
+    InfeasibleError,
+    search_memory_plans,
+)
+from horovod_tpu.memory.smoke import run_smoke
+from horovod_tpu.parallel.plan import candidate_plans
+from horovod_tpu.utils.bench_autotune import ThroughputAutotuner
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+
+
+# -- planner ----------------------------------------------------------------
+
+PLANS = [p.to_string() for p in candidate_plans(8)]
+SEARCH_KW = dict(param_bytes=8e9, activation_bytes=24e9,
+                 shard_optimizer_states=True, compute_s=0.1, n_ici=8)
+
+
+class TestPlanner:
+    def test_deterministic_across_two_runs(self):
+        a = search_memory_plans(PLANS, budget_bytes=6e9, **SEARCH_KW)
+        b = search_memory_plans(PLANS, budget_bytes=6e9, **SEARCH_KW)
+        assert a == b
+        assert a.summary() == b.summary()
+
+    def test_budget_excludes_the_free_winner(self):
+        free = search_memory_plans(PLANS, **SEARCH_KW)
+        tight = search_memory_plans(PLANS, budget_bytes=6e9,
+                                    **SEARCH_KW)
+        assert free != tight
+        assert tight.total_bytes <= 6e9 < free.total_bytes
+        # the budget buys memory with time, never the reverse
+        assert tight.predicted_step_s >= free.predicted_step_s
+
+    def test_infeasible_names_the_tightest_axis(self):
+        with pytest.raises(InfeasibleError) as e:
+            search_memory_plans(PLANS, budget_bytes=0.1e9, **SEARCH_KW)
+        err = e.value
+        assert err.tightest_axis in ("params", "grads", "optimizer",
+                                     "activations", "exchange")
+        assert err.tightest_axis in str(err)
+        assert err.closest is not None
+        assert err.closest.total_bytes > 0.1e9
+
+    def test_empty_grid_refuses(self):
+        with pytest.raises(ValueError, match="at least one plan"):
+            search_memory_plans([], **SEARCH_KW)
+
+    def test_smoke_scenario_clean(self):
+        # hvdci gate 8 — the same walk the CI entry runs
+        assert run_smoke() == []
+        assert run_smoke() == []
+
+
+# -- host offload -----------------------------------------------------------
+
+def tree(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"mu": jnp.asarray(rng.randn(32, 8), jnp.float32),
+            "nu": jnp.asarray(rng.rand(32, 8), jnp.float32),
+            "count": jnp.asarray(7, jnp.int32)}
+
+
+def assert_bit_exact(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestHostOffloadEngine:
+    def test_round_trip_bit_exact(self):
+        with HostOffloadEngine(name="t", depth=2) as engine:
+            t = tree()
+            engine.offload(0, t)
+            out = engine.fetch(0, t)
+            assert_bit_exact(t, out)
+            assert engine.fallbacks == 0
+            assert engine.stall_s >= 0.0
+
+    def test_unknown_tag_returns_fallback(self):
+        with HostOffloadEngine(name="t") as engine:
+            t = tree()
+            assert engine.fetch("never-offloaded", t) is t
+
+    def test_double_offload_same_tag_refuses(self):
+        with HostOffloadEngine(name="t") as engine:
+            t = tree()
+            engine.offload(0, t)
+            with pytest.raises(ValueError, match="already offloaded"):
+                engine.offload(0, t)
+            engine.fetch(0, t)
+
+    def test_close_idempotent_and_refuses_new_work(self):
+        engine = HostOffloadEngine(name="t")
+        engine.close()
+        engine.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            engine.offload(0, tree())
+
+    @pytest.mark.parametrize("site", ["offload.d2h", "offload.h2d"])
+    def test_chaos_fault_degrades_to_device_ref(self, site):
+        """An injected transfer fault must hand back the retained
+        device reference — bit-identical state, counted fallback."""
+        faults.set_plan(FaultPlan().add(site, "raise", "OSError",
+                                        at=1))
+        with HostOffloadEngine(name="t", depth=2) as engine:
+            t = tree()
+            engine.offload(0, t)
+            out = engine.fetch(0, t)
+            assert out is t                   # the retained reference
+            assert engine.fallbacks == 1
+            # the fault plan is exhausted: the next round-trip heals
+            t2 = tree(seed=1)
+            engine.offload(1, t2)
+            assert_bit_exact(t2, engine.fetch(1, t2))
+            assert engine.fallbacks == 1
+
+
+class TestOffloadTrainLoop:
+    """The engine's contract on the real thing: streaming the
+    optimizer state out and back between seeded train steps changes
+    no number — with or without an injected transfer fault."""
+
+    STEPS = 4
+
+    def _run(self, offload, plan=None):
+        import horovod_tpu as hvd
+
+        hvd.init()
+        if plan is not None:
+            faults.set_plan(plan)
+
+        def loss_fn(params, batch):
+            h = jnp.tanh(batch["x"] @ params["w1"])
+            return jnp.mean((h @ params["w2"] - batch["y"]) ** 2)
+
+        rng = np.random.RandomState(0)
+        variables = {
+            "w1": jnp.asarray(rng.randn(8, 16) * 0.3, jnp.float32),
+            "w2": jnp.asarray(rng.randn(16, 4) * 0.3, jnp.float32)}
+        step = hvd.DistributedTrainStep(loss_fn, optax.adamw(0.05))
+        params, opt = step.init(variables)
+        batch = step.shard_batch({
+            "x": jnp.asarray(np.random.RandomState(1).randn(8, 8),
+                             jnp.float32),
+            "y": jnp.asarray(np.random.RandomState(2).randn(8, 4),
+                             jnp.float32)})
+        engine = HostOffloadEngine(name="loop", depth=2) \
+            if offload else None
+        losses = []
+        for i in range(self.STEPS):
+            if engine is not None:
+                opt = engine.fetch(i - 1, opt)
+            params, opt, loss = step(params, opt, batch)
+            losses.append(float(loss))
+            if engine is not None:
+                engine.offload(i, opt)
+        if engine is not None:
+            opt = engine.fetch(self.STEPS - 1, opt)
+            engine.close()
+        faults.clear_plan()
+        return losses, engine
+
+    def test_offloaded_loop_is_bit_identical(self):
+        base, _ = self._run(offload=False)
+        offloaded, engine = self._run(offload=True)
+        assert offloaded == base
+        assert engine.fallbacks == 0
+
+    @pytest.mark.parametrize("site", ["offload.d2h", "offload.h2d"])
+    def test_chaos_fault_loses_no_step(self, site):
+        base, _ = self._run(offload=False)
+        plan = FaultPlan().add(site, "raise", "OSError", at=2)
+        faulted, engine = self._run(offload=True, plan=plan)
+        assert faulted == base
+        assert engine.fallbacks == 1
+
+    def test_offload_depth_config_default(self):
+        import horovod_tpu as hvd
+        from horovod_tpu.memory.offload import default_offload_depth
+        from horovod_tpu.runtime import state
+
+        hvd.init()
+        assert state.global_state().config.offload_depth == 2
+        assert default_offload_depth() == 2
+
+
+# -- autotuner feasibility gate ---------------------------------------------
+
+class TestAutotunerFeasibility:
+    def test_infeasible_points_never_measured(self):
+        measured = []
+
+        def measure(point):
+            measured.append(point["x"])
+            return float(point["x"])
+
+        tuner = ThroughputAutotuner(measure, {"x": [1, 2, 3, 4]},
+                                    feasible=lambda p: p["x"] <= 2)
+        best, rate = tuner.run()
+        assert best == {"x": 2}
+        assert rate == 2.0
+        assert set(measured) == {1, 2}
+
+    def test_all_infeasible_raises_and_never_measures(self):
+        def measure(point):
+            raise AssertionError("must not measure a rejected point")
+
+        tuner = ThroughputAutotuner(measure, {"x": [1, 2, 3]},
+                                    feasible=lambda p: False)
+        with pytest.raises(RuntimeError, match="no feasible point"):
+            tuner.run()
+
+    def test_no_predicate_keeps_old_behavior(self):
+        tuner = ThroughputAutotuner(lambda p: float(p["x"]),
+                                    {"x": [1, 2, 3]})
+        assert tuner.run() == ({"x": 3}, 3.0)
+
+
+# -- PERF006 ----------------------------------------------------------------
+
+MEM_BASE = {"hbm_high_water_bytes": 1.0e9, "remat_policy": "full",
+            "plan": "dp=8"}
+
+
+class TestPerf006:
+    def _art(self, name, **over):
+        return PG._validate(name, dict(MEM_BASE, **over))
+
+    def test_growth_beyond_tolerance_fires(self):
+        findings = PG.diff([self._art("base")],
+                           self._art("cand",
+                                     hbm_high_water_bytes=1.2e9),
+                           PG.Tolerances())
+        assert [f.rule for f in findings] == ["PERF006"]
+        assert "hbm_high_water_bytes" in findings[0].message
+
+    def test_growth_within_tolerance_passes(self):
+        findings = PG.diff([self._art("base")],
+                           self._art("cand",
+                                     hbm_high_water_bytes=1.05e9),
+                           PG.Tolerances())
+        assert findings == []
+
+    def test_different_remat_policy_not_compared(self):
+        """none-vs-full measures two recompute trades, not a leak —
+        the comparability key keeps the gate quiet."""
+        findings = PG.diff([self._art("base")],
+                           self._art("cand",
+                                     hbm_high_water_bytes=3.0e9,
+                                     remat_policy="none"),
+                           PG.Tolerances())
+        assert findings == []
+
+    def test_memory_tolerance_knob(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_PERF_GATE_MEMORY_TOLERANCE", "0.5")
+        tol = PG.Tolerances.from_env()
+        assert tol.memory == 0.5
+        findings = PG.diff([self._art("base")],
+                           self._art("cand",
+                                     hbm_high_water_bytes=1.4e9),
+                           tol)
+        assert findings == []
+
+
+# -- telemetry vocabulary ---------------------------------------------------
+
+class TestMemorySeries:
+    def test_known_series_validate(self):
+        obj = {"schema_version": MS.SCHEMA_VERSION, "counters": {
+            'hvd_memory_offload_bytes_total'
+            '{direction="d2h",engine="x"}': 5.0,
+            'hvd_memory_offload_fallbacks_total{engine="x"}': 1.0,
+        }}
+        assert MS.validate_bench_metrics(obj) == []
+
+    def test_unknown_memory_series_rejected(self):
+        obj = {"schema_version": MS.SCHEMA_VERSION, "counters": {
+            "hvd_memory_bogus_total": 1.0}}
+        errors = MS.validate_bench_metrics(obj)
+        assert len(errors) == 1
+        assert "hvd_memory_bogus_total" in errors[0]
+
+    def test_engine_counters_live_in_the_vocabulary(self):
+        """Every series the offload engine emits is a MEMORY_SERIES
+        member — the closed-vocabulary guarantee."""
+        for name in ("hvd_memory_offload_bytes_total",
+                     "hvd_memory_offload_stall_seconds",
+                     "hvd_memory_offload_inflight",
+                     "hvd_memory_offload_fallbacks_total",
+                     "hvd_memory_hbm_high_water_bytes",
+                     "hvd_memory_plan_bytes"):
+            assert name in MS.MEMORY_SERIES
