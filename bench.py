@@ -1568,6 +1568,24 @@ def run_hbm_budget(args, hvd):
     out["recompute_overhead"] = round(
         measured["none"]["rate"] / measured["full"]["rate"] - 1.0, 4)
 
+    # the offload=True point must price at the measured footprint, not
+    # below it: the engine restores the whole shard before the step
+    # (OFFLOAD_RESIDENT_FRACTION = 1.0), so its prediction is held to
+    # the same remat-none high-water as the un-offloaded step
+    pred_off = CM.plan_memory_bytes(
+        plan_str, param_bytes=param_bytes, activation_bytes=act_bytes,
+        remat_policy="none", offload_optimizer=True).total
+    off_err = abs(pred_off - measured["none"]["hw"]) \
+        / measured["none"]["hw"]
+    if off_err > 0.25:
+        log(f"bench[hbm:offload]: WARNING plan_memory_bytes(offload) "
+            f"{pred_off / 1e6:.1f} MB is {off_err * 100:.0f}% off the "
+            f"measured {measured['none']['hw'] / 1e6:.1f} MB (25% bar)")
+    out.update({
+        "plan_memory_bytes_offload": round(pred_off, 1),
+        "plan_memory_rel_err_offload": round(off_err, 4),
+    })
+
     # HBM-budgeted planner over the candidate plan space of this
     # workload — default budget 80% of the remat-none high-water so
     # the unconstrained winner cannot fit and the budget provably

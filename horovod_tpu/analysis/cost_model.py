@@ -331,10 +331,20 @@ REMAT_RECOMPUTE_OVERHEAD = {
     "none": 0.0, "dots": 0.12, "full": 0.30, "offload": 0.34,
 }
 
-#: Resident share of an offloaded optimizer shard: the double-buffered
-#: stream (``memory/offload.py``, HOROVOD_OFFLOAD_DEPTH=2) keeps two of
-#: a 16-bucket rotation in HBM at a time.
-OFFLOAD_RESIDENT_FRACTION = 0.125
+#: Resident share of an offloaded optimizer shard **during the step
+#: window**: 1.0 — no high-water credit.  The streaming engine
+#: (``memory/offload.py``) retains the device reference until
+#: ``fetch()`` (the degrade contract) and ``fetch()`` restores the
+#: whole shard to device *before* the step that consumes it, so the
+#: per-step HBM high-water still holds the full shard; the host
+#: round-trip only parks it between steps.  Charging less would let
+#: the budgeted planner call configs feasible that OOM in practice —
+#: ``bench.py --hbm-budget`` validates the offload=True prediction
+#: against the measured high-water to keep this honest.  An engine
+#: that streamed slot *buckets* through the update phase could earn a
+#: fraction < 1 here; until one exists, offload is HBM-neutral in the
+#: roofline and the planner never profits from it.
+OFFLOAD_RESIDENT_FRACTION = 1.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -387,9 +397,12 @@ def plan_memory_bytes(plan: Union[str, Dict], *,
       (``tp·pp·ep·fsdp`` — ``ep`` idealized as sharding every layer,
       ``sp`` replicates parameters);
     * optimizer state is ``optimizer_slots`` × the param shard,
-      further ÷ ``dp`` under the ZeRO sharded exchange, and reduced to
-      :data:`OFFLOAD_RESIDENT_FRACTION` residency when streamed to
-      host (``memory/offload.py``);
+      further ÷ ``dp`` under the ZeRO sharded exchange;
+      ``offload_optimizer`` charges
+      :data:`OFFLOAD_RESIDENT_FRACTION` = 1.0 of it — host streaming
+      parks the shard *between* steps but restores it whole before the
+      step (``memory/offload.py``), so it buys no step-window
+      high-water;
     * activations scale by the policy's residency fraction
       (:data:`REMAT_ACTIVATION_FRACTION`), divide over ``sp`` and the
       microbatch count, and a pipeline holds ``min(pp, m)`` in-flight

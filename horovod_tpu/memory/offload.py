@@ -116,20 +116,28 @@ class HostOffloadEngine:
         """Issue the async D2H copy of ``tree`` under ``tag``.
 
         Keeps the device reference alongside the future — the degrade
-        contract — and applies backpressure at ``depth`` in-flight
-        copies by joining the oldest (its buffers then live on host
-        only, which is the point)."""
+        contract — and applies backpressure at ``depth`` *in-flight*
+        copies by joining the oldest unfinished one.  Completed copies
+        stay in ``_pending`` until ``fetch`` (that's the contract), so
+        only not-yet-done futures count toward the depth limit — a
+        finished transfer costs host RAM, not D2H bandwidth.  A copy
+        that *raised* counts as done too (no over-depth insert sneaks
+        in behind it); the fault surfaces at its own ``fetch`` via the
+        degrade path."""
         if self._closed:
             raise RuntimeError(f"offload engine {self.name!r} is closed")
         if tag in self._pending:
             raise ValueError(f"tag {tag!r} already offloaded — fetch it "
                              "before offloading it again")
-        while len(self._pending) >= self.depth:
-            _, (oldest, _ref) = next(iter(self._pending.items()))
-            try:
-                oldest.result()
-            except Exception:       # noqa: BLE001 — surfaced at fetch()
+        while True:
+            in_flight = [f for f, _ in self._pending.values()
+                         if not f.done()]
+            if len(in_flight) < self.depth:
                 break
+            try:
+                in_flight[0].result()
+            except Exception:       # noqa: BLE001 — surfaced at fetch()
+                pass
         self._pending[tag] = (self._executor.submit(self._d2h, tree),
                               tree)
         self._tel_inflight.labels(engine=self.name).set(

@@ -30,9 +30,18 @@ from horovod_tpu.analysis import cost_model as CM
 
 #: Fractional step-time penalty charged to an offloaded optimizer
 #: stream — the share of the D2H/H2D transfer the double buffer fails
-#: to hide under compute.  Small but nonzero on purpose: offload must
-#: lose speed ties, so the planner only reaches for host RAM when the
-#: budget forces it.
+#: to hide under compute.  Under the honest roofline
+#: (``cost_model.OFFLOAD_RESIDENT_FRACTION`` = 1.0: the engine
+#: restores the full shard before the step, so streaming buys no
+#: step-window high-water) an offload=True point is strictly
+#: dominated — same memory, this penalty slower — and the search
+#: never returns one.  The axis stays in the grid for callers that
+#: force ``offload=(True,)`` (host parking for reasons other than the
+#: step high-water) and for a future bucketed engine whose residency
+#: fraction drops below 1.  A winner with ``offload_optimizer=True``
+#: only streams if the caller also sets HOROVOD_OFFLOAD_OPTIMIZER=1
+#: and wires a :class:`~horovod_tpu.memory.offload.HostOffloadEngine`
+#: into the loop — the plan does not enable the engine by itself.
 OFFLOAD_STEP_PENALTY = 0.02
 
 #: Default microbatch grid — powers of two up to the bench pipeline
@@ -78,9 +87,13 @@ class MemoryCandidate:
         return self.predicted_bytes.total
 
     def summary(self) -> str:
+        # an offload=on winner is only real once the caller enables the
+        # streaming engine — say so wherever the candidate is printed
+        off = "on [needs HOROVOD_OFFLOAD_OPTIMIZER=1]" \
+            if self.offload_optimizer else "off"
         return (f"plan={self.plan} remat={self.remat_policy} "
                 f"microbatches={self.microbatches} "
-                f"offload={'on' if self.offload_optimizer else 'off'} "
+                f"offload={off} "
                 f"-> {self.total_bytes / 1e9:.3f} GB, "
                 f"{self.predicted_step_s * 1e3:.3f} ms/step")
 
@@ -131,6 +144,13 @@ def search_memory_plans(plans: Sequence[Union[str, Dict]], *,
     offload)`` — two runs over the same grid return the same object.
     Raises :class:`InfeasibleError` (naming the tightest axis) when
     nothing fits, and ``ValueError`` on an empty grid.
+
+    A returned candidate with ``offload_optimizer=True`` describes a
+    config that *assumes* optimizer-state streaming: applying it
+    requires HOROVOD_OFFLOAD_OPTIMIZER=1 plus a
+    :class:`~horovod_tpu.memory.offload.HostOffloadEngine` in the
+    training loop (``summary()`` flags this).  The search itself never
+    flips that knob.
     """
     if not plans:
         raise ValueError("search_memory_plans needs at least one plan")

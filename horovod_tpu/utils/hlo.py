@@ -335,10 +335,21 @@ def donated_param_bytes(hlo_text: str) -> int:
     exactly these bytes.  Nested alias indices (a donated tuple
     *element*) contribute the whole parameter — an over-subtraction in
     theory, but XLA flattens jit arguments to leaf parameters, so the
-    index is ``{}`` in every dump this parser meets."""
-    m = re.search(r"input_output_alias=\{(.*)", hlo_text)
+    index is ``{}`` in every dump this parser meets.  The attribute is
+    captured to its balanced closing brace, so a dump that wraps the
+    alias list across lines still counts every entry."""
+    m = re.search(r"input_output_alias=\{", hlo_text)
     if m is None:
         return 0
+    depth, j = 1, m.end()
+    while j < len(hlo_text) and depth:
+        c = hlo_text[j]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+        j += 1
+    attr = hlo_text[m.end():j - 1]
     sizes = {}
     for line in entry_computation(hlo_text).splitlines():
         om = _ANY_OP_RE.match(line)
@@ -348,7 +359,7 @@ def donated_param_bytes(hlo_text: str) -> int:
         if pm is not None:
             sizes[int(pm.group(1))] = result_bytes(om.group(2))
     return sum(sizes.get(int(pnum), 0)
-               for pnum, _pidx in _ALIAS_ENTRY_RE.findall(m.group(1)))
+               for pnum, _pidx in _ALIAS_ENTRY_RE.findall(attr))
 
 
 def memory_high_water(hlo_text: str) -> int:
@@ -369,9 +380,19 @@ def memory_high_water(hlo_text: str) -> int:
         root = next((i for i, ln in enumerate(lines)
                      if ln.lstrip().startswith("ROOT ")), None)
         if root is not None:
-            live = [(name, nbytes - min(nbytes, donated)
-                     if d == root else nbytes, d, last)
-                    for name, nbytes, d, last in live]
+            # credit the donation against the ROOT's own allocation,
+            # exactly once — never per-buffer, which double-subtracts
+            # when another def shares the ROOT line index
+            rm = _ANY_OP_RE.match(lines[root])
+            root_name = rm.group(1) if rm is not None else None
+            credited, fixed = False, []
+            for name, nbytes, d, last in live:
+                if not credited and d == root and \
+                        (root_name is None or name == root_name):
+                    nbytes -= min(nbytes, donated)
+                    credited = True
+                fixed.append((name, nbytes, d, last))
+            live = fixed
     n = max(last for _, _, _, last in live) + 1
     alloc = [0] * n
     free = [0] * n

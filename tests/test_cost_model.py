@@ -180,6 +180,15 @@ class TestDonationAccounting:
         assert H.memory_high_water(undonated) == 4096
         assert H.memory_high_water(DONATED_MODULE) == 3072
 
+    def test_wrapped_alias_attribute_counts_every_entry(self):
+        """A dump that wraps the alias list across lines (long module
+        headers do) must still count every donated entry — the capture
+        runs to the balanced closing brace, not end-of-line."""
+        wrapped = DONATED_MODULE.replace(
+            "may-alias), {1}:", "may-alias),\n  {1}:")
+        assert H.donated_param_bytes(wrapped) == 2048
+        assert H.memory_high_water(wrapped) == 3072
+
     def test_missing_alias_header_is_a_no_op(self):
         assert H.donated_param_bytes(FUSION_MODULE) == 0
 

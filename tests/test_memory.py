@@ -112,6 +112,47 @@ class TestHostOffloadEngine:
                 engine.offload(0, t)
             engine.fetch(0, t)
 
+    def test_backpressure_past_depth_does_not_hang(self):
+        """More outstanding tags than ``depth`` before any fetch: the
+        backpressure loop must count only not-yet-done copies (a
+        completed D2H stays in the pending map until its fetch — the
+        degrade contract), not spin on the oldest entry forever."""
+        import threading
+
+        engine = HostOffloadEngine(name="t", depth=2)
+        trees = [tree(seed=i) for i in range(5)]   # 2×depth + 1
+        done = threading.Event()
+
+        def work():
+            for i, t in enumerate(trees):
+                engine.offload(i, t)
+            done.set()
+
+        threading.Thread(target=work, daemon=True).start()
+        assert done.wait(timeout=30), \
+            "offload() hung with depth+1 outstanding tags"
+        for i, t in enumerate(trees):
+            assert_bit_exact(t, engine.fetch(i, t))
+        assert engine.fallbacks == 0
+        engine.close()
+
+    def test_backpressure_with_faulted_copy_neither_hangs_nor_leaks(self):
+        """A D2H that raised is *done*: it stops counting toward the
+        depth limit (no spin, no silent over-depth insert) and its
+        fault surfaces at that tag's own fetch as a counted degrade."""
+        faults.set_plan(FaultPlan().add("offload.d2h", "raise",
+                                        "OSError", at=1))
+        with HostOffloadEngine(name="t", depth=1) as engine:
+            t0, t1, t2 = tree(0), tree(1), tree(2)
+            engine.offload(0, t0)          # this D2H raises
+            engine.offload(1, t1)          # must pass the backpressure
+            engine.offload(2, t2)
+            assert engine.fetch(0, t0) is t0   # the retained reference
+            assert engine.fallbacks == 1
+            assert_bit_exact(t1, engine.fetch(1, t1))
+            assert_bit_exact(t2, engine.fetch(2, t2))
+            assert engine.fallbacks == 1
+
     def test_close_idempotent_and_refuses_new_work(self):
         engine = HostOffloadEngine(name="t")
         engine.close()
