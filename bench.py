@@ -745,6 +745,135 @@ def run_vit(args, hvd):
     }
 
 
+def _moe_capacity_factor(args):
+    """--moe-capacity-factor, falling back to HOROVOD_MOE_CAPACITY_FACTOR
+    then the Switch default 1.25."""
+    cf = getattr(args, "moe_capacity_factor", None)
+    if cf is None:
+        env_cf = os.environ.get("HOROVOD_MOE_CAPACITY_FACTOR")
+        cf = float(env_cf) if env_cf else 1.25
+    return float(cf)
+
+
+def _moe_ep_extent(args, hvd):
+    """The ep extent of this run — the --plan's ep axis when one is
+    given (the expert-parallel execution shape), else 1 (local
+    experts).  A perf-gate comparability key: runs at different ep
+    extents measure different dispatch schedules."""
+    if getattr(args, "plan", None):
+        from horovod_tpu.parallel import ShardingPlan
+
+        return ShardingPlan.from_string(args.plan).resolve(hvd.size()).ep
+    return 1
+
+
+def _moe_fused_twin(args, hvd, cfg):
+    """``--moe-fused``: the fused/unfused expert-dispatch twin probe.
+
+    Runs the SAME routed SwitchFFN (same params, same tokens, seeded)
+    over an ep ring spanning every device twice — once through the
+    tile-fused ``a2a ⊗ expert-matmul`` ppermute ring, once through the
+    boundary-wide ``all_to_all`` formulation — asserts drop-fraction
+    parity (the fused schedule must not change which tokens fit), and
+    emits the measured per-call seconds of each schedule plus the
+    structural fields HLO006 judges: ``moe_serial_tail_alltoalls``
+    (all-to-all start..done windows with no compute, scanned from the
+    fused program — must be 0) and the cost-model
+    ``moe_ep_wire_bytes``.  Every non-timing field is deterministic
+    across two runs (seeded params/tokens, structural counts)."""
+    import dataclasses as _dc
+
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu import telemetry
+    from horovod_tpu.analysis.cost_model import moe_dispatch_wire_bytes
+    from horovod_tpu.models.moe import SwitchFFN
+    from horovod_tpu.ops.pallas_kernels import resolve_fused_collectives
+    from horovod_tpu.parallel.mesh import make_parallel_mesh
+    from horovod_tpu.utils import hlo as H
+
+    devices = jax.devices()
+    experts = cfg.num_experts
+    ep = len(devices)
+    while experts % ep:        # ep must divide the expert count
+        ep -= 1
+    resolved = "on" if resolve_fused_collectives(args.moe_fused) \
+        else "off"
+    d = cfg.d_model
+    seq = min(128, cfg.max_seq_len)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.standard_normal((ep, seq, d)), jnp.float32)
+
+    local = SwitchFFN(_dc.replace(cfg, ep_axis=None))
+    variables = local.init(jax.random.PRNGKey(1), x[:1])
+    params = variables["params"]
+    mesh = make_parallel_mesh(ep=ep, devices=devices[:ep])
+
+    def make(mode):
+        ffn = SwitchFFN(_dc.replace(cfg, ep_axis="ep",
+                                    fused_dispatch=mode))
+
+        def run(p, xs):
+            y, state = ffn.apply({"params": p}, xs,
+                                 mutable=["intermediates"])
+            return y, state["intermediates"]["moe_drop_fraction"][0][None]
+
+        return jax.jit(jax.shard_map(
+            run, mesh=mesh, in_specs=(P(), P("ep")),
+            out_specs=(P("ep"), P("ep")), check_vma=False))
+
+    def timed(fn):
+        y, drop = fn(params, x)          # compile + warm
+        jax.block_until_ready(y)
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            y, drop = fn(params, x)
+            jax.block_until_ready(y)
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts)), np.asarray(y), float(
+            np.asarray(drop).mean())
+
+    fused_fn, unfused_fn = make("on"), make("off")
+    fused_s, y_fused, drop_fused = timed(fused_fn)
+    unfused_s, y_unfused, drop_unfused = timed(unfused_fn)
+    if drop_fused != drop_unfused:
+        raise SystemExit(
+            f"bench[moe]: fused dispatch changed the drop fraction "
+            f"({drop_fused} vs {drop_unfused}) — the ring schedule "
+            f"must route identically to the alltoall formulation")
+    if not np.allclose(y_fused, y_unfused, rtol=2e-4, atol=2e-4):
+        raise SystemExit(
+            "bench[moe]: fused dispatch diverged from the unfused "
+            "formulation beyond tolerance")
+
+    text = fused_fn.lower(params, x).compile().as_text()
+    serial_a2a = H.serial_tail_collectives(text, kinds=("all-to-all",))
+    a2a_lines = sum("all-to-all" in ln for ln in text.splitlines())
+    tokens = seq                        # per-shard tokens per dispatch
+    elem_bits = 16 if cfg.dtype == jnp.bfloat16 else 32
+    wire = moe_dispatch_wire_bytes(
+        tokens, d, experts, ep, capacity_factor=cfg.capacity_factor,
+        elem_bits=elem_bits)
+    telemetry.gauge(
+        "hvd_moe_ep_wire_bytes",
+        "per-chip ep-ring wire bytes of one dispatch+combine").set(wire)
+    log(f"bench[moe]: fused twin over ep={ep} — fused {fused_s:.4f}s "
+        f"vs unfused {unfused_s:.4f}s per call, drop {drop_fused:.3f} "
+        f"(parity ok), serial tail alltoalls {serial_a2a}, "
+        f"fused-program all-to-all lines {a2a_lines}")
+    return {
+        "moe_fused_collectives": resolved,
+        "moe_dispatch_s": round(fused_s, 6),
+        "moe_dispatch_unfused_s": round(unfused_s, 6),
+        "moe_tail_s": round(max(0.0, unfused_s - fused_s), 6),
+        "moe_dispatch_drop_fraction": round(drop_fused, 4),
+        "moe_serial_tail_alltoalls": serial_a2a,
+        "moe_fused_alltoall_lines": a2a_lines,
+        "moe_ep_wire_bytes": wire,
+    }
+
+
 def run_moe(args, hvd):
     """Opt-in (--model moe) fourth benchmark family: Switch-MoE LM.
 
@@ -766,17 +895,18 @@ def run_moe(args, hvd):
             args.tf_seq_len, args.moe_batch_size, jnp.bfloat16,
             args.moe_experts)
     spc = args.steps_per_call if platform == "tpu" else 1
+    cf = _moe_capacity_factor(args)
     log(f"bench[moe]: {n_chips} chip(s) on {platform}, "
         f"{layers}L/{d_model}d/{heads}h, {experts} experts "
         f"(moe_every 2), seq {seq}, batch {batch}/chip, "
-        f"steps_per_call {spc}")
+        f"cf {cf}, steps_per_call {spc}")
 
     cfg = MoEConfig(
         vocab_size=32_000, num_layers=layers, num_heads=heads,
         d_model=d_model, d_ff=4 * d_model, max_seq_len=seq, dtype=dtype,
         attention_impl="flash" if platform == "tpu" else "dense",
         flash_block=args.tf_flash_block, num_experts=experts,
-        capacity_factor=1.25, moe_every=2)
+        capacity_factor=cf, moe_every=2)
     model = MoETransformerLM(cfg)
 
     def loss_fn(params, batch):
@@ -788,7 +918,9 @@ def run_moe(args, hvd):
 
     step = hvd.DistributedTrainStep(
         loss_fn, optax.adamw(3e-4), steps_per_call=spc,
-        compiler_options=tpu_compiler_options(args))
+        compiler_options=tpu_compiler_options(args),
+        moe_fused=getattr(args, "moe_fused", None),
+        moe_capacity_factor=cf)
     tokens0 = jnp.zeros((1, seq), jnp.int32)
     variables = jax.jit(model.init)(jax.random.PRNGKey(0), tokens0)
     leaves = jax.tree_util.tree_flatten_with_path(variables["params"])[0]
@@ -855,10 +987,19 @@ def run_moe(args, hvd):
         f"{drop_fraction:.3f} (init {drop_init:.3f}), per-expert "
         f"shares {util} (uniform = {1.0 / experts:.3f})")
 
+    from horovod_tpu import telemetry
+    telemetry.gauge(
+        "hvd_moe_drop_fraction",
+        "post-warmup MoE token drop fraction").set(drop_fraction)
+    telemetry.gauge(
+        "hvd_moe_expert_utilization",
+        "minimum per-expert routed-token share").set(
+            min(util) if util else 0.0)
+
     flops_per_token = 6 * active + 6 * layers * seq * d_model
     peak = hw_peak_flops()
     tf_s = tokens_per_chip_sec * flops_per_token
-    return {
+    out = {
         "moe_tokens_per_sec": round(tokens_per_chip_sec, 1),
         "moe_mfu": round(tf_s / peak, 4) if peak else None,
         "moe_active_tflops_per_sec": round(tf_s / 1e12, 1),
@@ -868,7 +1009,14 @@ def run_moe(args, hvd):
         "moe_drop_fraction_init": round(drop_init, 4),
         "moe_expert_utilization": util,
         "moe_expert_util_min": min(util) if util else None,
+        # perf-gate comparability keys: a routing-config change is a
+        # schedule change, never diffed as a regression
+        "moe_capacity_factor": cf,
+        "moe_ep": _moe_ep_extent(args, hvd),
     }
+    if getattr(args, "moe_fused", None):
+        out.update(_moe_fused_twin(args, hvd, cfg))
+    return out
 
 
 def run_chaos(args, hvd):
@@ -1310,10 +1458,11 @@ def run_autotune(args, hvd):
 
     from horovod_tpu.utils.bench_autotune import ThroughputAutotuner
 
-    if args.model not in ("resnet", "transformer"):
+    if args.model not in ("resnet", "transformer", "moe"):
         raise SystemExit(
             "--autotune tunes one model's knobs per run; pass "
-            "--model resnet or --model transformer explicitly")
+            "--model resnet, --model transformer or --model moe "
+            "explicitly")
     model = args.model
     # short measurement windows: relative ranking needs ~2x2 timed
     # calls per point, not the full bench's 5x5
@@ -1347,6 +1496,11 @@ def run_autotune(args, hvd):
             # the sharding-plan compiler's search axis, pruned by
             # plan_cost_s like the other exchange knobs
             exchange_axes["plan"] = plans
+    if args.model == "moe":
+        # run_moe never threads the exchange knobs into its step —
+        # racing them would sample noise, so the moe grid is the
+        # routing axes only
+        exchange_axes = {}
 
     def apply_exchange_point(a, point):
         if exchange_axes:
@@ -1386,6 +1540,27 @@ def run_autotune(args, hvd):
             point, payload, n_dcn=n_dcn, n_ici=n_ici,
             compute_s=compute_s)
 
+    def moe_predictor():
+        """Routing-axis scorer (analysis/cost_model.py): prices each
+        capacity_factor / tokens_per_expert sample by predicted expert
+        compute + exposed dispatch seconds so the tuner prunes the
+        grid before anything races.  Shapes mirror what run_moe will
+        actually measure on this platform (CPU pins a tiny twin)."""
+        from horovod_tpu.analysis.cost_model import score_moe_schedule
+
+        if jax.devices()[0].platform == "cpu":
+            tokens, d, d_ff, experts = 4 * 128, 128, 512, 4
+        else:
+            tokens = args.moe_batch_size * args.tf_seq_len
+            d, d_ff = args.moe_d_model, 4 * args.moe_d_model
+            experts = args.moe_experts
+        # ep=1: the bench twin's experts are chip-local; the wire term
+        # activates when a --plan with an ep extent is under test
+        ep = _moe_ep_extent(args, hvd)
+        return lambda point: score_moe_schedule(
+            point, tokens=tokens, d_model=d, d_ff=d_ff,
+            num_experts=experts, ep=ep, fused=True)
+
     def hbm_feasible():
         """Hard HBM-budget gate for the autotuner (docs/memory.md):
         under HOROVOD_HBM_BUDGET_BYTES every candidate is priced by
@@ -1400,6 +1575,45 @@ def run_autotune(args, hvd):
             plan_memory_bytes,
         )
 
+        default_plan = f"dp={hvd.size()}"
+        if model == "moe":
+            from horovod_tpu.analysis.cost_model import moe_capacity
+
+            d, layers, experts = (args.moe_d_model, args.moe_layers,
+                                  args.moe_experts)
+            # dense trunk (attention + embeddings + the dense-FFN half
+            # of the blocks); expert FFNs priced separately so the
+            # budget sees them divide across a plan's ep extent, and
+            # the (E, C, d) dispatch+combine buffers grow with the
+            # sampled capacity
+            param_bytes = 4.0 * (8 * layers * d * d + 32_000 * d)
+            expert_bytes = 4.0 * (layers // 2) * experts * 8.0 * d * d
+            act_bytes = 4.0 * args.moe_batch_size * args.tf_seq_len \
+                * d * layers * 14.0
+            tokens = args.moe_batch_size * args.tf_seq_len
+
+            def moe_fits(point):
+                tpe = point.get("tokens_per_expert")
+                if tpe is not None:
+                    slack = float(point.get("capacity_factor") or 1.0)
+                    cap = max(1, int(-(-slack * int(tpe) // 1)))
+                else:
+                    cap = moe_capacity(
+                        tokens, experts,
+                        float(point.get("capacity_factor") or 1.25))
+                buf = 2.0 * experts * cap * d * 4.0
+                return plan_fits(
+                    plan_memory_bytes(
+                        point.get("plan", default_plan),
+                        param_bytes=param_bytes,
+                        activation_bytes=act_bytes,
+                        shard_optimizer_states=(
+                            args.shard_optimizer_states),
+                        expert_param_bytes=expert_bytes,
+                        moe_capacity_buffer_bytes=buf),
+                    budget)
+
+            return moe_fits
         if model == "transformer":
             d, layers = args.tf_d_model, args.tf_layers
             param_bytes = 4.0 * (12 * layers * d * d + 32_000 * d)
@@ -1408,7 +1622,6 @@ def run_autotune(args, hvd):
         else:
             param_bytes = 4.0 * 25.6e6
             act_bytes = 4.0 * args.batch_size * 16.8e6
-        default_plan = f"dp={hvd.size()}"
         return lambda point: plan_fits(
             plan_memory_bytes(
                 point.get("plan", default_plan),
@@ -1438,13 +1651,33 @@ def run_autotune(args, hvd):
             a.steps_per_call = point["steps_per_call"]
             apply_exchange_point(a, point)
             return run_resnet(a, hvd)["value"]
+    elif model == "moe":
+        # routing axes: capacity_factor trades drop fraction against
+        # expert FLOPs + dispatch wire; tokens_per_expert scales the
+        # nominal per-expert workload through the batch size.  Both
+        # are cost-model-priced (moe_predictor) before anything races.
+        experts, seq = args.moe_experts, args.tf_seq_len
+        axes = {"steps_per_call": [1, 5, 10, 20, 40],
+                "capacity_factor": [0.5, 1.0, 1.25, 1.5, 2.0],
+                "tokens_per_expert": [32, 64, 128]}
+
+        def measure(point):
+            a = copy.copy(base)
+            a.steps_per_call = point["steps_per_call"]
+            a.moe_capacity_factor = point["capacity_factor"]
+            a.moe_batch_size = max(1, round(
+                point["tokens_per_expert"] * experts / seq))
+            a.moe_fused = None      # no twin probe inside the race
+            return run_moe(a, hvd)["moe_tokens_per_sec"]
     else:
-        raise SystemExit(f"--autotune supports resnet/transformer, "
-                         f"not {model}")
+        raise SystemExit(f"--autotune supports resnet/transformer/"
+                         f"moe, not {model}")
 
     log_path = args.autotune_log or f"autotune_{model}.csv"
     tuner = ThroughputAutotuner(measure, axes, log_path=log_path,
-                                predict=exchange_predictor(),
+                                predict=(moe_predictor()
+                                         if model == "moe"
+                                         else exchange_predictor()),
                                 feasible=hbm_feasible())
     best, rate = tuner.run()
     return {"metric": f"autotune_{model}", "value": round(rate, 1),
@@ -1886,6 +2119,16 @@ def main():
                    help="MoE per-chip batch size (--model moe only; "
                         "measured knee — 4: 41.6%%, 8: 49.4%%, "
                         "16: 50.3%%, 32: 40.7%% MFU)")
+    p.add_argument("--moe-fused", default=None,
+                   choices=["auto", "on", "off"],
+                   help="run the fused/unfused expert-dispatch twin "
+                        "probe and emit its fields into BENCH JSON "
+                        "(docs/fused_kernels.md); also stamps the "
+                        "resolved mode into the step's AOT key")
+    p.add_argument("--moe-capacity-factor", type=float, default=None,
+                   help="Switch capacity factor (default: "
+                        "HOROVOD_MOE_CAPACITY_FACTOR, then 1.25); a "
+                        "perf-gate comparability key")
     p.add_argument("--vit-heads", type=int, default=12,
                    help="ViT heads: 12 = standard ViT-B head_dim 64; "
                         "6 = TPU-shaped head_dim 128 (MXU lane width)")

@@ -352,18 +352,29 @@ class MemoryBytes:
     """Per-device HBM high-water decomposition of one plan — the four
     components the budget trades against each other, plus the exchange
     staging.  ``tightest`` names the dominant component, the axis an
-    infeasibility error points at (``memory/planner.py``)."""
+    infeasibility error points at (``memory/planner.py``).
+
+    MoE plans add two components (both 0.0 for dense models):
+    ``expert_params`` — the per-device expert-parameter shard (their
+    grads/optimizer slots fold into ``grads``/``optimizer``) — and
+    ``moe_buffers``, the static ``(E, C, d)`` dispatch + combine
+    capacity buckets, which are ``ep``-invariant per device (each chip
+    always stages ``E·C·d`` slots: all experts' slots before the
+    exchange, or ``ep`` source tiles of its ``E/ep`` experts after)."""
 
     params: float
     grads: float
     optimizer: float
     activations: float
     exchange: float
+    expert_params: float = 0.0
+    moe_buffers: float = 0.0
 
     @property
     def total(self) -> float:
         return (self.params + self.grads + self.optimizer
-                + self.activations + self.exchange)
+                + self.activations + self.exchange
+                + self.expert_params + self.moe_buffers)
 
     @property
     def tightest(self) -> str:
@@ -381,7 +392,9 @@ def plan_memory_bytes(plan: Union[str, Dict], *,
                       optimizer_slots: int = 2,
                       shard_optimizer_states: bool = False,
                       offload_optimizer: bool = False,
-                      exchange_bucket_bytes: Optional[float] = None
+                      exchange_bucket_bytes: Optional[float] = None,
+                      expert_param_bytes: float = 0.0,
+                      moe_capacity_buffer_bytes: float = 0.0
                       ) -> MemoryBytes:
     """Predicted per-device HBM high-water of one plan — the memory
     twin of :func:`plan_cost_s`, and the quantity the feasibility
@@ -410,7 +423,14 @@ def plan_memory_bytes(plan: Union[str, Dict], *,
       state);
     * exchange staging is the double-buffered bucket pair when the
       bucketed exchange is on, else one grad-shard-sized fused buffer
-      whenever a data axis exists.
+      whenever a data axis exists;
+    * ``expert_param_bytes`` (MoE plans: the expert FFN weights, which
+      ``ep`` *actually* shards — pass the dense remainder as
+      ``param_bytes``) divides over the same ``tp·pp·ep·fsdp`` axes,
+      with grads and optimizer slots folded into those components;
+      ``moe_capacity_buffer_bytes`` (the static dispatch + combine
+      ``(E, C, d)`` buckets, already per-device and ``ep``-invariant:
+      ``2·E·C·d·elem_bytes``) is charged as-is.
 
     Validated against ``utils/hlo.memory_high_water`` on compiled
     CPU-twin dumps by ``bench.py --hbm-budget`` (within 25%;
@@ -424,8 +444,9 @@ def plan_memory_bytes(plan: Union[str, Dict], *,
     microbatches = max(1, int(microbatches))
     param_shard_axes = ext["tp"] * ext["pp"] * ext["ep"] * ext["fsdp"]
     params = float(param_bytes) / param_shard_axes
-    grads = params
-    optimizer = max(0, int(optimizer_slots)) * params
+    expert_params = float(expert_param_bytes) / param_shard_axes
+    grads = params + expert_params
+    optimizer = max(0, int(optimizer_slots)) * (params + expert_params)
     if shard_optimizer_states:
         optimizer /= ext["dp"]
     if offload_optimizer:
@@ -441,7 +462,9 @@ def plan_memory_bytes(plan: Union[str, Dict], *,
     else:
         exchange = grads if data_world > 1 else 0.0
     return MemoryBytes(params=params, grads=grads, optimizer=optimizer,
-                       activations=activations, exchange=exchange)
+                       activations=activations, exchange=exchange,
+                       expert_params=expert_params,
+                       moe_buffers=float(moe_capacity_buffer_bytes))
 
 
 def plan_fits(mem: Union[MemoryBytes, float],
@@ -518,6 +541,120 @@ def score_exchange_schedule(point: Dict,
     if fused == "on":
         return -fused_tail_exchange_s(serial, compute_s, n_tiles)
     return -serial
+
+
+# -- MoE expert-dispatch pricing --------------------------------------------
+
+
+def moe_capacity(tokens: int, num_experts: int,
+                 capacity_factor: float = 1.25) -> int:
+    """Per-expert capacity bucket, ``max(1, ceil(cf·tokens/E))`` —
+    mirrors ``parallel/expert.expert_parallel_ffn`` by value (this
+    module stays stdlib-only, like :data:`PLAN_GRAMMAR_KEYS`)."""
+    tokens, num_experts = max(1, int(tokens)), max(1, int(num_experts))
+    return int(max(1, -(-float(capacity_factor) * tokens
+                        // num_experts)))
+
+
+def moe_dispatch_wire_bytes(tokens: int, d_model: int, num_experts: int,
+                            ep: int, capacity_factor: float = 1.25,
+                            elem_bits: int = 32,
+                            capacity: Optional[int] = None) -> float:
+    """Per-chip wire bytes of one MoE dispatch + combine exchange.
+
+    Each of the ``ep−1`` ring hops moves one ``(E/ep, C, d)`` source
+    tile, in both directions (route → expert, expert output → origin):
+    ``2·(ep−1)·(E/ep)·C·d·elem_bytes``.  The boundary-wide
+    ``all_to_all`` moves exactly the same bytes (each chip ships
+    ``ep−1`` of its ``ep`` tiles, twice) — the fused ring changes the
+    *exposure* (:func:`moe_dispatch_exposed_s`), never the volume, so
+    this is the honest ``hvd_moe_ep_wire_bytes`` gauge for both
+    schedules.  ``tokens`` is the per-chip token count; ``ep <= 1``
+    prices 0 (local experts, nothing crosses the wire)."""
+    ep = max(1, int(ep))
+    if ep == 1:
+        return 0.0
+    if capacity is None:
+        capacity = moe_capacity(tokens, num_experts, capacity_factor)
+    e_local = max(1, int(num_experts) // ep)
+    tile = e_local * int(capacity) * int(d_model) * (elem_bits / 8.0)
+    return 2.0 * (ep - 1) * tile
+
+
+def moe_expert_compute_s(tokens: int, d_model: int, d_ff: int,
+                         num_experts: int, ep: int,
+                         capacity_factor: float = 1.25,
+                         hw: HardwareModel = V5E,
+                         capacity: Optional[int] = None) -> float:
+    """Per-chip expert-FFN forward seconds: ``E/ep`` local experts each
+    process up to ``ep·C`` routed slots through the two ``d×d_ff``
+    matmuls (``4·d·d_ff`` FLOPs per slot).  The compute the fused ring
+    hides hops under — and the term that grows linearly with the
+    ``capacity_factor`` autotune axis."""
+    ep = max(1, int(ep))
+    if capacity is None:
+        capacity = moe_capacity(tokens, num_experts, capacity_factor)
+    e_local = max(1, int(num_experts) // ep)
+    flops = e_local * ep * int(capacity) * 4.0 * int(d_model) * int(d_ff)
+    return flops / hw.peak_flops_per_s
+
+
+def moe_dispatch_exposed_s(wire_s: float, compute_s: float, ep: int,
+                           fused: bool = True) -> float:
+    """Exposed (un-overlapped) seconds of the dispatch + combine
+    exchange: the fused ``a2a ⊗ expert-matmul`` ring streams one tile
+    per hop while the previous tile's expert matmul computes, so the
+    serial-tail credit is exactly :func:`fused_tail_exchange_s` with
+    the ring's ``ep`` tiles; unfused, the whole boundary-wide
+    ``all_to_all`` wire is exposed (nothing overlaps it)."""
+    if not fused:
+        return max(0.0, float(wire_s))
+    return fused_tail_exchange_s(wire_s, compute_s,
+                                 n_tiles=max(1, int(ep)))
+
+
+def score_moe_schedule(point: Dict, *,
+                       tokens: int,
+                       d_model: int,
+                       d_ff: int,
+                       num_experts: int,
+                       ep: int = 1,
+                       fused: bool = True,
+                       hw: HardwareModel = V5E,
+                       elem_bits: int = 32) -> Optional[float]:
+    """Rank one MoE autotune sample point (``{"capacity_factor": ...}``
+    and/or ``{"tokens_per_expert": ...}``) by its predicted per-step
+    MoE seconds, negated — the ``bench --autotune`` pruning twin of
+    :func:`score_exchange_schedule` for the routing axes.
+    ``tokens_per_expert`` sets the nominal per-expert workload (scaled
+    by ``capacity_factor`` slack when both are sampled);
+    ``capacity_factor`` alone derives it via :func:`moe_capacity`.
+    Returns
+    ``None`` when the point carries neither knob (the ``predict=``
+    contract: a predictor that cannot rank must not narrow the
+    grid)."""
+    cf = point.get("capacity_factor")
+    tpe = point.get("tokens_per_expert")
+    if cf is None and tpe is None:
+        return None
+    if tpe is not None:
+        # cf composes with tpe when both knobs land in one point: tpe
+        # is the nominal per-expert workload, cf the slack multiplier —
+        # pinning capacity to tpe alone would score a cf scan flat and
+        # prune nothing
+        slack = float(cf) if cf is not None else 1.0
+        capacity = int(max(1, -(-slack * int(tpe) // 1)))
+    else:
+        capacity = moe_capacity(tokens, num_experts, float(cf))
+    wire_bytes = moe_dispatch_wire_bytes(
+        tokens, d_model, num_experts, ep, elem_bits=elem_bits,
+        capacity=capacity)
+    wire_s = wire_bytes / hw.ici_bytes_per_s
+    compute_s = moe_expert_compute_s(
+        tokens, d_model, d_ff, num_experts, ep, hw=hw,
+        capacity=capacity)
+    exposed = moe_dispatch_exposed_s(wire_s, compute_s, ep, fused=fused)
+    return -(compute_s + exposed)
 
 
 def _op_wire_bytes(op: H.CollectiveOp, world: int) -> float:

@@ -30,6 +30,12 @@ HLO005     serial exchange tail: the final RS/AG start..done pair has
            claims ``fused_collectives=on`` yet still reports a serial
            tail — the exposure the tile-fused exchange exists to
            remove (docs/fused_kernels.md)
+HLO006     serial boundary-wide MoE dispatch: an ``all-to-all``
+           start..done window with no compute inside it (HLO text), or
+           an artifact claiming the fused expert dispatch is on for an
+           ``ep>1`` plan yet still reporting serial all-to-alls — the
+           a2a ⊗ expert-matmul ring's mirror of HLO005
+           (docs/fused_kernels.md "Expert-parallel dispatch")
 =========  ==============================================================
 """
 
@@ -93,7 +99,7 @@ def lint_hlo_text(text: str,
 
     # HLO002 — every -start must close with a -done
     for kind in ("all-reduce", "reduce-scatter", "all-gather",
-                 "collective-permute"):
+                 "collective-permute", "all-to-all"):
         starts = text.count(f"{kind}-start(")
         dones = text.count(f"{kind}-done(")
         if starts != dones:
@@ -143,6 +149,18 @@ def lint_hlo_text(text: str,
             "start..done pair has no compute scheduled between it — "
             "the last bucket's wire is fully exposed (enable "
             "fused_collectives, docs/fused_kernels.md)"))
+
+    # HLO006 — serial boundary-wide MoE dispatch: an all-to-all whose
+    # start..done window holds no compute is the exposure the fused
+    # a2a ⊗ expert-matmul ring removes (same judgment rule as HLO005,
+    # pointed at the expert-dispatch collective)
+    if H.serial_tail_collectives(text, kinds=("all-to-all",)):
+        findings.append(HloFinding(
+            "HLO006",
+            "serial MoE dispatch: the final all-to-all start..done "
+            "window has no compute scheduled inside it — the expert "
+            "exchange is fully exposed (enable the fused a2a ⊗ "
+            "expert-matmul dispatch, docs/fused_kernels.md)"))
     return findings
 
 
@@ -207,6 +225,23 @@ def lint_artifact(artifact: Dict) -> List[HloFinding]:
                 f"[{label}] fused_collectives=on but the probe still "
                 f"found {serial} serial final RS/AG pair(s) — the "
                 f"tile-fused exchange is not reaching the wire"))
+        # HLO006 — an ep>1 run that claims the fused expert dispatch
+        # is ON must not still report serial boundary-wide all-to-alls
+        # (legacy artifacts without the fields pass vacuously; ep<=1
+        # or fused off is the expected unfused/local schedule)
+        moe_serial = artifact.get(
+            f"{prefix}moe_serial_tail_alltoalls")
+        moe_fused = artifact.get(f"{prefix}moe_fused_collectives")
+        moe_ep = artifact.get(f"{prefix}moe_ep")
+        if moe_fused == "on" and moe_ep and int(moe_ep) > 1 \
+                and moe_serial:
+            findings.append(HloFinding(
+                "HLO006",
+                f"[{label}] moe_fused_collectives=on for an "
+                f"ep={moe_ep} plan but the probe still found "
+                f"{moe_serial} serial boundary-wide all-to-all(s) — "
+                f"the a2a ⊗ expert-matmul ring is not reaching the "
+                f"wire"))
     return findings
 
 

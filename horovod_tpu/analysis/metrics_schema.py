@@ -110,6 +110,19 @@ MEMORY_SERIES = frozenset({
     "hvd_memory_plan_bytes",
 })
 
+# the MoE expert-dispatch plane's closed series vocabulary
+# (docs/fused_kernels.md "Expert-parallel dispatch", docs/moe.md):
+# routing quality (drop fraction, per-expert utilization) and the
+# ep-ring wire gauge in the hvd_moe_* namespace.  The fused-launch
+# counter lives in the hvd_pallas namespace
+# (hvd_pallas_fused_launches_total{kernel="a2a_matmul"}) and is open
+# by design — new fused kernels add label values, not series.
+MOE_SERIES = frozenset({
+    "hvd_moe_drop_fraction",
+    "hvd_moe_expert_utilization",
+    "hvd_moe_ep_wire_bytes",
+})
+
 
 def _check_guard_series(errors: List[str], obj, field: str) -> None:
     if not isinstance(obj, dict):
@@ -169,6 +182,18 @@ def _check_memory_series(errors: List[str], obj, field: str) -> None:
                 errors.append(
                     f"{field}[{k!r}]: unknown memory series {base!r} — "
                     f"not in metrics_schema.MEMORY_SERIES")
+
+
+def _check_moe_series(errors: List[str], obj, field: str) -> None:
+    if not isinstance(obj, dict):
+        return      # shape error already reported by _check_series_map
+    for k in obj:
+        if isinstance(k, str) and k.startswith("hvd_moe"):
+            base = k.split("{", 1)[0]
+            if base not in MOE_SERIES:
+                errors.append(
+                    f"{field}[{k!r}]: unknown moe series {base!r} — "
+                    f"not in metrics_schema.MOE_SERIES")
 
 
 def _check_series_map(errors: List[str], obj, field: str) -> None:
@@ -252,6 +277,9 @@ def validate_snapshot(obj: Dict) -> List[str]:
     _check_memory_series(errors, obj.get("counters", {}), "counters")
     _check_memory_series(errors, obj.get("gauges", {}), "gauges")
     _check_memory_series(errors, obj.get("histograms", {}), "histograms")
+    _check_moe_series(errors, obj.get("counters", {}), "counters")
+    _check_moe_series(errors, obj.get("gauges", {}), "gauges")
+    _check_moe_series(errors, obj.get("histograms", {}), "histograms")
     return errors
 
 
@@ -270,6 +298,7 @@ def validate_bench_metrics(obj: Dict) -> List[str]:
     _check_elastic_series(errors, obj.get("counters", {}), "metrics.counters")
     _check_degrade_series(errors, obj.get("counters", {}), "metrics.counters")
     _check_memory_series(errors, obj.get("counters", {}), "metrics.counters")
+    _check_moe_series(errors, obj.get("counters", {}), "metrics.counters")
     return errors
 
 

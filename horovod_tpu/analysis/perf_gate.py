@@ -80,7 +80,11 @@ _V1_IDENTITY = ("platform", "device_kind", "n_devices", "mesh_shape")
 THROUGHPUT_FIELDS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("value", ("metric", "plan")),
     ("transformer_tokens_per_sec", ("transformer_params_m", "plan")),
-    ("moe_tokens_per_sec", ("moe_params_m", "plan")),
+    # routing config guards the MoE diff: a capacity-factor or ep-extent
+    # change is a schedule change (different dispatch geometry + drop
+    # behavior), never a throughput regression
+    ("moe_tokens_per_sec",
+     ("moe_params_m", "plan", "moe_capacity_factor", "moe_ep")),
     ("vit_img_sec_per_chip", ("vit_params_m", "plan")),
     ("serve_throughput_rps", ("serve_offered_rps", "plan")),
 )

@@ -194,6 +194,15 @@ class DegradedPlanResolver:
             axes = ", ".join(f"{ax}={getattr(self.base, ax)}"
                              for ax in self.base.model_axes) or "dp=1"
             _TEL_WAITS.inc()
+            # ep is stateful in a way tp/sp are not: each ep rank holds
+            # DISTINCT expert parameters, so a world below the expert
+            # extent has no rank set that can host every expert — name
+            # the axis so the operator knows which capacity to restore
+            hint = ""
+            if self.base.ep > 1:
+                hint = (f" (ep={self.base.ep}: the survivors cannot "
+                        f"host every expert shard — expert state is "
+                        f"only reshardable across the data axes)")
             return DegradeDecision(
                 action="wait", plan=None, cost_s=float("inf"),
                 reason=(
@@ -201,7 +210,8 @@ class DegradedPlanResolver:
                     f"load-bearing model extent "
                     f"{self.base.model_extent} ({axes}) at data extent "
                     f">= {self.min_data_extent} — waiting up to "
-                    f"{self.wait_s:.0f}s for capacity to return"),
+                    f"{self.wait_s:.0f}s for capacity to return"
+                    f"{hint}"),
                 wait_s=self.wait_s)
         # largest feasible world first (keeping capacity is never worse
         # — with compute_s=0 the cost model alone would price a

@@ -18,7 +18,10 @@ TPU-first choices, same stance as the rest of the zoo:
   (every device holds all experts — single chip, or experts replicated
   under pure DP) and *expert-parallel* (``ep_axis`` set, call under
   ``shard_map``: experts sharded, tokens moved by ``all_to_all`` via
-  :func:`~horovod_tpu.parallel.expert.expert_parallel_ffn`);
+  :func:`~horovod_tpu.parallel.expert.expert_parallel_ffn` — or by the
+  tile-fused ``a2a ⊗ expert-matmul`` ppermute ring when
+  ``fused_dispatch`` / ``HOROVOD_MOE_FUSED_DISPATCH`` resolves on,
+  overlapping each hop's wire with the previous tile's expert matmul);
 * the Switch load-balancing auxiliary loss is sowed under
   ``intermediates/moe_aux_loss`` so training loops can add
   ``aux_weight * mean(aux)`` without threading extra outputs.
@@ -57,8 +60,18 @@ class MoEConfig:
     capacity_factor: float = 1.25
     moe_every: int = 2              # every Nth block is MoE (Switch: 2)
     ep_axis: Optional[str] = None   # None: local experts; "ep": sharded
+    fused_dispatch: Optional[str] = None  # auto|on|off; None -> env knob
     remat: bool = False
     remat_policy: Optional[str] = None  # none|dots|full|offload
+
+    def resolved_fused_dispatch(self) -> str:
+        """The ``fused_dispatch`` mode with the
+        ``HOROVOD_MOE_FUSED_DISPATCH`` env-knob fallback applied
+        (default ``"auto"`` = TPU-only, docs/fused_kernels.md)."""
+        import os
+        return (self.fused_dispatch
+                or os.environ.get("HOROVOD_MOE_FUSED_DISPATCH")
+                or "auto").lower()
 
     def transformer(self) -> TransformerConfig:
         return TransformerConfig(
@@ -139,10 +152,15 @@ class SwitchFFN(nn.Module):
             # scores= hands the fp32 routing used for the aux loss to
             # the dispatch plane: the accounted routing IS the
             # dispatched routing, in any compute dtype
+            from horovod_tpu.ops.pallas_kernels import \
+                resolve_fused_collectives
+
+            fused = resolve_fused_collectives(
+                cfg.resolved_fused_dispatch())
             y, dropped = expert_parallel_ffn(
                 tokens.astype(cfg.dtype), gate_kernel,
                 expert_fn, e, capacity_factor=cfg.capacity_factor,
-                axis=cfg.ep_axis, scores=scores)
+                axis=cfg.ep_axis, scores=scores, fused=fused)
         else:
             # local mode: same dispatch/combine as the parallel path
             # minus the all_to_alls — numerics are mode-invariant

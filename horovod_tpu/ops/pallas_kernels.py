@@ -785,6 +785,105 @@ def matmul_reducescatter(x: jax.Array, w: jax.Array, axis: str,
     return acc.astype(out_dtype)
 
 
+def expert_chunk_mlp(chunk: jax.Array, w1: jax.Array, w2: jax.Array,
+                     interpret: bool = False) -> jax.Array:
+    """Per-expert gelu MLP over one ``(e_local, slots, d)`` token chunk
+    — the per-tile compute of :func:`expert_alltoall_ffn`.  Each
+    expert's two dots run the blocked Pallas matmul on TPU
+    (:func:`pallas_matmul`; off-contract shapes fall back to the
+    identical jnp dot inside it), batched by a Python loop over the
+    (small) local expert count so every dot is a 2-D MXU tile."""
+    outs = []
+    for ei in range(chunk.shape[0]):
+        h = pallas_matmul(chunk[ei], w1[ei], interpret=interpret)
+        outs.append(pallas_matmul(jax.nn.gelu(h), w2[ei],
+                                  out_dtype=chunk.dtype,
+                                  interpret=interpret))
+    return jnp.stack(outs)
+
+
+def expert_alltoall_ffn(dispatch: jax.Array, expert_fn,
+                        axis: str, fused: bool = True,
+                        interpret: bool = False) -> jax.Array:
+    """Fused ``a2a ⊗ expert-matmul``: the MoE dispatch→expert→combine
+    exchange over mesh axis ``axis`` with the token movement streamed
+    around a ``ppermute`` ring instead of two boundary-wide
+    ``all_to_all``\\ s.
+
+    ``dispatch`` is this rank's ``(world, e_local, capacity, d)``
+    routed-token buffer (dim 0 = destination expert rank, the layout
+    :func:`~horovod_tpu.parallel.expert.expert_parallel_ffn` builds);
+    ``expert_fn`` applies this rank's local experts to an
+    ``(e_local, slots, d)`` token buffer and MUST be token-wise (each
+    slot independent — true of any per-token MLP): the fused schedule
+    computes it per source-rank tile, the unfused one over the whole
+    ``world·capacity`` buffer, and only a slot-independent body makes
+    the two identical.  Returns the combined ``(world, e_local,
+    capacity, d)`` expert outputs back at the origin rank, dim 0 = the
+    expert rank that computed them — exactly the unfused formulation::
+
+        received = lax.all_to_all(dispatch, axis, 0, 0)
+        outputs  = expert_fn(received … reshaped)
+        combined = lax.all_to_all(outputs …)
+
+    Fused schedule: hop ``s`` moves ONE ``(e_local, capacity, d)``
+    token tile to expert rank ``me+s`` while the tile that arrived at
+    hop ``s-1`` is inside its expert matmul, and each tile's outputs
+    ride the inverse permute home as soon as they exist — expert
+    ``k+1``'s tokens are in flight while expert ``k``'s matmul
+    computes, and the boundary-wide all-to-all disappears from the
+    schedule (the HLO guard pins ``2·(world−1)`` collective-permutes,
+    zero all-to-all).  Differentiable end-to-end: every op is a lax
+    primitive with a transpose (the grads run the ring backwards).
+    ``fused=False`` keeps the unfused all_to_all formulation — the
+    numerics oracle and the off-contract fallback.
+    """
+    from jax import lax
+
+    if dispatch.ndim != 4:
+        raise ValueError(
+            f"expert_alltoall_ffn takes a (world, e_local, capacity, d) "
+            f"dispatch buffer, got shape {dispatch.shape}")
+    world = int(lax.axis_size(axis))
+    if dispatch.shape[0] != world:
+        raise ValueError(
+            f"dispatch dim 0 is {dispatch.shape[0]} but axis {axis!r} "
+            f"has size {world}")
+    _, e_local, capacity, d = dispatch.shape
+    if not fused or world == 1:
+        if world == 1:
+            return expert_fn(dispatch[0])[None]
+        received = lax.all_to_all(dispatch, axis, split_axis=0,
+                                  concat_axis=0, tiled=False)
+        buffers = received.transpose(1, 0, 2, 3).reshape(
+            e_local, world * capacity, d)
+        outputs = expert_fn(buffers)
+        outputs = outputs.reshape(e_local, world, capacity, d) \
+            .transpose(1, 0, 2, 3)
+        return lax.all_to_all(outputs, axis, split_axis=0,
+                              concat_axis=0, tiled=False)
+    _count_fused_launch("a2a_matmul")
+    me = lax.axis_index(axis)
+    # tile for my own experts never touches the wire: compute first so
+    # its matmul overlaps hop 1's transfer
+    chunks = [expert_fn(jnp.take(dispatch, me, axis=0))]
+    for s in range(1, world):
+        fwd = [(i, (i + s) % world) for i in range(world)]
+        bwd = [(i, (i - s) % world) for i in range(world)]
+        # hop s: send the tile destined for rank me+s; what arrives is
+        # rank me-s's tile for MY experts.  The sends are mutually
+        # data-independent, so tile s+1's wire overlaps tile s's dot.
+        got = lax.ppermute(
+            jnp.take(dispatch, (me + s) % world, axis=0), axis, fwd)
+        # the outputs ride the inverse permute home immediately —
+        # rank p receives its own tokens' results from rank p+s
+        chunks.append(lax.ppermute(expert_fn(got), axis, bwd))
+    # chunks[s] holds my tokens' outputs from expert rank (me+s):
+    # rotate shift-major -> rank-major so dim 0 matches the unfused
+    # all_to_all's source-rank ordering
+    return jnp.roll(jnp.stack(chunks), me, axis=0)
+
+
 def allgather_matmul(x: jax.Array, w: jax.Array, axis: str,
                      fused: bool = True,
                      interpret: bool = False) -> jax.Array:

@@ -22,6 +22,7 @@ Design notes for the MXU/HBM (see repo guidance):
 
 from __future__ import annotations
 
+import os
 import time
 from functools import partial
 from typing import Callable, Optional, Sequence, Union
@@ -103,7 +104,9 @@ class DistributedTrainStep:
                  fused_collectives: str = "auto",
                  error_feedback: bool = False,
                  plan=None,
-                 guard=None):
+                 guard=None,
+                 moe_fused: Optional[str] = None,
+                 moe_capacity_factor: Optional[float] = None):
         """``steps_per_call > 1`` scans that many optimizer steps inside
         the one compiled program (the Keras ``steps_per_execution``
         knob): one dispatch amortizes per-call host/launch overhead —
@@ -312,6 +315,24 @@ class DistributedTrainStep:
             "on" if shard_optimizer_states and
             resolve_fused_collectives(fused_collectives) else "off")
         self._shard_opt = shard_optimizer_states
+        # MoE schedule fields: the routing config inside a MoE loss_fn
+        # is invisible to the step, so callers stamp it here — the
+        # resolved expert-dispatch mode and the capacity factor are
+        # AOT-key fields, and a warm start never serves a fused-ring
+        # executable to an unfused config or mixes capacity geometries
+        # (docs/fused_kernels.md "Expert-parallel dispatch").
+        if moe_fused is None:
+            moe_fused = os.environ.get("HOROVOD_MOE_FUSED_DISPATCH")
+        self._moe_fused = (
+            None if moe_fused is None else
+            ("on" if resolve_fused_collectives(str(moe_fused).lower())
+             else "off"))
+        if moe_capacity_factor is None:
+            env_cf = os.environ.get("HOROVOD_MOE_CAPACITY_FACTOR")
+            moe_capacity_factor = float(env_cf) if env_cf else None
+        self._moe_capacity_factor = (
+            None if moe_capacity_factor is None
+            else float(moe_capacity_factor))
         if fsdp_axis is not None and mode != "pjit":
             raise ValueError(
                 "fsdp_axis requires mode='pjit' (GSPMD inserts the "
@@ -641,6 +662,22 @@ class DistributedTrainStep:
         return self._fused_collectives
 
     @property
+    def moe_fused(self) -> Optional[str]:
+        """The resolved MoE expert-dispatch schedule this step was
+        stamped with: ``"on"`` (tile-fused a2a ⊗ expert-matmul ring),
+        ``"off"`` (boundary-wide alltoalls), or ``None`` when the step
+        carries no MoE schedule.  An AOT-key field; ``bench.py --moe``
+        emits it as ``moe_fused_collectives``."""
+        return self._moe_fused
+
+    @property
+    def moe_capacity_factor(self) -> Optional[float]:
+        """The MoE capacity factor stamped into the AOT key (``None``
+        when the step carries no MoE schedule) — a capacity change is a
+        schedule change, never a warm-start hit."""
+        return self._moe_capacity_factor
+
+    @property
     def remat_policy(self) -> str:
         """The resolved remat policy (``none|dots|full|offload``) this
         step was built under — explicit ``remat=`` argument or the
@@ -676,6 +713,8 @@ class DistributedTrainStep:
             "plan": None if self._plan is None else self._plan.to_string(),
             "error_feedback": self._error_feedback,
             "remat": self._remat_policy,
+            "moe_fused": self._moe_fused,
+            "moe_capacity_factor": self._moe_capacity_factor,
         }
 
     def init(self, params):

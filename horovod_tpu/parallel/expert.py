@@ -48,7 +48,9 @@ def expert_parallel_ffn(x: jax.Array, gate_kernel: jax.Array,
                         expert_fn: Callable, num_experts_total: int,
                         capacity_factor: float = 1.25,
                         axis: str = AXIS_EP,
-                        scores: Optional[jax.Array] = None):
+                        scores: Optional[jax.Array] = None,
+                        fused: bool = False,
+                        interpret: bool = False):
     """Mixture-of-experts FFN with experts sharded over ``axis``.
 
     Call inside ``shard_map``.  Args:
@@ -56,10 +58,20 @@ def expert_parallel_ffn(x: jax.Array, gate_kernel: jax.Array,
       gate_kernel: (d, num_experts_total) router weights (replicated).
       expert_fn: ``f(local_expert_params_selector) -> (E_local, C_world,
         d) -> (E_local, C_world, d)`` — actually invoked as
-        ``expert_fn(buffers)`` where ``buffers`` is (E_local, world*C, d);
-        must apply this shard's local experts batched over dim 0.
+        ``expert_fn(buffers)`` where ``buffers`` is (E_local, world*C, d)
+        (unfused) or one (E_local, C, d) source tile at a time (fused);
+        must apply this shard's local experts batched over dim 0 and be
+        token-wise (each slot independent) so both schedules agree.
       num_experts_total: E; must divide by the axis size.
       capacity_factor: per-expert capacity = ceil(cf * tokens/E).
+      fused: route the dispatch/combine through the tile-fused
+        ``a2a ⊗ expert-matmul`` ring
+        (:func:`~horovod_tpu.ops.pallas_kernels.expert_alltoall_ffn`)
+        instead of two boundary-wide ``all_to_all``\\ s — identical
+        numerics (forward and grads), overlapped wire.  Resolve the
+        ``"auto"|"on"|"off"`` knob with
+        :func:`~horovod_tpu.ops.pallas_kernels.resolve_fused_collectives`
+        before calling.
 
     Returns:
       (tokens_local, d) gate-weighted expert outputs (dropped tokens get
@@ -91,21 +103,14 @@ def expert_parallel_ffn(x: jax.Array, gate_kernel: jax.Array,
     dispatch = dispatch.at[expert_idx, safe_slot].add(
         jnp.where(keep[:, None], x, 0.0))
 
-    # (E, C, d) -> (world, E_local, C, d) -> alltoall over shards:
-    # afterwards dim 0 is the SOURCE shard, and our E_local experts' data
-    # from every shard is local
+    # (E, C, d) -> (world, E_local, C, d); dim 0 is the destination
+    # shard.  The dispatch/combine exchange (two alltoalls, or the fused
+    # ppermute ring that streams one tile per hop while the previous
+    # tile's expert matmul computes) lives in ops.pallas_kernels.
+    from horovod_tpu.ops.pallas_kernels import expert_alltoall_ffn
     dispatch = dispatch.reshape(world, e_local, capacity, d)
-    received = lax.all_to_all(dispatch, axis, split_axis=0, concat_axis=0,
-                              tiled=False)        # (world, E_local, C, d)
-    buffers = received.transpose(1, 0, 2, 3).reshape(
-        e_local, world * capacity, d)
-
-    outputs = expert_fn(buffers)                  # (E_local, world*C, d)
-
-    outputs = outputs.reshape(e_local, world, capacity, d) \
-        .transpose(1, 0, 2, 3)                    # (world, E_local, C, d)
-    combined = lax.all_to_all(outputs, axis, split_axis=0, concat_axis=0,
-                              tiled=False)        # back at source shards
+    combined = expert_alltoall_ffn(dispatch, expert_fn, axis,
+                                   fused=fused, interpret=interpret)
     combined = combined.reshape(num_experts_total, capacity, d)
 
     # gather each token's result from its (expert, slot) and weight by gate

@@ -42,6 +42,9 @@ KNOWN_KNOBS = frozenset({
     # -- parallelism plan (parallel/plan.py, docs/parallelism.md):
     # the ShardingPlan grammar, e.g. "dp=4,tp=2" or "dp=2,pp=2,v=2"
     "HOROVOD_PLAN",
+    # -- MoE expert-parallel dispatch (models/moe.py, parallel/expert.py,
+    #    docs/fused_kernels.md "Expert-parallel dispatch")
+    "HOROVOD_MOE_FUSED_DISPATCH", "HOROVOD_MOE_CAPACITY_FACTOR",
     # -- warm-start compile cache
     "HOROVOD_COMPILE_CACHE", "HOROVOD_COMPILE_CACHE_DIR",
     # -- input pipeline

@@ -898,3 +898,65 @@ class TestSerialTailRule:
                "fused_exchange_serial_tail_collectives": 2}
         assert any(f.rule == "HLO005"
                    for f in hlo_lint.lint_artifact(art))
+
+
+class TestMoeDispatchRule:
+    """HLO006 (ISSUE 16): a serial boundary-wide MoE dispatch — the
+    final all-to-all start..done pair with no compute inside its
+    window — must be flagged in HLO dumps, and an ep>1 artifact that
+    claims the fused dispatch must not still report one."""
+
+    SERIAL = "\n".join([
+        "ENTRY %main () -> f32[8,16] {",
+        "  %p = f32[8,16]{1,0} parameter(0)",
+        "  %a2a = (f32[8,16]{1,0}, f32[8,16]{1,0}) "
+        "all-to-all-start(%p), replica_groups={{0,1,2,3,4,5,6,7}}, "
+        "dimensions={0}",
+        "  %a2ad = f32[8,16]{1,0} all-to-all-done(%a2a)",
+        "  ROOT %r = f32[8,16]{1,0} copy(%a2ad)",
+        "}",
+    ])
+
+    def test_serial_dispatch_fires(self):
+        findings = hlo_lint.lint_hlo_text(self.SERIAL)
+        assert any(f.rule == "HLO006" for f in findings), findings
+
+    def test_overlapped_dispatch_clean(self):
+        """Expert matmul scheduled inside the start..done window — the
+        fused ring's shape — hides the wire; no finding."""
+        overlapped = self.SERIAL.replace(
+            "  %a2ad = ",
+            "  %d = f32[16,16]{1,0} dot(%a, %b), "
+            "lhs_contracting_dims={1}, rhs_contracting_dims={0}\n"
+            "  %a2ad = ")
+        assert [f for f in hlo_lint.lint_hlo_text(overlapped)
+                if f.rule == "HLO006"] == []
+
+    def test_synchronous_dispatch_not_judged(self):
+        sync = ("  %a2a = f32[8,16]{1,0} all-to-all(%p), "
+                "replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}")
+        assert [f for f in hlo_lint.lint_hlo_text(sync)
+                if f.rule == "HLO006"] == []
+
+    def test_artifact_fused_ep_with_serial_dispatch_fires(self):
+        art = {"moe_fused_collectives": "on", "moe_ep": 4,
+               "moe_serial_tail_alltoalls": 1}
+        assert any(f.rule == "HLO006"
+                   for f in hlo_lint.lint_artifact(art))
+
+    def test_artifact_ep_one_or_unfused_expected(self):
+        # ep=1: experts local, no boundary to judge
+        assert [f for f in hlo_lint.lint_artifact(
+            {"moe_fused_collectives": "on", "moe_ep": 1,
+             "moe_serial_tail_alltoalls": 1})
+            if f.rule == "HLO006"] == []
+        # fused off: the serial all-to-all IS the unfused schedule
+        assert [f for f in hlo_lint.lint_artifact(
+            {"moe_fused_collectives": "off", "moe_ep": 4,
+             "moe_serial_tail_alltoalls": 1})
+            if f.rule == "HLO006"] == []
+
+    def test_legacy_artifact_without_moe_fields_passes(self):
+        assert [f for f in hlo_lint.lint_artifact(
+            {"overlap_fraction": 0.5})
+            if f.rule == "HLO006"] == []

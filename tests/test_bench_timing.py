@@ -202,3 +202,78 @@ class TestPlanProbeFields:
         assert bench._plan_axis_values(8) == \
             ["dp=8", "dp=4,fsdp=2", "dp=2,fsdp=4", "dp=1,fsdp=8"]
         assert bench._plan_axis_values(1) == ["dp=1"]
+
+
+class TestMoeAutotune:
+    """``--autotune --model moe`` (ISSUE 16): the routing axes
+    (capacity_factor, tokens_per_expert) race through the coordinate
+    descent with the cost-model predictor pruning, the twin probe is
+    disabled inside the race, and HOROVOD_HBM_BUDGET_BYTES gates each
+    candidate through the expert-aware plan_memory_bytes before it is
+    allowed to measure."""
+
+    class FakeHvd:
+        def size(self):
+            return 1
+
+    @staticmethod
+    def _args(tmp_path):
+        import types
+
+        return types.SimpleNamespace(
+            model="moe", num_iters=5, num_batches_per_iter=5,
+            num_warmup_batches=2, shard_optimizer_states=False,
+            moe_experts=4, tf_seq_len=128, moe_d_model=32,
+            moe_layers=2, moe_batch_size=4, plan=None,
+            autotune_log=str(tmp_path / "tune.csv"))
+
+    def _patch_run_moe(self, monkeypatch, seen):
+        def fake_run_moe(a, hvd):
+            assert a.moe_fused is None      # no twin probe in the race
+            assert a.num_iters == 2         # short measurement windows
+            seen.append((a.moe_capacity_factor, a.moe_batch_size,
+                         a.steps_per_call))
+            # reward high cf/tpe so any low-capacity winner below can
+            # only come from the budget gate, not the measurement
+            return {"moe_tokens_per_sec":
+                    a.moe_capacity_factor * 1000.0
+                    + a.moe_batch_size * 32.0 + a.steps_per_call}
+
+        monkeypatch.setattr(bench, "run_moe", fake_run_moe)
+
+    def test_routing_axes_race_and_log(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("HOROVOD_HBM_BUDGET_BYTES", raising=False)
+        seen = []
+        self._patch_run_moe(monkeypatch, seen)
+        out = bench.run_autotune(self._args(tmp_path), self.FakeHvd())
+        assert out["metric"] == "autotune_moe"
+        assert out["unit"] == "tokens/sec/chip"
+        best = out["best_point"]
+        assert best["capacity_factor"] in [0.5, 1.0, 1.25, 1.5, 2.0]
+        assert best["tokens_per_expert"] in [32, 64, 128]
+        assert best["steps_per_call"] in [1, 5, 10, 20, 40]
+        assert seen, "nothing raced"
+        # tokens_per_expert reaches the measurement through the batch
+        # size: tpe * E / seq with E=4, seq=128 -> tpe/32
+        assert {b for _, b, _ in seen} <= {1, 2, 4}
+        log = (tmp_path / "tune.csv").read_text().splitlines()
+        assert len(log) >= 2                # header + samples
+
+    def test_hbm_budget_gates_capacity(self, tmp_path, monkeypatch):
+        """Budget chosen so the dispatch buffers of cap > 131 blow it:
+        dense 4*(P+E) + activations = 19,005,440 fixed bytes, buffers
+        2*E*cap*d*4 = 1024*cap.  The measured rate rewards HIGH
+        capacity, so every point that raced being small-capacity is
+        the feasibility gate at work."""
+        monkeypatch.setenv("HOROVOD_HBM_BUDGET_BYTES", "19140000")
+        seen = []
+        self._patch_run_moe(monkeypatch, seen)
+        out = bench.run_autotune(self._args(tmp_path), self.FakeHvd())
+        assert seen, "nothing raced"
+        for cf, batch, _spc in seen:
+            tpe = batch * 32
+            cap = -(-cf * tpe // 1)
+            assert cap <= 131, (cf, tpe)
+        best = out["best_point"]
+        assert -(-best["capacity_factor"]
+                 * best["tokens_per_expert"] // 1) <= 131

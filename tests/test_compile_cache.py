@@ -298,3 +298,46 @@ class TestPlanKey:
             "module @m {}", on._aot_extras()) != \
             compile_cache.executable_key("module @m {}",
                                          off._aot_extras())
+
+
+class TestMoeRoutingKey:
+    """ISSUE 16: the MoE dispatch schedule and capacity factor are
+    AOT-key fields — a warm start must never serve a fused-ring
+    executable (or a different capacity bucketing) to a config that
+    asked for the unfused all_to_all formulation."""
+
+    def test_key_differs_on_moe_fields(self):
+        base = compile_cache.executable_key(
+            "module @m {}",
+            {"moe_fused": None, "moe_capacity_factor": None})
+        assert compile_cache.executable_key(
+            "module @m {}",
+            {"moe_fused": "on", "moe_capacity_factor": None}) != base
+        assert compile_cache.executable_key(
+            "module @m {}",
+            {"moe_fused": None, "moe_capacity_factor": 1.5}) != base
+
+    def test_step_extras_carry_resolved_dispatch(self, cache_dir):
+        step = _make_step(mode="shard_map", moe_fused="on",
+                          moe_capacity_factor=1.5)
+        ex = step._aot_extras()
+        assert ex["moe_fused"] == "on"
+        assert ex["moe_capacity_factor"] == 1.5
+        bare = _make_step(mode="shard_map")
+        assert bare._aot_extras()["moe_fused"] is None
+        assert bare._aot_extras()["moe_capacity_factor"] is None
+        assert compile_cache.executable_key(
+            "module @m {}", ex) != compile_cache.executable_key(
+            "module @m {}", bare._aot_extras())
+        # "auto" resolves through resolve_fused_collectives — off on
+        # this CPU twin, so it keys like an explicit "off"
+        auto = _make_step(mode="shard_map", moe_fused="auto")
+        assert auto._aot_extras()["moe_fused"] == "off"
+
+    def test_env_knobs_reach_the_key(self, cache_dir, monkeypatch):
+        monkeypatch.setenv("HOROVOD_MOE_FUSED_DISPATCH", "on")
+        monkeypatch.setenv("HOROVOD_MOE_CAPACITY_FACTOR", "2.0")
+        step = _make_step(mode="shard_map")
+        ex = step._aot_extras()
+        assert ex["moe_fused"] == "on"
+        assert ex["moe_capacity_factor"] == 2.0

@@ -93,6 +93,29 @@ class TestResolver:
         r = DegradedPlanResolver.from_env("dp=4", 4)
         assert (r.wait_s, r.min_data_extent) == (7.0, 2)
 
+    def test_ep_shrink_preserves_expert_extent(self):
+        """ISSUE 16 satellite: losing data capacity under an ep>1 plan
+        shrinks dp and keeps the expert extent — the survivors can
+        still host every expert shard."""
+        d = self.make("dp=4,ep=2", 8).resolve(6)
+        assert d.action == "shrink"
+        assert d.plan.ep == 2
+        assert (d.plan.dp or 1) * d.plan.fsdp == 3
+
+    def test_wait_names_ep_when_experts_cannot_fit(self):
+        """A world below the expert extent has no rank set that can
+        host every expert's DISTINCT parameters — the refusal must
+        name ep so the operator knows which capacity to restore."""
+        r = self.make("dp=2,ep=4", 8)
+        d = r.resolve(3)                   # 3 < expert extent 4
+        assert d.action == "wait"
+        assert d.plan is None
+        assert "ep=4" in d.reason
+        assert "expert" in d.reason
+        # non-ep model-extent waits keep the terse reason
+        d_tp = self.make("dp=2,tp=4", 8).resolve(3)
+        assert "expert" not in d_tp.reason
+
 
 class TestController:
     def make(self, p="dp=4", n=4, **kw):
@@ -142,6 +165,26 @@ class TestController:
         ctl.on_world_change(2, step=1)
         ctl.record_transition_s(1.5)
         assert ctl.history[-1]["transition_s"] == 1.5
+
+    def test_ep_capacity_walk_shrinks_waits_promotes(self):
+        """Seeded ep>1 capacity walk (ISSUE 16): 8 devices at
+        dp=4,ep=2 lose two (dp shrinks, experts keep their extent),
+        then drop below the expert extent (wait names ep), then return
+        (promote back to the base plan)."""
+        ctl = self.make("dp=4,ep=2", 8, global_batch=16,
+                        per_replica_batch=2, promote=True)
+        d = ctl.on_world_change(6, step=10)
+        assert d.action == "shrink"
+        assert ctl.current_plan.to_string() == "dp=3,ep=2"
+        # global batch preserved: ceil(16 / (3 replicas · 2)) = 3
+        assert ctl.grad_accum() == 3
+        d2 = ctl.on_world_change(1, step=11)
+        assert d2.action == "wait"
+        assert "ep=2" in d2.reason and "expert" in d2.reason
+        assert ctl.current_plan.to_string() == "dp=3,ep=2"
+        d3 = ctl.on_world_change(8, step=12)
+        assert d3.action == "promote"
+        assert ctl.current_plan.to_string() == "dp=4,ep=2"
 
 
 class TestPreserveGlobalBatch:
@@ -241,6 +284,37 @@ class TestReshardEdgeCases:
         with pytest.raises(ValueError, match="tp"):
             reshard_restore(ckpt, {"m": np.zeros((2,), np.float32)},
                             0, plan("dp=2"), step=1)
+
+    def test_ep_plan_reshards_across_dp_shrink(self, tmp_path):
+        """Expert-state plans reshard over the data axes: a dp=4,ep=2
+        checkpoint restores onto the dp=2,ep=2 survivors exactly —
+        the expert extent is untouched, only the data shards move."""
+        ckpt = Checkpointer(str(tmp_path), use_orbax=False)
+        m = np.arange(16, dtype=np.float32)
+        for rank in range(4):
+            sl = slice(rank * 4, (rank + 1) * 4)
+            ckpt.save_sharded(2, {"m": m[sl].copy()}, rank, 4,
+                              plan="dp=4,ep=2")
+        ckpt.wait()
+        template = {"m": np.zeros((8,), np.float32)}
+        parts = [reshard_restore(ckpt, template, rank,
+                                 plan("dp=2,ep=2"), step=2)
+                 for rank in range(2)]
+        assert np.array_equal(
+            np.concatenate([p["m"] for p in parts]), m)
+
+    def test_ep_extent_change_refuses_naming_ep(self, tmp_path):
+        """Dropping (or changing) the ep extent re-partitions the
+        DISTINCT per-rank expert parameters — no flat-buffer reshard
+        covers that; the refusal names the axis."""
+        ckpt = Checkpointer(str(tmp_path), use_orbax=False)
+        for rank in range(4):
+            ckpt.save_sharded(1, {"m": np.zeros((2,), np.float32)},
+                              rank, 4, plan="dp=4,ep=2")
+        ckpt.wait()
+        with pytest.raises(ValueError, match="ep"):
+            reshard_restore(ckpt, {"m": np.zeros((2,), np.float32)},
+                            0, plan("dp=4"), step=1)
 
     def test_round_trip_4_2_4_matches_never_degraded(self, tmp_path):
         """The full kill → shrink → replay → promote walk: final

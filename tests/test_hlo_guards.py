@@ -680,3 +680,86 @@ class TestFusedCollectiveHLO:
         assert kinds.get("reduce-scatter", 0) == 0, kinds
         assert kinds.get("collective-permute", 0) >= self.W - 1, kinds
         assert kinds.get("all-gather", 0) == 1, kinds
+
+
+class TestFusedExpertDispatchHLO:
+    """Guards for the fused ``a2a ⊗ expert-matmul`` MoE dispatch
+    (ISSUE 16 tentpole): under a dp×ep×tp plan with
+    ``fused_dispatch="on"`` the compiled program must carry ZERO
+    boundary-wide all-to-alls — the dispatch/combine exchange is the
+    ppermute ring — and no serial all-to-all tail window.  A silent
+    fall-back to the unfused schedule is numerically invisible and
+    only shows up as an exposed expert exchange on a real pod; these
+    guards fail instead."""
+
+    def _lowered_switch_ffn(self, mode, ep=2):
+        """Compiled text of a SwitchFFN forward on a dp×ep×tp mesh."""
+        from horovod_tpu.models.moe import MoEConfig, SwitchFFN
+        from horovod_tpu.parallel.mesh import make_parallel_mesh
+
+        mesh = make_parallel_mesh(dp=2, ep=ep, tp=8 // (2 * ep),
+                                  devices=jax.devices("cpu")[:8])
+        cfg = MoEConfig(
+            vocab_size=64, num_layers=2, num_heads=2, d_model=32,
+            d_ff=64, max_seq_len=16, dtype=jnp.float32, num_experts=4,
+            capacity_factor=8.0, moe_every=2, ep_axis="ep",
+            fused_dispatch=mode)
+        ffn = SwitchFFN(cfg)
+        x = jnp.zeros((4, 8, 32), jnp.float32)
+        local_init = SwitchFFN(
+            MoEConfig(vocab_size=64, num_layers=2, num_heads=2,
+                      d_model=32, d_ff=64, max_seq_len=16,
+                      dtype=jnp.float32, num_experts=4,
+                      capacity_factor=8.0, moe_every=2))
+        params = local_init.init(jax.random.PRNGKey(0), x)["params"]
+
+        sm = jax.jit(jax.shard_map(
+            lambda p, x: ffn.apply({"params": p}, x), mesh=mesh,
+            in_specs=(P(), P(("dp", "ep"))),
+            out_specs=P(("dp", "ep")), check_vma=False))
+        return sm.lower(params, x).compile().as_text()
+
+    def test_fused_program_has_zero_alltoalls(self, hvd_runtime):
+        text = self._lowered_switch_ffn("on")
+        kinds = H.count_by_kind(H.collective_ops(text))
+        assert kinds.get("all-to-all", 0) == 0, kinds
+        # the exchange is the ring: >= 2·(ep−1) permute hops (dispatch
+        # + combine directions; XLA may emit more as send/recv pairs)
+        assert kinds.get("collective-permute", 0) >= 2, kinds
+        # no serial boundary-wide dispatch window left to expose
+        assert H.serial_tail_collectives(
+            text, kinds=("all-to-all",)) == 0
+
+    def test_unfused_control_keeps_alltoalls(self, hvd_runtime):
+        text = self._lowered_switch_ffn("off")
+        kinds = H.count_by_kind(H.collective_ops(text))
+        assert kinds.get("all-to-all", 0) >= 1, kinds
+
+    def test_eight_way_ring_scales_with_world(self, hvd_runtime):
+        """At ep=8 the fused program still has zero all-to-alls and at
+        least 2·(W−1) = 14 ring hops."""
+        from horovod_tpu.models.moe import MoEConfig, SwitchFFN
+        from horovod_tpu.parallel.mesh import make_parallel_mesh
+
+        mesh = make_parallel_mesh(ep=8, devices=jax.devices("cpu")[:8])
+        cfg = MoEConfig(
+            vocab_size=64, num_layers=2, num_heads=2, d_model=32,
+            d_ff=64, max_seq_len=16, dtype=jnp.float32, num_experts=8,
+            capacity_factor=8.0, moe_every=2, ep_axis="ep",
+            fused_dispatch="on")
+        ffn = SwitchFFN(cfg)
+        x = jnp.zeros((8, 8, 32), jnp.float32)
+        params = SwitchFFN(
+            MoEConfig(vocab_size=64, num_layers=2, num_heads=2,
+                      d_model=32, d_ff=64, max_seq_len=16,
+                      dtype=jnp.float32, num_experts=8,
+                      capacity_factor=8.0, moe_every=2)).init(
+                          jax.random.PRNGKey(0), x)["params"]
+        sm = jax.jit(jax.shard_map(
+            lambda p, x: ffn.apply({"params": p}, x), mesh=mesh,
+            in_specs=(P(), P("ep")), out_specs=P("ep"),
+            check_vma=False))
+        text = sm.lower(params, x).compile().as_text()
+        kinds = H.count_by_kind(H.collective_ops(text))
+        assert kinds.get("all-to-all", 0) == 0, kinds
+        assert kinds.get("collective-permute", 0) >= 14, kinds

@@ -76,6 +76,45 @@ class TestSwitchFFN:
         np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_local),
                                    rtol=2e-4, atol=2e-4)
 
+    def test_fused_dispatch_matches_local_mode(self, hvd_runtime):
+        """fused_dispatch="on": the a2a⊗expert-matmul ppermute ring
+        must reproduce the local path exactly like the unfused
+        all_to_all plumbing does — and both ep schedules must agree on
+        the drop fraction (identical routing, docs/fused_kernels.md
+        "Expert-parallel dispatch")."""
+        mesh = make_parallel_mesh(ep=8, devices=jax.devices("cpu")[:8])
+        kw = dict(num_experts=8, capacity_factor=16.0)
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 8, 32),
+                              jnp.float32)
+        local = SwitchFFN(tiny_cfg(**kw))
+        variables = local.init(jax.random.PRNGKey(1), x)
+        y_local = local.apply(variables, x)
+
+        def make(mode):
+            ffn = SwitchFFN(tiny_cfg(ep_axis="ep", fused_dispatch=mode,
+                                     **kw))
+
+            def run(p, x):
+                y, state = ffn.apply({"params": p}, x,
+                                     mutable=["intermediates"])
+                drop = state["intermediates"]["moe_drop_fraction"][0]
+                return y, drop[None]
+
+            return jax.jit(jax.shard_map(
+                run, mesh=mesh, in_specs=(P(), P("ep",)),
+                out_specs=(P("ep",), P("ep",)), check_vma=False))
+
+        y_fused, drop_fused = make("on")(variables["params"], x)
+        y_unfused, drop_unfused = make("off")(variables["params"], x)
+        np.testing.assert_allclose(np.asarray(y_fused),
+                                   np.asarray(y_local),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(y_fused),
+                                   np.asarray(y_unfused),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(drop_fused),
+                                      np.asarray(drop_unfused))
+
     def test_ep_routing_matches_local_in_bf16(self, hvd_runtime):
         """bf16 compute: the dispatched routing must still be the fp32
         routing the aux loss accounts (scores= pass-through into the

@@ -362,6 +362,105 @@ class TestNonTileShapeParity:
                                    np.asarray(expected), rtol=0.05,
                                    atol=0.05)
 
+    # -- expert dispatch at off-tile shapes (ISSUE 16 satellite):
+    #    the fused a2a⊗expert-matmul ring through the FULL
+    #    expert_parallel_ffn pipeline (routing, capacity, drops) at
+    #    shapes the happy-path parity never touches
+
+    def _expert_pair(self, t, d, e_total, world, capacity_factor,
+                     dtype=jnp.float32, gate_w=None, seed=0):
+        """(fused_y, unfused_y, fused_drop, unfused_drop) from the same
+        tokens/router/experts on a ``world``-way ep mesh."""
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.parallel.expert import expert_parallel_ffn
+        from horovod_tpu.parallel.mesh import make_parallel_mesh
+
+        mesh = make_parallel_mesh(ep=world,
+                                  devices=jax.devices("cpu")[:world])
+        key = jax.random.PRNGKey(seed)
+        x = jax.random.normal(key, (t, d)).astype(dtype)
+        if gate_w is None:
+            gate_w = jax.random.normal(jax.random.fold_in(key, 1),
+                                       (d, e_total)).astype(dtype)
+        e_local = e_total // world
+        w = jax.random.normal(jax.random.fold_in(key, 2),
+                              (world, e_local, d, d)).astype(dtype) * 0.3
+
+        def f(x, gate_w, w):
+            def expert_fn(buffers):
+                return jnp.einsum("esd,edk->esk", buffers, w[0])
+
+            def run(fused):
+                y, dropped = expert_parallel_ffn(
+                    x, gate_w, expert_fn, e_total,
+                    capacity_factor=capacity_factor, fused=fused)
+                return y, dropped[None]
+
+            (yf, df), (yu, du) = run(True), run(False)
+            return yf, yu, df, du
+
+        return jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), P(), P("ep")),
+            out_specs=(P(), P(), P(), P()), check_vma=False))(
+                x, gate_w, w)
+
+    @pytest.mark.parametrize("t,d", [
+        (13, 5),    # odd everything: capacity ceil(1.25*13/8) = 3
+        (31, 7),    # prime token count, odd feature dim
+    ])
+    def test_expert_dispatch_off_tile_tokens(self, t, d):
+        yf, yu, df, du = self._expert_pair(t, d, e_total=8, world=8,
+                                           capacity_factor=1.25)
+        np.testing.assert_allclose(np.asarray(yf), np.asarray(yu),
+                                   rtol=1e-5, atol=1e-5)
+        assert float(df[0]) == float(du[0])
+
+    def test_expert_dispatch_capacity_overflow_drop_parity(self):
+        """Over-capacity routing: the fused ring must drop EXACTLY the
+        tokens the unfused path drops (same zero rows, same fraction)."""
+        d, e_total = 4, 8
+        # every token prefers expert 0 at cf=1.0 -> heavy dropping
+        gate_w = jnp.zeros((d, e_total)).at[:, 0].set(10.0)
+        yf, yu, df, du = self._expert_pair(
+            24, d, e_total=e_total, world=8, capacity_factor=1.0,
+            gate_w=gate_w, seed=1)
+        assert float(df[0]) > 0.5
+        assert float(df[0]) == float(du[0])
+        np.testing.assert_allclose(np.asarray(yf), np.asarray(yu),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(
+            np.abs(np.asarray(yf)).sum(axis=1) == 0,
+            np.abs(np.asarray(yu)).sum(axis=1) == 0)
+
+    def test_expert_dispatch_one_expert_per_rank(self):
+        """E == world degenerate ring: every hop carries exactly one
+        expert's bucket."""
+        yf, yu, df, du = self._expert_pair(16, 6, e_total=8, world=8,
+                                           capacity_factor=2.0, seed=2)
+        np.testing.assert_allclose(np.asarray(yf), np.asarray(yu),
+                                   rtol=1e-5, atol=1e-5)
+        assert float(df[0]) == float(du[0])
+
+    def test_expert_dispatch_world_one(self):
+        """ep extent 1: no wire at all — both schedules are the local
+        expert call."""
+        yf, yu, df, du = self._expert_pair(10, 4, e_total=4, world=1,
+                                           capacity_factor=4.0, seed=3)
+        np.testing.assert_allclose(np.asarray(yf), np.asarray(yu),
+                                   rtol=1e-6, atol=1e-6)
+        assert float(df[0]) == float(du[0])
+
+    def test_expert_dispatch_bf16(self):
+        yf, yu, df, du = self._expert_pair(
+            16, 8, e_total=8, world=8, capacity_factor=8.0,
+            dtype=jnp.bfloat16, seed=4)
+        assert yf.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(yf, np.float32),
+                                   np.asarray(yu, np.float32),
+                                   rtol=5e-2, atol=5e-2)
+        assert float(df[0]) == float(du[0])
+
 
 class TestPallasMatmul:
     """Blocked Pallas matmul — the per-tile compute of the fused
@@ -540,6 +639,164 @@ class TestFusedMatmulCollectives:
             after = telemetry.value(
                 "hvd_pallas_fused_launches_total",
                 kernel="matmul_reducescatter")
+            assert after > before
+        finally:
+            telemetry.disable()
+
+
+class TestFusedExpertDispatch:
+    """``a2a ⊗ expert-matmul`` fused dispatch/combine ring vs the
+    unfused all_to_all formulation it replaces (ISSUE 16 tentpole):
+    identical tokens, drops, outputs and grads — only the schedule
+    differs."""
+
+    W = 8
+
+    def _mesh(self, world=None):
+        from jax.sharding import Mesh
+
+        world = world or self.W
+        devs = np.asarray(jax.devices("cpu")[:world])
+        return Mesh(devs.reshape(world), ("ep",))
+
+    @staticmethod
+    def _expert_mlp(w1, w2):
+        """Token-wise gelu MLP over an (e_local, slots, d) buffer —
+        the contract expert_alltoall_ffn requires."""
+        def expert_fn(t):
+            h = jnp.einsum("ecd,edf->ecf", t, w1)
+            return jnp.einsum("ecf,efd->ecd", jax.nn.gelu(h), w2)
+
+        return expert_fn
+
+    def _inputs(self, world, e_local=2, cap=3, d=4, f=8,
+                dtype=jnp.float32, seed=0):
+        rng = np.random.RandomState(seed)
+        disp = jnp.asarray(
+            rng.standard_normal((world, world, e_local, cap, d)), dtype)
+        w1 = jnp.asarray(
+            rng.standard_normal((world, e_local, d, f)) * 0.3, dtype)
+        w2 = jnp.asarray(
+            rng.standard_normal((world, e_local, f, d)) * 0.3, dtype)
+        return disp, w1, w2
+
+    def _pair(self, disp, w1, w2, world):
+        """Run the fused ring and its unfused oracle over the same
+        per-rank dispatch buffers + per-rank expert weights."""
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.ops.pallas_kernels import expert_alltoall_ffn
+
+        def f(disp, w1, w2):
+            expert_fn = self._expert_mlp(w1[0], w2[0])
+            fused = expert_alltoall_ffn(disp[0], expert_fn, "ep",
+                                        fused=True)
+            ref = expert_alltoall_ffn(disp[0], expert_fn, "ep",
+                                      fused=False)
+            return fused[None], ref[None]
+
+        return jax.jit(jax.shard_map(
+            f, mesh=self._mesh(world),
+            in_specs=(P("ep"), P("ep"), P("ep")),
+            out_specs=(P("ep"), P("ep")), check_vma=False))(disp, w1, w2)
+
+    def test_ring_matches_unfused_alltoall(self):
+        world = self.W
+        disp, w1, w2 = self._inputs(world)
+        fused, ref = self._pair(disp, w1, w2, world)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        # closed form: out[r, q, e, c] = expert (q, e)'s MLP applied to
+        # the tile rank r addressed to it — both schedules must hit it
+        h = jnp.einsum("rqecd,qedf->rqecf", disp, w1)
+        expect = jnp.einsum("rqecf,qefd->rqecd", jax.nn.gelu(h), w2)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(expect),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grads_match_unfused(self):
+        """Differentiable end-to-end: the ring transposes must produce
+        the same dx/dw1/dw2 as the all_to_all formulation."""
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.ops.pallas_kernels import expert_alltoall_ffn
+
+        world = self.W
+        disp, w1, w2 = self._inputs(world, seed=1)
+        mesh = self._mesh(world)
+
+        def make_loss(fused):
+            def f(disp, w1, w2):
+                expert_fn = self._expert_mlp(w1[0], w2[0])
+                out = expert_alltoall_ffn(disp[0], expert_fn, "ep",
+                                          fused=fused)
+                return lax.psum(jnp.sum(out ** 2), "ep")
+
+            sm = jax.shard_map(
+                f, mesh=mesh, in_specs=(P("ep"), P("ep"), P("ep")),
+                out_specs=P(), check_vma=False)
+            return jax.jit(jax.grad(sm, argnums=(0, 1, 2)))
+
+        gf = make_loss(True)(disp, w1, w2)
+        gu = make_loss(False)(disp, w1, w2)
+        for a, b in zip(gf, gu):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_bf16_parity(self):
+        world = self.W
+        disp, w1, w2 = self._inputs(world, dtype=jnp.bfloat16, seed=2)
+        fused, ref = self._pair(disp, w1, w2, world)
+        assert fused.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(fused, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   rtol=5e-2, atol=5e-2)
+
+    def test_single_local_expert_ring(self):
+        """E == world: one expert per rank — the tightest ring (every
+        tile is one expert's bucket)."""
+        world = self.W
+        disp, w1, w2 = self._inputs(world, e_local=1, cap=2, seed=3)
+        fused, ref = self._pair(disp, w1, w2, world)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_world_one_degenerate_ring(self):
+        """A 1-rank axis has no wire: both schedules reduce to one
+        local expert_fn call."""
+        disp, w1, w2 = self._inputs(1, e_local=4, seed=4)
+        fused, ref = self._pair(disp, w1, w2, 1)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_shape_validation(self):
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.ops.pallas_kernels import expert_alltoall_ffn
+
+        def run(fn, x):
+            return jax.jit(jax.shard_map(
+                fn, mesh=self._mesh(), in_specs=(P(),),
+                out_specs=P(), check_vma=False))(x)
+
+        with pytest.raises(ValueError, match="dispatch buffer"):
+            run(lambda x: expert_alltoall_ffn(x, lambda t: t, "ep"),
+                jnp.zeros((8, 2, 3)))
+        with pytest.raises(ValueError, match="dim 0"):
+            run(lambda x: expert_alltoall_ffn(x, lambda t: t, "ep"),
+                jnp.zeros((4, 2, 3, 4)))
+
+    def test_fused_launch_counter(self):
+        from horovod_tpu import telemetry
+
+        telemetry.enable()
+        try:
+            before = telemetry.value(
+                "hvd_pallas_fused_launches_total", kernel="a2a_matmul")
+            disp, w1, w2 = self._inputs(self.W, seed=5)
+            self._pair(disp, w1, w2, self.W)
+            after = telemetry.value(
+                "hvd_pallas_fused_launches_total", kernel="a2a_matmul")
             assert after > before
         finally:
             telemetry.disable()

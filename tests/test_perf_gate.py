@@ -233,6 +233,39 @@ class TestPlanComparability:
                                      "plan": "dp=2,fsdp=4"})
         assert PG.diff([base], cand, PG.Tolerances()) == []
 
+    def test_moe_routing_config_guards_the_diff(self):
+        """ISSUE 16 satellite: capacity_factor and the ep extent are
+        comparability keys on the MoE throughput — a routing-config
+        change is a schedule change (different dispatch geometry +
+        drop behavior), never a regression."""
+        def art(name, value, cf, ep):
+            return PG._validate(name, {
+                "moe_tokens_per_sec": value, "moe_params_m": 100.0,
+                "moe_capacity_factor": cf, "moe_ep": ep})
+
+        base = art("base", 30_000.0, 1.25, 1)
+        # cf change: half the throughput, no finding
+        assert PG.diff([base], art("cand", 15_000.0, 2.0, 1),
+                       PG.Tolerances()) == []
+        # ep change: no finding
+        assert PG.diff([base], art("cand", 15_000.0, 1.25, 8),
+                       PG.Tolerances()) == []
+        # same routing config: the regression fires
+        assert [f.rule for f in PG.diff(
+            [base], art("cand", 15_000.0, 1.25, 1),
+            PG.Tolerances())] == ["PERF001"]
+
+    def test_moe_legacy_artifacts_still_gate(self):
+        """BENCH_r0* rounds predate the routing keys; None matches
+        None so the checked-in MoE trajectory keeps gating."""
+        def art(name, value):
+            return PG._validate(name, {"moe_tokens_per_sec": value,
+                                       "moe_params_m": 100.0})
+
+        assert [f.rule for f in PG.diff(
+            [art("base", 30_000.0)], art("cand", 15_000.0),
+            PG.Tolerances())] == ["PERF001"]
+
 
 class TestSchema:
     META = {"schema_version": 1, "jax_version": "0.4.37",

@@ -405,3 +405,27 @@ class TestLintClean:
         report = engine.run_analysis([pkg])
         assert report.findings == [], \
             [f.format() for f in report.findings]
+
+
+class TestMoeSeriesSchema:
+    """MOE_SERIES (ISSUE 16): the hvd_moe_* namespace is closed — the
+    three dispatch-plane series validate, anything else is a schema
+    error (the fused-launch counter rides the open hvd_pallas
+    namespace instead)."""
+
+    def _snap(self, gauges):
+        return {"schema_version": 1, "kind": "hvdtel_snapshot",
+                "run_id": "r", "generation": 0, "step": 0,
+                "counters": {}, "histograms": {}, "gauges": gauges}
+
+    def test_known_moe_series_validate(self):
+        snap = self._snap({
+            "hvd_moe_drop_fraction": 0.004,
+            "hvd_moe_expert_utilization{expert=\"3\"}": 0.12,
+            "hvd_moe_ep_wire_bytes": 122880.0})
+        assert metrics_schema.validate_snapshot(snap) == []
+
+    def test_unknown_moe_series_rejected(self):
+        snap = self._snap({"hvd_moe_router_entropy": 1.0})
+        errs = metrics_schema.validate_snapshot(snap)
+        assert any("MOE_SERIES" in e for e in errs), errs
