@@ -488,7 +488,9 @@ def score_exchange_schedule(point: Dict,
                             n_ici: int = 1,
                             compute_s: float = 0.0,
                             hw: HardwareModel = V5E,
-                            n_tiles: int = FUSED_TILE_COUNT
+                            n_tiles: int = FUSED_TILE_COUNT,
+                            sp_attn_wire_s: float = 0.0,
+                            sp_attn_compute_s: float = 0.0
                             ) -> Optional[float]:
     """Rank one autotune sample point by its predicted *exposed*
     exchange seconds (negated — higher is better, matching the
@@ -502,7 +504,14 @@ def score_exchange_schedule(point: Dict,
     single-scope wire in flat (the flat quantized path compresses ICI
     too).  A ``plan`` knob reprices the exchange under that plan's
     factorization and adds the pipeline bubble penalty
-    (:func:`plan_cost_s`).  Returns ``None`` when the point carries no
+    (:func:`plan_cost_s`); a plan with ``sp>1`` additionally charges
+    the attention K/V ring — ``sp_attn_wire_s``/``sp_attn_compute_s``
+    (from :func:`sp_ring_wire_bytes` / :func:`sp_attention_compute_s`,
+    priced for sp=1 by the caller and rescaled here to the sampled
+    extent) exposed per :func:`sp_ring_exposed_s`, fused when the
+    point's ``fused_collectives`` is ``"on"`` — the fused-vs-unfused
+    ring the dp×sp autotune prunes on.  Returns ``None`` when the
+    point carries no
     exchange knob at all — the caller then skips pruning entirely (the
     ParameterManager ``predict=`` contract: a predictor that cannot
     rank must not narrow the grid)."""
@@ -526,9 +535,22 @@ def score_exchange_schedule(point: Dict,
         exch = exchange_time_s(wire, hw)
         if fused == "on":
             exch = fused_tail_exchange_s(exch, compute_s, n_tiles)
+        sp_cost = 0.0
+        if ext["sp"] > 1 and (sp_attn_wire_s or sp_attn_compute_s):
+            # inputs are the sp=1 (whole-sequence, one-chip) quantities:
+            # wire = seconds to move the full K+V once at ICI rate,
+            # compute = the full t_global² attention; the sampled sp
+            # extent rescales them — per-chip ring wire is the
+            # (sp−1)/sp ring factor of the full volume, per-chip
+            # compute divides by sp (each rank owns t_global/sp queries)
+            sp_w = float(sp_attn_wire_s) * _ring_factor(ext["sp"])
+            sp_c = float(sp_attn_compute_s) / ext["sp"]
+            sp_cost = sp_c + sp_ring_exposed_s(
+                sp_w, sp_c, ext["sp"], fused=(fused == "on"))
         # penalty form of the bubble stretch: the constant compute_s
         # offset cancels in the ranking
-        return -(float(compute_s) * bubble / (1.0 - bubble) + exch)
+        return -(float(compute_s) * bubble / (1.0 - bubble) + exch
+                 + sp_cost)
     hierarchy = hierarchy if hierarchy in ("flat", "two_level") else "flat"
     wire = exchange_wire_bytes(float(payload_bytes), n_dcn=n_dcn,
                                n_ici=n_ici, hierarchy=hierarchy,
@@ -541,6 +563,64 @@ def score_exchange_schedule(point: Dict,
     if fused == "on":
         return -fused_tail_exchange_s(serial, compute_s, n_tiles)
     return -serial
+
+
+# -- sequence-parallel (sp ring) pricing ------------------------------------
+
+
+def sp_ring_wire_bytes(seq_local: int, heads: int, head_dim: int,
+                       sp: int, batch: int = 1,
+                       elem_bits: int = 32) -> float:
+    """Per-chip K/V ring wire bytes of one sp attention forward.
+
+    Each of the ``sp−1`` ring hops moves this chip's K *and* V block
+    (``b·t_local·h·d`` elements each):
+    ``2·(sp−1)·b·t_local·h·d·elem_bytes``.  The fused ring-flash path
+    moves exactly the same bytes as the jnp formulation — fusion
+    changes the *exposure* (:func:`sp_ring_exposed_s`), never the
+    volume — so this is the honest wire gauge for both schedules.
+    ``sp <= 1`` prices 0 (the sequence is local, nothing crosses the
+    wire)."""
+    sp = max(1, int(sp))
+    if sp == 1:
+        return 0.0
+    block = (max(1, int(batch)) * int(seq_local) * int(heads)
+             * int(head_dim) * (elem_bits / 8.0))
+    return 2.0 * (sp - 1) * block
+
+
+def sp_attention_compute_s(seq_global: int, heads: int, head_dim: int,
+                           sp: int, batch: int = 1,
+                           causal: bool = False,
+                           hw: HardwareModel = V5E) -> float:
+    """Per-chip attention forward seconds under ``sp``-way sequence
+    parallelism: the full ``4·b·t_global²·h·d`` FLOPs (QKᵀ + PV, two
+    FLOPs per MAC) divide evenly over the sp ranks — each rank's
+    ``t_global/sp`` queries visit every K/V block exactly once around
+    the ring.  ``causal`` halves the live score area (under the zigzag
+    layout the halving is per-rank exact; under the contiguous layout
+    it holds in aggregate while the per-rank work skews — see
+    ``ops.pallas_kernels.ring_step_schedule``)."""
+    flops = (4.0 * max(1, int(batch)) * float(seq_global) ** 2
+             * int(heads) * int(head_dim)) / max(1, int(sp))
+    if causal:
+        flops *= 0.5
+    return flops / hw.peak_flops_per_s
+
+
+def sp_ring_exposed_s(wire_s: float, compute_s: float, sp: int,
+                      fused: bool = True) -> float:
+    """Exposed (un-overlapped) seconds of the sp K/V ring: the fused
+    ring-flash path pre-issues the next block's ``ppermute`` before
+    the current block's flash kernel, so hop *k* hides under block
+    *k*'s compute — the serial-tail credit is exactly
+    :func:`fused_tail_exchange_s` with the ring's ``sp`` steps as
+    tiles; unfused (the jnp scan), every hop sits serially between
+    steps and the whole wire is exposed."""
+    if not fused:
+        return max(0.0, float(wire_s))
+    return fused_tail_exchange_s(wire_s, compute_s,
+                                 n_tiles=max(1, int(sp)))
 
 
 # -- MoE expert-dispatch pricing --------------------------------------------

@@ -36,6 +36,14 @@ HLO006     serial boundary-wide MoE dispatch: an ``all-to-all``
            ``ep>1`` plan yet still reporting serial all-to-alls — the
            a2a ⊗ expert-matmul ring's mirror of HLO005
            (docs/fused_kernels.md "Expert-parallel dispatch")
+HLO007     serial/de-fused sp attention ring: a ``collective-permute``
+           start..done window with no compute inside it (HLO text — a
+           K/V hop the flash compute should be hiding), or an artifact
+           claiming ``sp>1`` fused ring attention yet reporting serial
+           tail permutes, any full-sequence attention all-gather, or
+           fewer than ``2·(sp−1)`` ring permutes — the ring-flash
+           mirror of HLO005/HLO006 (docs/fused_kernels.md "Ring-flash
+           attention")
 =========  ==============================================================
 """
 
@@ -161,6 +169,19 @@ def lint_hlo_text(text: str,
             "window has no compute scheduled inside it — the expert "
             "exchange is fully exposed (enable the fused a2a ⊗ "
             "expert-matmul dispatch, docs/fused_kernels.md)"))
+
+    # HLO007 — serial sp attention ring hop: a collective-permute whose
+    # start..done window holds no compute is a K/V hop the flash
+    # kernel's compute should be hiding (the double-buffered ring-flash
+    # schedule issues the next hop before the current block's kernel —
+    # same judgment rule as HLO005/HLO006, pointed at the ring wire)
+    if H.serial_tail_collectives(text, kinds=("collective-permute",)):
+        findings.append(HloFinding(
+            "HLO007",
+            "serial sp ring hop: the final collective-permute "
+            "start..done window has no compute scheduled inside it — "
+            "the K/V exchange is fully exposed (enable the fused "
+            "ring-flash attention, docs/fused_kernels.md)"))
     return findings
 
 
@@ -242,6 +263,43 @@ def lint_artifact(artifact: Dict) -> List[HloFinding]:
                 f"{moe_serial} serial boundary-wide all-to-all(s) — "
                 f"the a2a ⊗ expert-matmul ring is not reaching the "
                 f"wire"))
+        # HLO007 — an sp>1 run that claims the fused ring-flash
+        # attention is ON must show a clean ring: zero full-sequence
+        # attention all-gathers, zero serial tail permutes, and at
+        # least 2·(sp−1) collective-permutes when the probe counted
+        # them (K and V each hop sp−1 times; legacy artifacts without
+        # the fields pass vacuously, sp<=1 or fused off is the
+        # expected jnp/unfused schedule)
+        sp_fused = artifact.get(f"{prefix}sp_fused_collectives")
+        sp_ext = artifact.get(f"{prefix}sp")
+        if sp_fused == "on" and sp_ext and int(sp_ext) > 1:
+            sp_serial = artifact.get(
+                f"{prefix}sp_serial_tail_permutes")
+            sp_ag = artifact.get(f"{prefix}sp_attention_allgathers")
+            sp_perms = artifact.get(f"{prefix}sp_collective_permutes")
+            if sp_serial:
+                findings.append(HloFinding(
+                    "HLO007",
+                    f"[{label}] sp_fused_collectives=on for an "
+                    f"sp={sp_ext} plan but the probe still found "
+                    f"{sp_serial} serial collective-permute window(s) "
+                    f"— the K/V ring is not hiding under the flash "
+                    f"compute"))
+            if sp_ag:
+                findings.append(HloFinding(
+                    "HLO007",
+                    f"[{label}] sp={sp_ext} fused ring attention but "
+                    f"the probe found {sp_ag} full-sequence "
+                    f"all-gather(s) on the attention path — the ring "
+                    f"degenerated to gather-everything"))
+            if sp_perms is not None and \
+                    int(sp_perms) < 2 * (int(sp_ext) - 1):
+                findings.append(HloFinding(
+                    "HLO007",
+                    f"[{label}] sp={sp_ext} fused ring attention "
+                    f"compiled only {sp_perms} collective-permute(s) — "
+                    f"expected >= 2·(sp−1) = {2 * (int(sp_ext) - 1)} "
+                    f"(K and V each hop sp−1 times)"))
     return findings
 
 

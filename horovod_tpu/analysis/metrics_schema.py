@@ -123,6 +123,18 @@ MOE_SERIES = frozenset({
     "hvd_moe_ep_wire_bytes",
 })
 
+# the sequence-parallel (sp ring) plane's closed series vocabulary
+# (docs/fused_kernels.md "Ring-flash attention"): the K/V ring wire
+# gauge and the causal launch schedule counters in the hvd_sp_*
+# namespace.  As with MoE, the fused-launch counter lives in the open
+# hvd_pallas namespace
+# (hvd_pallas_fused_launches_total{kernel="ring_flash_attention"})
+SP_SERIES = frozenset({
+    "hvd_sp_ring_wire_bytes",
+    "hvd_sp_ring_steps",
+    "hvd_sp_skipped_ring_steps",
+})
+
 
 def _check_guard_series(errors: List[str], obj, field: str) -> None:
     if not isinstance(obj, dict):
@@ -194,6 +206,18 @@ def _check_moe_series(errors: List[str], obj, field: str) -> None:
                 errors.append(
                     f"{field}[{k!r}]: unknown moe series {base!r} — "
                     f"not in metrics_schema.MOE_SERIES")
+
+
+def _check_sp_series(errors: List[str], obj, field: str) -> None:
+    if not isinstance(obj, dict):
+        return      # shape error already reported by _check_series_map
+    for k in obj:
+        if isinstance(k, str) and k.startswith("hvd_sp_"):
+            base = k.split("{", 1)[0]
+            if base not in SP_SERIES:
+                errors.append(
+                    f"{field}[{k!r}]: unknown sp series {base!r} — "
+                    f"not in metrics_schema.SP_SERIES")
 
 
 def _check_series_map(errors: List[str], obj, field: str) -> None:
@@ -280,6 +304,9 @@ def validate_snapshot(obj: Dict) -> List[str]:
     _check_moe_series(errors, obj.get("counters", {}), "counters")
     _check_moe_series(errors, obj.get("gauges", {}), "gauges")
     _check_moe_series(errors, obj.get("histograms", {}), "histograms")
+    _check_sp_series(errors, obj.get("counters", {}), "counters")
+    _check_sp_series(errors, obj.get("gauges", {}), "gauges")
+    _check_sp_series(errors, obj.get("histograms", {}), "histograms")
     return errors
 
 
@@ -299,6 +326,7 @@ def validate_bench_metrics(obj: Dict) -> List[str]:
     _check_degrade_series(errors, obj.get("counters", {}), "metrics.counters")
     _check_memory_series(errors, obj.get("counters", {}), "metrics.counters")
     _check_moe_series(errors, obj.get("counters", {}), "metrics.counters")
+    _check_sp_series(errors, obj.get("counters", {}), "metrics.counters")
     return errors
 
 

@@ -79,7 +79,12 @@ _V1_IDENTITY = ("platform", "device_kind", "n_devices", "mesh_shape")
 #: schedules, not a regression (bench.py --plan; docs/parallelism.md)
 THROUGHPUT_FIELDS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("value", ("metric", "plan")),
-    ("transformer_tokens_per_sec", ("transformer_params_m", "plan")),
+    # sp extent + sequence length guard the transformer diff: an
+    # sp=2 seq-4096 long-context run against an sp=1 seq-512 one
+    # measures a different attention schedule and a t²-different
+    # FLOP mix, never a regression (bench.py --plan dp×sp)
+    ("transformer_tokens_per_sec",
+     ("transformer_params_m", "plan", "sp", "transformer_seq_len")),
     # routing config guards the MoE diff: a capacity-factor or ep-extent
     # change is a schedule change (different dispatch geometry + drop
     # behavior), never a throughput regression

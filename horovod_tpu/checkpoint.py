@@ -322,9 +322,12 @@ class Checkpointer:
         grammar string) stamps the parallelism plan the state was
         trained under into every shard payload, letting
         :meth:`restore_sharded` reshard across *plan* changes — the
-        data extent (dp×fsdp) may change freely; a changed
-        model-parallel factorization (pp/ep/sp/tp) is refused there
-        instead of silently mis-slicing (docs/parallelism.md)."""
+        data extent (dp×fsdp) may change freely, and so may ``sp``:
+        sequence parallelism shards *activations*, not parameters, so
+        for the saved state sp is data-free and the flat-buffer reshard
+        covers it.  A changed model-parallel factorization (pp/ep/tp)
+        is refused there instead of silently mis-slicing
+        (docs/parallelism.md)."""
         if not 0 <= shard_rank < shard_count:
             raise ValueError(
                 f"shard_rank {shard_rank} out of range for "
@@ -523,10 +526,13 @@ class Checkpointer:
 
         ``plan`` names the *restoring* run's plan.  When the checkpoint
         carries a saved plan (:meth:`save_sharded` ``plan=``), the
-        model-parallel extents (pp/ep/sp/tp) must match — those change
+        model-parallel extents (pp/ep/tp) must match — those change
         the parameter tensors themselves, which no flat-buffer reshard
-        can fix — while the data extent (dp×fsdp) reshards exactly like
-        a world-size change."""
+        can fix — while the data extent (dp×fsdp) *and* the sp extent
+        reshard exactly like a world-size change: sp shards the
+        sequence (activations), so every sp rank holds the same
+        parameter/optimizer values and the exchange treats sp as one
+        more data axis (docs/parallelism.md)."""
         self.wait()
         if step is None:
             step = self._resolve_step()
@@ -607,28 +613,33 @@ def _canonical_plan(plan: Any, shard_count: int) -> Optional[str]:
 
     p = as_plan(plan)
     if p.dp is not None:
-        data_extent = p.dp * p.fsdp
+        # sp counts: sequence parallelism shards activations, not
+        # parameters, so the sharded state spreads over dp×fsdp×sp
+        # ranks (sp joined the exchange scope in the train step)
+        data_extent = p.dp * p.fsdp * p.sp
         if data_extent != shard_count:
             raise ValueError(
                 f"plan {p.to_string()} shards the exchange over "
-                f"dp*fsdp={data_extent} ranks, but shard_count is "
+                f"dp*fsdp*sp={data_extent} ranks, but shard_count is "
                 f"{shard_count}")
     return p.to_string(allow_unresolved=True)
 
 
 def _check_plan_reshard(saved: str, restoring: str, path: str) -> None:
     """Refuse cross-plan restores that change the model-parallel
-    factorization: pp/ep/sp/tp extents reshape the parameter tensors
+    factorization: pp/ep/tp extents reshape the parameter tensors
     themselves, so the flat-buffer reshard of :func:`_reshard_leaf`
-    would slice garbage.  Data-extent (dp/fsdp) and virtual-stage
-    changes reshard fine."""
+    would slice garbage.  Data-extent (dp/fsdp), ``sp`` (sequence
+    parallelism shards activations — parameters are identical on every
+    sp rank, so for the saved state sp is just more data extent) and
+    virtual-stage changes reshard fine."""
     from horovod_tpu.parallel.plan import ShardingPlan
 
     sp = ShardingPlan.from_string(saved.replace("dp=?", "dp=1")
                                   if "dp=?" in saved else saved)
     rp = ShardingPlan.from_string(restoring.replace("dp=?", "dp=1")
                                   if "dp=?" in restoring else restoring)
-    model_axes = ("pp", "ep", "sp", "tp")
+    model_axes = ("pp", "ep", "tp")
     mismatch = [ax for ax in model_axes
                 if getattr(sp, ax) != getattr(rp, ax)]
     if mismatch:
@@ -636,8 +647,8 @@ def _check_plan_reshard(saved: str, restoring: str, path: str) -> None:
             f"sharded checkpoint in {path} was saved under plan "
             f"{saved!r} but the restore runs plan {restoring!r}: "
             f"model-parallel extents differ on {mismatch} — resharding "
-            f"only covers data-extent (dp/fsdp) changes; re-partition "
-            f"the model to change pp/ep/sp/tp")
+            f"only covers data-extent (dp/fsdp/sp) changes; "
+            f"re-partition the model to change pp/ep/tp")
 
 
 def _load_shards(path: str) -> list:

@@ -64,9 +64,21 @@ class TransformerConfig:
     remat_policy: Optional[str] = None
     # tile-fused matmul⊗collective kernels at the tp boundaries
     # (HOROVOD_FUSED_COLLECTIVES, docs/fused_kernels.md) — consumed by
-    # :func:`fused_tp_apply`, the explicit shard_map execution mode;
-    # the GSPMD modules below ignore it (XLA owns their collectives)
+    # :func:`fused_tp_apply`, the explicit shard_map execution mode,
+    # and by the ring attention dispatch (``attention_impl="ring"``:
+    # "auto" defers to HOROVOD_SP_FUSED_RING / HOROVOD_FUSED_COLLECTIVES
+    # so env knobs stay live; "on"/"off" here wins).  The GSPMD modules
+    # below ignore it (XLA owns their collectives)
     fused_collectives: str = "auto"     # auto | on | off
+    # sp sequence layout for the ring path — None defers to
+    # HOROVOD_SP_LAYOUT (default "contiguous"); "zigzag" load-balances
+    # the causal mask across ranks (docs/fused_kernels.md)
+    sp_layout: Optional[str] = None     # None | contiguous | zigzag
+    # run the flash/ring-flash Pallas kernels in interpreter mode so
+    # the CPU twin exercises the REAL blocked memory behavior instead
+    # of the dense jnp fallback (which materializes the (T, T) scores
+    # the kernels exist to avoid) — bench/test plumbing, never on-TPU
+    flash_interpret: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -147,9 +159,18 @@ class Attention(nn.Module):
 
             o = flash_attention(q, k, v, causal=cfg.causal,
                                 block_q=cfg.flash_block,
-                                block_k=cfg.flash_block)
+                                block_k=cfg.flash_block,
+                                interpret=cfg.flash_interpret)
         elif cfg.attention_impl == "ring":
-            o = ring_attention(q, k, v, cfg.sp_axis, causal=cfg.causal)
+            # "auto" stays None so the HOROVOD_SP_* env knobs resolve
+            # inside the dispatch; an explicit config "on"/"off" wins
+            o = ring_attention(
+                q, k, v, cfg.sp_axis, causal=cfg.causal,
+                fused=(None if cfg.fused_collectives == "auto"
+                       else cfg.fused_collectives),
+                layout=cfg.sp_layout,
+                block_q=cfg.flash_block, block_k=cfg.flash_block,
+                interpret=cfg.flash_interpret)
         elif cfg.attention_impl == "ulysses":
             o = ulysses_attention(q, k, v, cfg.sp_axis, causal=cfg.causal)
         else:

@@ -81,18 +81,30 @@ def fused_scale(x: jax.Array, factor: float,
 # flash attention (forward + blockwise backward kernels)
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                      causal: bool, scale: float):
-    # blocks: q (1, BQ, D); k/v (1, T, D); o (1, BQ, D)
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k: int,
+                      causal: bool, scale: float, positions: bool = False):
+    # blocks: q (1, BQ, D); k/v (1, T, D); o (1, BQ, D).  With
+    # ``positions`` two extra int32 inputs ride along in the lse layout
+    # (qpos (1, 8, BQ), kpos (1, 8, T)): GLOBAL sequence positions, so
+    # the causal mask stays correct when this kernel consumes a ring
+    # shard whose rows are not local-index-contiguous (the sp ring's
+    # zigzag layout, :func:`ring_flash_attention`).
     # inputs stay in their native dtype (bf16): the MXU runs bf16 x bf16
     # at full rate with fp32 accumulation via preferred_element_type —
     # casting to fp32 first would forfeit the systolic-array rate
+    if positions:
+        qpos_ref, kpos_ref, o_ref, lse_ref = rest
+    else:
+        o_ref, lse_ref = rest
     q = q_ref[0]                                      # (BQ, D)
     block_q, d = q.shape
     t = k_ref.shape[1]
     qi = pl.program_id(1)
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
+    if positions:
+        q_pos = qpos_ref[0, 0][:, None]               # (BQ, 1) global
+    else:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
 
     def body(kb, carry):
         o, m, l = carry
@@ -100,8 +112,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
+            if positions:
+                k_pos = kpos_ref[0, 0, pl.ds(kb * block_k,
+                                             block_k)][None, :]
+            else:
+                k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
             mask = q_pos >= k_pos
             s = jnp.where(mask, s, _NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1))
@@ -117,10 +133,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         return o_new, m_new, l_new
 
     num_k = t // block_k
-    if causal:
+    if causal and not positions:
         # skip blocks strictly above the diagonal (their mask is
         # all-false); ceil-divide — flooring would drop the partially
-        # live diagonal block whenever block_q is not a block_k multiple
+        # live diagonal block whenever block_q is not a block_k multiple.
+        # With explicit positions the layout is arbitrary (zigzag), so
+        # no diagonal exists to skip — every block runs, masked per row.
         num_k_live = ((qi + 1) * block_q + block_k - 1) // block_k
         num_k = jnp.minimum(num_k, jnp.maximum(num_k_live, 1))
     o0 = jnp.zeros((block_q, d), jnp.float32)
@@ -147,19 +165,38 @@ def _bh_layout(q, k, v):
     return to_bh(q), to_bh(k), to_bh(v)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _pos_layout(pos):
+    """A (t,) position vector in the lse residual layout (1, 8, t):
+    int32 replicated over the 8-sublane axis (Mosaic tiling contract —
+    same stance as the lse/delta blocks)."""
+    t = pos.shape[0]
+    return jnp.broadcast_to(pos.astype(jnp.int32)[None, None, :],
+                            (1, 8, t))
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+               qpos=None, kpos=None):
     b, t, h, d = q.shape
     qb, kb, vb = _bh_layout(q, k, v)
     grid = (b * h, t // block_q)
+    positions = qpos is not None
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+        pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+    ]
+    args = [qb, kb, vb]
+    if positions:
+        in_specs += [
+            pl.BlockSpec((1, 8, block_q), lambda bh, qi: (0, 0, qi)),
+            pl.BlockSpec((1, 8, t), lambda bh, qi: (0, 0, 0)),
+        ]
+        args += [_pos_layout(qpos), _pos_layout(kpos)]
     out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, block_k=block_k,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, positions=positions),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, 8, block_q), lambda bh, qi: (bh, 0, qi)),
@@ -169,16 +206,22 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((b * h, 8, t), jnp.float32),
         ],
         interpret=interpret,
-    )(qb, kb, vb)
+    )(*args)
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3), lse
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, block_k: int, causal: bool,
-                         scale: float):
+                         *rest, block_k: int, causal: bool,
+                         scale: float, positions: bool = False):
     """dQ for one Q block: stream K/V blocks, rebuild p from the saved
     logsumexp, accumulate dq = Σ ds·K·scale (FlashAttention-2 backward,
-    dS = P ∘ (dP − delta) with delta = rowsum(dO ∘ O))."""
+    dS = P ∘ (dP − delta) with delta = rowsum(dO ∘ O)).  With
+    ``positions``, qpos/kpos inputs carry GLOBAL sequence positions and
+    the causal mask compares those (the sp ring's arbitrary layouts)."""
+    if positions:
+        qpos_ref, kpos_ref, dq_ref = rest
+    else:
+        (dq_ref,) = rest
     q = q_ref[0]                              # (BQ, D) native dtype
     do = do_ref[0]                            # (BQ, D)
     lse = lse_ref[0, 0]                       # (BQ,) (sublane 0)
@@ -186,16 +229,23 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     block_q, d = q.shape
     t = k_ref.shape[1]
     qi = pl.program_id(1)
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
+    if positions:
+        q_pos = qpos_ref[0, 0][:, None]       # (BQ, 1) global
+    else:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
 
     def body(kb, dq):
         k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
         v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
+            if positions:
+                k_pos = kpos_ref[0, 0, pl.ds(kb * block_k,
+                                             block_k)][None, :]
+            else:
+                k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
             mask = q_pos >= k_pos
             s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
@@ -207,7 +257,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                             preferred_element_type=jnp.float32) * scale
 
     num_k = t // block_k
-    if causal:
+    if causal and not positions:
         # ceil-divide: see the forward kernel's diagonal-block note
         num_k_live = ((qi + 1) * block_q + block_k - 1) // block_k
         num_k = jnp.minimum(num_k, jnp.maximum(num_k_live, 1))
@@ -217,18 +267,27 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, block_q: int, causal: bool,
-                          scale: float):
+                          *rest, block_q: int, causal: bool,
+                          scale: float, positions: bool = False):
     """dK/dV for one K block: stream Q/dO blocks; dV = Σ pᵀ·dO,
     dK = Σ dsᵀ·Q·scale.  Causal: Q blocks strictly above the diagonal
-    contribute nothing and are skipped."""
+    contribute nothing and are skipped — except under ``positions``
+    (global, possibly non-contiguous row positions), where no diagonal
+    exists and every block runs with its per-row mask."""
+    if positions:
+        qpos_ref, kpos_ref, dk_ref, dv_ref = rest
+    else:
+        dk_ref, dv_ref = rest
     k = k_ref[0]                              # (BK, D) native dtype
     v = v_ref[0]                              # (BK, D)
     block_k, d = k.shape
     t = q_ref.shape[1]
     ki = pl.program_id(1)
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
+    if positions:
+        k_pos = kpos_ref[0, 0][None, :]       # (1, BK) global
+    else:
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
 
     def body(qb, carry):
         dk, dv = carry
@@ -238,8 +297,12 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta_blk = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
         s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
+            if positions:
+                q_pos = qpos_ref[0, 0, pl.ds(qb * block_q,
+                                             block_q)][:, None]
+            else:
+                q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
             mask = q_pos >= k_pos
             s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse_blk[:, None])
@@ -254,7 +317,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         return dk, dv
 
     start = 0
-    if causal:
+    if causal and not positions:
         # first Q block that reaches this K block's diagonal
         start = (ki * block_k) // block_q
     zeros = jnp.zeros((block_k, d), jnp.float32)
@@ -264,46 +327,71 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-               interpret):
+               interpret, qpos=None, kpos=None, delta=None):
+    """FlashAttention-2 blockwise backward.  ``lse``/``delta`` may be
+    GLOBAL quantities (the sp ring: softmax over the whole ring's keys)
+    — the FA2 decomposition is exact per K/V block given the global
+    logsumexp, which is what lets :func:`ring_flash_attention` reuse
+    these kernels per visiting block.  ``delta`` defaults to
+    rowsum(dO ∘ O) of the given out/g; pass a precomputed ``(b·h, t)``
+    row-sum to avoid recomputing it once per ring step."""
     b, t, h, d = q.shape
     qb, kb, vb = _bh_layout(q, k, v)
     do = g.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    ob = out.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    # delta = rowsum(dO ∘ O): tiny elementwise pass, XLA fuses it;
+    positions = qpos is not None
+    if delta is None:
+        ob = out.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        # delta = rowsum(dO ∘ O): tiny elementwise pass, XLA fuses it
+        delta = (do.astype(jnp.float32) *
+                 ob.astype(jnp.float32)).sum(-1)
     # replicated to the same 8-sublane layout as lse (tiling contract)
-    delta = jnp.broadcast_to(
-        (do.astype(jnp.float32) * ob.astype(jnp.float32)).sum(-1)[:, None],
-        (b * h, 8, t))
+    delta = jnp.broadcast_to(delta[:, None, :], (b * h, 8, t))
 
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+        pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+        pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, 8, block_q), lambda bh, qi: (bh, 0, qi)),
+        pl.BlockSpec((1, 8, block_q), lambda bh, qi: (bh, 0, qi)),
+    ]
+    dq_args = [qb, kb, vb, do, lse, delta]
+    if positions:
+        dq_in_specs += [
+            pl.BlockSpec((1, 8, block_q), lambda bh, qi: (0, 0, qi)),
+            pl.BlockSpec((1, 8, t), lambda bh, qi: (0, 0, 0)),
+        ]
+        dq_args += [_pos_layout(qpos), _pos_layout(kpos)]
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, positions=positions),
         grid=(b * h, t // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, 8, block_q), lambda bh, qi: (bh, 0, qi)),
-            pl.BlockSpec((1, 8, block_q), lambda bh, qi: (bh, 0, qi)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
         interpret=interpret,
-    )(qb, kb, vb, do, lse, delta)
+    )(*dq_args)
 
+    dkv_in_specs = [
+        pl.BlockSpec((1, t, d), lambda bh, ki: (bh, 0, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, t, d), lambda bh, ki: (bh, 0, 0)),
+        pl.BlockSpec((1, 8, t), lambda bh, ki: (bh, 0, 0)),
+        pl.BlockSpec((1, 8, t), lambda bh, ki: (bh, 0, 0)),
+    ]
+    dkv_args = [qb, kb, vb, do, lse, delta]
+    if positions:
+        dkv_in_specs += [
+            pl.BlockSpec((1, 8, t), lambda bh, ki: (0, 0, 0)),
+            pl.BlockSpec((1, 8, block_k), lambda bh, ki: (0, 0, ki)),
+        ]
+        dkv_args += [_pos_layout(qpos), _pos_layout(kpos)]
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, positions=positions),
         grid=(b * h, t // block_k),
-        in_specs=[
-            pl.BlockSpec((1, t, d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, 8, t), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, 8, t), lambda bh, ki: (bh, 0, 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
@@ -313,12 +401,35 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
             jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
         ],
         interpret=interpret,
-    )(qb, kb, vb, do, lse, delta)
+    )(*dkv_args)
 
     def from_bh(x):
         return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
     return from_bh(dq), from_bh(dk), from_bh(dv)
+
+
+def fit_flash_block(t: int, requested: int) -> Optional[int]:
+    """Largest flash block ≤ ``requested`` that divides ``t`` — a seq
+    len that is a multiple of 128 but not of the (large) default must
+    shrink the block, not fall back to the dense O(T²) path.  Sequences
+    shorter than one tile run as a single block (small-shape tests and
+    probes); other non-128-multiples return ``None`` (the caller's
+    dense/jnp fallback) — sub-tile blocks on real bf16 inputs are
+    Mosaic-lowering risk.  Shared by :func:`flash_attention` and the
+    :func:`ring_flash_attention` dispatch in
+    :mod:`~horovod_tpu.parallel.ring_attention`."""
+    if t <= 128:
+        b = min(requested, t)
+        if t % b == 0:
+            return b
+        # ragged small seq: a single whole-sequence block if it
+        # tiles, else the dense fallback
+        return t if t % 8 == 0 else None
+    for cand in (requested, 512, 256, 128):
+        if cand <= t and t % cand == 0:
+            return cand
+    return None
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -339,27 +450,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     b, t, h, d = q.shape
     scale = d ** -0.5 if scale is None else scale
 
-    def fit_block(requested: int) -> Optional[int]:
-        """Largest block ≤ requested that divides ``t`` — a seq len that
-        is a multiple of 128 but not of the (large) default must shrink
-        the block, not fall back to the dense O(T²) path.  Sequences
-        shorter than one tile run as a single block (small-shape tests
-        and probes); other non-128-multiples keep the dense fallback —
-        sub-tile blocks on real bf16 inputs are Mosaic-lowering risk."""
-        if t <= 128:
-            b = min(requested, t)
-            if t % b == 0:
-                return b
-            # ragged small seq: a single whole-sequence block if it
-            # tiles, else the dense fallback
-            return t if t % 8 == 0 else None
-        for cand in (requested, 512, 256, 128):
-            if cand <= t and t % cand == 0:
-                return cand
-        return None
-
-    block_q = fit_block(block_q)
-    block_k = fit_block(block_k)
+    block_q = fit_flash_block(t, block_q)
+    block_k = fit_flash_block(t, block_k)
     usable = (interpret or _on_tpu()) and \
         block_q is not None and block_k is not None
     if not usable:
@@ -927,3 +1019,287 @@ def allgather_matmul(x: jax.Array, w: jax.Array, axis: str,
         if s < world - 1:
             cur = lax.ppermute(cur, axis, perm)
     return out
+
+
+# ---------------------------------------------------------------------------
+# ring-flash attention: the sp ring fused with the flash kernels
+# ---------------------------------------------------------------------------
+#
+# The naive jnp ring (parallel/ring_attention.py) materializes a full
+# (b, h, tq, tk) fp32 score tensor per visiting block and leaves each
+# ppermute serial between steps.  Here every visiting K/V block runs
+# the Pallas flash kernels instead — the online-softmax partials merge
+# across ring steps in log-space, so no per-block score tensor exists
+# and nothing upcasts to fp32 beyond the flash accumulator — while the
+# NEXT block's ppermute is issued before the current block's kernel
+# (data-independent sends, the same double-buffering contract as
+# expert_alltoall_ffn's dispatch ring).  docs/fused_kernels.md
+# "Ring-flash attention".
+
+#: Sequence layouts the sp ring understands (``HOROVOD_SP_LAYOUT``).
+RING_LAYOUTS = ("contiguous", "zigzag")
+
+
+def ring_layout_positions(rank, world: int, seq_local: int,
+                          layout: str) -> jax.Array:
+    """Global sequence positions shard ``rank`` holds under ``layout``.
+
+    ``contiguous``: shard r is global chunk r of ``world`` chunks.
+    ``zigzag``: shard r holds chunks ``(r, 2·world−1−r)`` of ``2·world``
+    equal chunks — pairing an early (causally busy) chunk with a late
+    one so the causal mask load-balances across ranks, and no causal
+    ring step is ever fully masked: the low chunk of any rank precedes
+    the high chunk of every rank, so every (q shard, k/v shard) pair
+    has at least one allowed position.  ``rank`` may be a traced
+    ``lax.axis_index``.
+    """
+    if layout not in RING_LAYOUTS:
+        raise ValueError(
+            f"sp layout must be one of {RING_LAYOUTS}, got {layout!r}")
+    if layout == "contiguous":
+        return rank * seq_local + jnp.arange(seq_local, dtype=jnp.int32)
+    if seq_local % 2:
+        raise ValueError(
+            f"zigzag layout needs an even per-shard seq, got {seq_local}")
+    half = seq_local // 2
+    ar = jnp.arange(half, dtype=jnp.int32)
+    return jnp.concatenate(
+        [rank * half + ar, (2 * world - 1 - rank) * half + ar])
+
+
+def zigzag_sequence_indices(world: int, seq_global: int) -> jax.Array:
+    """Permutation σ with ``x_zigzag = x[σ]`` along the sequence dim.
+
+    Contiguous (rank-major) sharding of the permuted sequence hands
+    shard r exactly its zigzag chunks ``(r, 2·world−1−r)`` — the
+    host-side pre-pass that makes the zigzag layout a pure relabeling
+    (undo on outputs with ``jnp.argsort`` of the same indices)."""
+    if seq_global % (2 * world):
+        raise ValueError(
+            f"zigzag needs seq divisible by 2·world={2 * world}, "
+            f"got {seq_global}")
+    half = seq_global // (2 * world)
+    idx = []
+    for r in range(world):
+        idx.extend(range(r * half, (r + 1) * half))
+        idx.extend(range((2 * world - 1 - r) * half,
+                         (2 * world - r) * half))
+    return jnp.asarray(idx, dtype=jnp.int32)
+
+
+def ring_step_schedule(world: int, causal: bool = False,
+                       layout: str = "contiguous") -> dict:
+    """Static kernel-launch schedule of the sp ring — pure Python.
+
+    A causal (rank, step) pair whose visiting K/V block lies entirely
+    in the query shard's future launches no kernel (the runtime skip in
+    :func:`ring_flash_attention`).  Chunk-level comparison is exact:
+    the whole step is masked iff ``max(q chunk) < min(k/v chunk)``.
+    Under ``contiguous`` that skips ``world·(world−1)/2`` of the
+    ``world²`` launches — all stacked on the low ranks; ``zigzag``
+    skips none because no pair is ever fully masked, and the *partial*
+    mask work balances across ranks instead.  The cost model and the
+    zigzag acceptance pin both read this."""
+    if layout not in RING_LAYOUTS:
+        raise ValueError(
+            f"sp layout must be one of {RING_LAYOUTS}, got {layout!r}")
+
+    def chunks(r):
+        return (r,) if layout == "contiguous" else (r, 2 * world - 1 - r)
+
+    skipped = []
+    for r in range(world):
+        n = 0
+        if causal:
+            qmax = max(chunks(r))
+            for s in range(world):
+                kmin = min(chunks((r - s) % world))
+                if qmax < kmin:
+                    n += 1
+        skipped.append(n)
+    total = sum(skipped)
+    return {
+        "world": world, "causal": causal, "layout": layout,
+        "steps_per_rank": world,
+        "launches": world * world - total,
+        "skipped": total,
+        "skipped_by_rank": tuple(skipped),
+    }
+
+
+def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                         axis_name: str, causal: bool = False,
+                         scale: Optional[float] = None,
+                         layout: str = "contiguous",
+                         block_q: int = 512, block_k: int = 512,
+                         interpret: bool = False) -> jax.Array:
+    """Fused sp-ring ⊗ flash attention over mesh axis ``axis_name``.
+
+    Same contract as :func:`~horovod_tpu.parallel.ring_attention.
+    ring_attention` — call inside ``shard_map`` with ``(batch,
+    seq_local, heads, head_dim)`` shards, returns the exact softmax
+    attention over the full global sequence — but each visiting K/V
+    block is consumed by the Pallas flash kernels and the per-step
+    normalized partials ``(out_s, lse_s)`` merge in log-space::
+
+        lse  = logaddexp(lse, lse_s)
+        out  = out·exp(lse_prev − lse) + out_s·exp(lse_s − lse)
+
+    initialized at the finite ``_NEG_INF`` sentinel, so a fully-masked
+    partial contributes ``exp(−huge) == 0`` exactly and the accumulator
+    can never emit NaN.  The next block's ``ppermute`` is issued before
+    the current block's kernel — the sends are data-independent, so the
+    scheduler double-buffers the wire behind the MXU (the same contract
+    as ``expert_alltoall_ffn``; on the synchronous CPU twin this pins
+    structure, the overlap itself is a TPU quantity).
+
+    Causal masking compares GLOBAL positions that travel around the
+    ring with their blocks, so it composes with the ``zigzag`` layout;
+    a causal ring step whose visiting block is entirely in the future
+    skips its kernel launch via ``lax.cond`` (identity carry — the
+    schedule is in :func:`ring_step_schedule`).
+
+    Differentiable via ``custom_vjp``: FA2's blockwise backward is
+    exact given the GLOBAL logsumexp and delta, so the backward replays
+    the ring with each block's dK/dV accumulator traveling WITH the
+    block — after ``world`` hops every accumulator is home and
+    complete.
+
+    Raises for shards off the flash tiling contract (unequal q/k/v
+    shapes, non-tiling ``seq_local``, odd ``seq_local`` under zigzag)
+    — the dispatch in ``parallel/ring_attention.py`` checks first and
+    keeps the jnp formulation for those.
+    """
+    from jax import lax
+
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(
+            f"ring_flash_attention needs equal q/k/v shard shapes, got "
+            f"{q.shape}/{k.shape}/{v.shape}")
+    b, t, h, d = q.shape
+    world = int(lax.axis_size(axis_name))
+    scale = d ** -0.5 if scale is None else scale
+    bq = fit_flash_block(t, block_q)
+    bk = fit_flash_block(t, block_k)
+    if bq is None or bk is None:
+        raise ValueError(
+            f"seq_local {t} does not fit the flash tiling contract; "
+            f"use the jnp ring (parallel.ring_attention) instead")
+    # validates layout, and zigzag's even-seq requirement (rank 0 is
+    # representative; the traced per-rank positions are rebuilt inside
+    # the vjp halves so no tracer is closed over across them)
+    ring_layout_positions(0, world, t, layout)
+    _count_fused_launch("ring_flash_attention")
+    perm = [(i, (i + 1) % world) for i in range(world)]
+    bh = b * h
+
+    def _positions():
+        me = lax.axis_index(axis_name)
+        qpos = ring_layout_positions(me, world, t, layout)
+        return qpos, jnp.max(qpos)
+
+    def _to_o(w_row):
+        # (bh, t) row weight -> broadcastable over (b, t, h, d)
+        return w_row.reshape(b, h, t).transpose(0, 2, 1)[..., None]
+
+    def _merge(out_acc, lse_acc, out_b, lse_b):
+        # log-space merge of normalized flash partials.  All-finite by
+        # construction: the sentinel is finite, logaddexp of finite
+        # inputs is finite, and exp(_NEG_INF − anything) == 0 exactly.
+        lse_new = jnp.logaddexp(lse_acc, lse_b)
+        out_new = (out_acc * _to_o(jnp.exp(lse_acc - lse_new)) +
+                   out_b.astype(jnp.float32) *
+                   _to_o(jnp.exp(lse_b - lse_new)))
+        return out_new, lse_new
+
+    def _fwd_ring(q, k, v):
+        qpos, q_max = _positions()
+        out_acc = jnp.zeros((b, t, h, d), jnp.float32)
+        lse_acc = jnp.full((bh, t), _NEG_INF, jnp.float32)
+        k_cur, v_cur, kpos_cur = k, v, qpos
+        for s in range(world):
+            nxt = None
+            if s < world - 1:
+                # double-buffer: the hop is data-independent of this
+                # step's kernel, so the wire flies behind the MXU
+                nxt = lax.ppermute((k_cur, v_cur, kpos_cur),
+                                   axis_name, perm)
+
+            def live(args):
+                o_acc, l_acc, k_c, v_c, kp = args
+                out_b, lse_b = _flash_fwd(
+                    q, k_c, v_c, causal, scale, bq, bk, interpret,
+                    qpos=qpos if causal else None,
+                    kpos=kp if causal else None)
+                return _merge(o_acc, l_acc, out_b, lse_b[:, 0, :])
+
+            args = (out_acc, lse_acc, k_cur, v_cur, kpos_cur)
+            if causal:
+                # a block entirely in the future launches no kernel;
+                # the identity carry doubles as the lse=-inf NaN guard
+                out_acc, lse_acc = lax.cond(
+                    q_max < jnp.min(kpos_cur),
+                    lambda a: (a[0], a[1]), live, args)
+            else:
+                out_acc, lse_acc = live(args)
+            if nxt is not None:
+                k_cur, v_cur, kpos_cur = nxt
+        return out_acc.astype(q.dtype), lse_acc
+
+    def _bwd_ring(res, g):
+        q, k, v, out, lse_g = res
+        qpos, q_max = _positions()
+        gb = g.transpose(0, 2, 1, 3).reshape(bh, t, d).astype(jnp.float32)
+        ob = out.transpose(0, 2, 1, 3).reshape(bh, t, d) \
+            .astype(jnp.float32)
+        delta = (gb * ob).sum(-1)                       # (bh, t) global
+        lse8 = jnp.broadcast_to(lse_g[:, None, :], (bh, 8, t))
+        dq_acc = jnp.zeros((b, t, h, d), jnp.float32)
+        # the visiting block's dK/dV accumulate where the block IS and
+        # travel with it: after `world` hops each is home, complete
+        dk_cur = jnp.zeros((b, t, h, d), jnp.float32)
+        dv_cur = jnp.zeros((b, t, h, d), jnp.float32)
+        k_cur, v_cur, kpos_cur = k, v, qpos
+        for s in range(world):
+            nxt = None
+            if s < world - 1:
+                nxt = lax.ppermute((k_cur, v_cur, kpos_cur),
+                                   axis_name, perm)
+
+            def live(args):
+                dq_a, dk_c, dv_c, k_c, v_c, kp = args
+                dq_b, dk_b, dv_b = _flash_bwd(
+                    q, k_c, v_c, out, lse8, g, causal, scale, bq, bk,
+                    interpret, qpos=qpos if causal else None,
+                    kpos=kp if causal else None, delta=delta)
+                return (dq_a + dq_b.astype(jnp.float32),
+                        dk_c + dk_b.astype(jnp.float32),
+                        dv_c + dv_b.astype(jnp.float32))
+
+            args = (dq_acc, dk_cur, dv_cur, k_cur, v_cur, kpos_cur)
+            if causal:
+                dq_acc, dk_cur, dv_cur = lax.cond(
+                    q_max < jnp.min(kpos_cur),
+                    lambda a: (a[0], a[1], a[2]), live, args)
+            else:
+                dq_acc, dk_cur, dv_cur = live(args)
+            # the accumulators hop with their block every step — the
+            # world-th hop is the homecoming
+            dk_cur, dv_cur = lax.ppermute((dk_cur, dv_cur),
+                                          axis_name, perm)
+            if nxt is not None:
+                k_cur, v_cur, kpos_cur = nxt
+        return (dq_acc.astype(q.dtype), dk_cur.astype(k.dtype),
+                dv_cur.astype(v.dtype))
+
+    @jax.custom_vjp
+    def _attn(q, k, v):
+        out, _ = _fwd_ring(q, k, v)
+        return out
+
+    def _fwd(q, k, v):
+        out, lse_g = _fwd_ring(q, k, v)
+        return out, (q, k, v, out, lse_g)
+
+    _attn.defvjp(_fwd, _bwd_ring)
+    return _attn(q, k, v)

@@ -195,7 +195,10 @@ class DistributedTrainStep:
         knob) is the declarative parallelism source of truth: it
         builds the mesh (DCN-outer/ICI-inner ``AXIS_ORDER``) when no
         ``mesh`` is given, scopes the batch sharding and the gradient
-        exchange to its data axes (dp/fsdp — never the model axes),
+        exchange to its data axes (dp/fsdp — plus ``sp`` under
+        ``shard_map``, where the batch's token dim shards over the sp
+        axis and the token-mean loss makes sp data-axis math for the
+        reduction; tp/ep stay out of the exchange scope),
         turns ``fsdp>1`` into ``fsdp_axis`` placement under pjit, and
         stamps its canonical string into the AOT key so a warm start
         never serves an executable compiled for a different plan.
@@ -227,13 +230,18 @@ class DistributedTrainStep:
                     "(gpipe / interleaved_1f1b inside shard_map), not "
                     "the train step — the step compiles "
                     "dp/fsdp/tp/ep/sp plans")
-            if mode == "shard_map" and plan.model_axes:
+            blocked_model_axes = tuple(
+                a for a in plan.model_axes if a != "sp")
+            if mode == "shard_map" and blocked_model_axes:
                 raise ValueError(
                     f"plan {plan.to_string()} has model axes "
-                    f"{plan.model_axes}: mode='shard_map' compiles "
-                    "data-only plans (dp/fsdp) — model-parallel plans "
-                    "need mode='pjit', where GSPMD places the "
-                    "tp/ep/sp shardings the model's modules declare")
+                    f"{blocked_model_axes}: mode='shard_map' compiles "
+                    "data plans (dp/fsdp) plus sequence parallelism "
+                    "(sp — the batch's token dim shards over the sp "
+                    "axis and the model's ring/ulysses attention owns "
+                    "the exchange) — tp/ep plans need mode='pjit', "
+                    "where GSPMD places the shardings the model's "
+                    "modules declare")
             norm_axes = (data_axes,) if isinstance(data_axes, str) \
                 else tuple(data_axes)
             if norm_axes == tuple(GLOBAL_AXES):
@@ -350,6 +358,14 @@ class DistributedTrainStep:
         self._fsdp_min = fsdp_min_weight_size
         self._data_axes = tuple(data_axes) if not isinstance(data_axes, str) \
             else (data_axes,)
+        # sp>1 under shard_map: the batch's token dim (dim 1) shards
+        # over the sp axis — the model's ring/ulysses attention owns
+        # the sequence exchange, and because the loss is a token mean,
+        # sp joins the gradient/loss reduction scope exactly like a
+        # data axis (average of per-shard token means = global mean)
+        self._sp = int(plan.sp) if plan is not None else 1
+        self._sp_axis = "sp" if (mode == "shard_map" and
+                                 self._sp > 1) else None
         # remat accepts the legacy bool or a policy string (none|dots|
         # full|offload).  The resolved policy — including the
         # HOROVOD_REMAT_POLICY env knob, which steers the *models'*
@@ -386,7 +402,10 @@ class DistributedTrainStep:
             ((2,) if donate_batch else ())
 
         repl = NamedSharding(self._mesh, P())
-        batch_sharding = NamedSharding(self._mesh, P(self._data_axes))
+        batch_spec = (P(self._data_axes, self._sp_axis)
+                      if self._sp_axis is not None
+                      else P(self._data_axes))
+        batch_sharding = NamedSharding(self._mesh, batch_spec)
 
         if sparse_params and mode != "shard_map":
             raise ValueError(
@@ -483,7 +502,10 @@ class DistributedTrainStep:
         elif mode == "shard_map":
             shard_map = jax.shard_map
 
-            axes = self._data_axes
+            # sp joins the reduction scope (token-mean losses make it
+            # data-axis math); the batch spec already shards tokens
+            axes = self._data_axes + (
+                (self._sp_axis,) if self._sp_axis is not None else ())
 
             if shard_optimizer_states:
                 from horovod_tpu.optim.optimizer import (
@@ -575,14 +597,14 @@ class DistributedTrainStep:
             if guard is not None:
                 smapped = shard_map(
                     per_device_guarded, mesh=self._mesh,
-                    in_specs=(P(), P(), P(self._data_axes), P()),
+                    in_specs=(P(), P(), batch_spec, P()),
                     out_specs=(P(), P(), P(), P()),
                     check_vma=False)
                 self._step = jax.jit(smapped, donate_argnums=donated)
             else:
                 smapped = shard_map(
                     per_device, mesh=self._mesh,
-                    in_specs=(P(), P(), P(self._data_axes)),
+                    in_specs=(P(), P(), batch_spec),
                     out_specs=(P(), P(), P()),
                     check_vma=False)
                 self._step = jax.jit(
@@ -715,6 +737,7 @@ class DistributedTrainStep:
             "remat": self._remat_policy,
             "moe_fused": self._moe_fused,
             "moe_capacity_factor": self._moe_capacity_factor,
+            "sp": self._sp,
         }
 
     def init(self, params):

@@ -20,8 +20,9 @@ The same plan object is the single source of truth for:
   update → AG) runs only over the plan's data axes, never the model
   axes;
 * ``checkpoint.py`` — sharded save/restore records the plan and
-  reshards across *plan* changes (the data extent may change; the
-  model-parallel factorization must not);
+  reshards across *plan* changes (the data extent — including ``sp``,
+  which shards activations, never parameters — may change; the
+  pp/ep/tp factorization must not);
 * ``parallel/mesh.py`` — :meth:`build_mesh` lays the plan out
   DCN-outer/ICI-inner per ``AXIS_ORDER``.
 
@@ -184,7 +185,15 @@ class ShardingPlan:
 
     @property
     def model_axes(self) -> Tuple[str, ...]:
-        """Model-parallel axes at extent > 1 (pp/ep/sp/tp)."""
+        """Model-parallel axes at extent > 1 (pp/ep/sp/tp).
+
+        ``sp`` is deliberately here even though it shards activations
+        rather than parameters: a live job cannot change its sequence
+        factorization (the ring's exchange schedule and the batch's
+        token sharding are compiled in), so degrade transitions must
+        keep the sp extent — only checkpoint resharding, where the
+        job restarts anyway, treats sp as data extent
+        (``checkpoint._check_plan_reshard``)."""
         return tuple(ax for ax in ("pp", "ep", "sp", "tp")
                      if getattr(self, ax) > 1)
 

@@ -45,6 +45,9 @@ KNOWN_KNOBS = frozenset({
     # -- MoE expert-parallel dispatch (models/moe.py, parallel/expert.py,
     #    docs/fused_kernels.md "Expert-parallel dispatch")
     "HOROVOD_MOE_FUSED_DISPATCH", "HOROVOD_MOE_CAPACITY_FACTOR",
+    # -- sequence-parallel ring attention (parallel/ring_attention.py,
+    #    ops/pallas_kernels.py, docs/fused_kernels.md "Ring-flash attention")
+    "HOROVOD_SP_FUSED_RING", "HOROVOD_SP_LAYOUT",
     # -- warm-start compile cache
     "HOROVOD_COMPILE_CACHE", "HOROVOD_COMPILE_CACHE_DIR",
     # -- input pipeline

@@ -960,3 +960,78 @@ class TestMoeDispatchRule:
         assert [f for f in hlo_lint.lint_artifact(
             {"overlap_fraction": 0.5})
             if f.rule == "HLO006"] == []
+
+
+class TestSpRingRule:
+    """HLO007 (ISSUE 17): a serial sp ring hop — the final
+    collective-permute start..done pair with no compute inside its
+    window — must be flagged in HLO dumps, and an sp>1 artifact that
+    claims the fused ring-flash attention must show a clean ring."""
+
+    SERIAL = "\n".join([
+        "ENTRY %main () -> f32[8,16] {",
+        "  %p = f32[8,16]{1,0} parameter(0)",
+        "  %cp = (f32[8,16]{1,0}, f32[8,16]{1,0}) "
+        "collective-permute-start(%p), "
+        "source_target_pairs={{0,1},{1,2},{2,3},{3,0}}",
+        "  %cpd = f32[8,16]{1,0} collective-permute-done(%cp)",
+        "  ROOT %r = f32[8,16]{1,0} copy(%cpd)",
+        "}",
+    ])
+
+    def test_serial_ring_hop_fires(self):
+        findings = hlo_lint.lint_hlo_text(self.SERIAL)
+        assert any(f.rule == "HLO007" for f in findings), findings
+
+    def test_overlapped_ring_hop_clean(self):
+        """Flash compute scheduled inside the start..done window — the
+        double-buffered ring's shape — hides the hop; no finding."""
+        overlapped = self.SERIAL.replace(
+            "  %cpd = ",
+            "  %d = f32[16,16]{1,0} dot(%a, %b), "
+            "lhs_contracting_dims={1}, rhs_contracting_dims={0}\n"
+            "  %cpd = ")
+        assert [f for f in hlo_lint.lint_hlo_text(overlapped)
+                if f.rule == "HLO007"] == []
+
+    def test_synchronous_permute_not_judged(self):
+        sync = ("  %cp = f32[8,16]{1,0} collective-permute(%p), "
+                "source_target_pairs={{0,1},{1,0}}")
+        assert [f for f in hlo_lint.lint_hlo_text(sync)
+                if f.rule == "HLO007"] == []
+
+    def test_artifact_fused_sp_with_dirty_ring_fires_each_probe(self):
+        """All three structural probes fire independently: an exposed
+        hop, a full-sequence gather, and a too-short permute count."""
+        art = {"sp_fused_collectives": "on", "sp": 4,
+               "sp_serial_tail_permutes": 1,
+               "sp_attention_allgathers": 2,
+               "sp_collective_permutes": 3}   # < 2*(4-1)
+        findings = [f for f in hlo_lint.lint_artifact(art)
+                    if f.rule == "HLO007"]
+        assert len(findings) == 3, findings
+
+    def test_artifact_clean_fused_ring_passes(self):
+        art = {"sp_fused_collectives": "on", "sp": 2,
+               "sp_serial_tail_permutes": 0,
+               "sp_attention_allgathers": 0,
+               "sp_collective_permutes": 10}
+        assert [f for f in hlo_lint.lint_artifact(art)
+                if f.rule == "HLO007"] == []
+
+    def test_artifact_sp_one_or_unfused_expected(self):
+        # sp=1: the sequence is local, no ring to judge
+        assert [f for f in hlo_lint.lint_artifact(
+            {"sp_fused_collectives": "on", "sp": 1,
+             "sp_serial_tail_permutes": 1})
+            if f.rule == "HLO007"] == []
+        # fused off: the serial hop IS the jnp/unfused schedule
+        assert [f for f in hlo_lint.lint_artifact(
+            {"sp_fused_collectives": "off", "sp": 4,
+             "sp_serial_tail_permutes": 1})
+            if f.rule == "HLO007"] == []
+
+    def test_legacy_artifact_without_sp_fields_passes(self):
+        assert [f for f in hlo_lint.lint_artifact(
+            {"overlap_fraction": 0.5})
+            if f.rule == "HLO007"] == []

@@ -277,3 +277,82 @@ class TestMoeAutotune:
         best = out["best_point"]
         assert -(-best["capacity_factor"]
                  * best["tokens_per_expert"] // 1) <= 131
+
+
+class TestSpRingBench:
+    """``--plan`` dp×sp bench surface (ISSUE 17): the plan axis grows
+    dp×sp factorizations only at long context, the ring twin probe
+    emits the HLO007-judged structural fields plus the closed
+    hvd_sp_* telemetry series, and the --sp-budget artifact certifies
+    sp=2 under an HBM budget that refuses sp=1."""
+
+    def test_plan_axis_values_gate_sp_on_seq_len(self):
+        # short context: the dp×fsdp walk only
+        assert all("sp=" not in p for p in bench._plan_axis_values(8))
+        assert all("sp=" not in p
+                   for p in bench._plan_axis_values(8, seq_len=512))
+        # seq >= 4096: every dividing sp extent joins the race
+        plans = bench._plan_axis_values(8, seq_len=4096)
+        for want in ("dp=4,sp=2", "dp=2,sp=4", "dp=1,sp=8"):
+            assert want in plans, plans
+        # sp must divide both the world and the sequence
+        assert all("sp=3" not in p
+                   for p in bench._plan_axis_values(6, seq_len=4096))
+
+    def test_sp_ring_twin_fields_and_lint(self):
+        import types
+
+        from horovod_tpu.analysis import hlo_lint
+        from horovod_tpu.ops import pallas_kernels as PK
+
+        fields = bench._sp_ring_twin(types.SimpleNamespace(), sp=2,
+                                     heads=2, head_dim=8, seq_local=16)
+        assert fields["sp_fused_collectives"] == "on"
+        # the structural triple HLO007 judges — clean by construction
+        assert fields["sp_serial_tail_permutes"] == 0
+        assert fields["sp_attention_allgathers"] == 0
+        assert fields["sp_collective_permutes"] >= 2
+        # launch census comes straight from ring_step_schedule
+        sched = PK.ring_step_schedule(2, causal=True,
+                                      layout=fields["sp_layout"])
+        assert fields["sp_ring_steps"] == sched["launches"]
+        assert fields["sp_skipped_ring_steps"] == sched["skipped"]
+        assert fields["sp_tail_s"] >= 0.0
+        assert fields["sp_ring_wire_bytes"] > 0
+        # the artifact the twin stamps passes the lint rule it feeds
+        art = dict(fields, sp=2)
+        assert [f.rule for f in hlo_lint.lint_artifact(art)
+                if f.rule == "HLO007"] == []
+
+    def test_sp_ring_twin_zigzag_layout_census(self, monkeypatch):
+        import types
+
+        monkeypatch.setenv("HOROVOD_SP_LAYOUT", "zigzag")
+        fields = bench._sp_ring_twin(types.SimpleNamespace(), sp=2,
+                                     heads=2, head_dim=8, seq_local=16)
+        assert fields["sp_layout"] == "zigzag"
+        # zigzag never fully masks a step: all sp² launches live
+        assert fields["sp_ring_steps"] == 4
+        assert fields["sp_skipped_ring_steps"] == 0
+
+    @pytest.mark.slow
+    def test_sp_budget_certifies_long_context(self):
+        """The seq-4096 CPU-twin certification: both twins compile
+        through the blocked kernels, plan_memory_bytes' 1/sp scaling
+        lands within the 25% bar, and the midpoint budget admits
+        dp=4,sp=2 while refusing dp=8."""
+        import types
+
+        import horovod_tpu as hvd
+
+        hvd.init()
+        try:
+            out = bench.run_sp_budget(
+                types.SimpleNamespace(tf_seq_len=4096), hvd)
+        finally:
+            hvd.shutdown()
+        assert out["sp_budget_certified_plan"] == "dp=4,sp=2"
+        assert out["sp_budget_refused_plan"] == "dp=8"
+        assert out["sp_plan_memory_rel_err"] <= 0.25
+        assert out["sp_hbm_high_water_bytes_sp2"] < \
+            out["sp_hbm_high_water_bytes_sp1"]

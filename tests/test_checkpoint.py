@@ -541,3 +541,89 @@ class TestPinAgainstRetention:
         assert ckpt.pinned_steps() == [3, 7]
         ckpt.unpin(3)
         assert ckpt.pinned_steps() == [7]
+
+
+class TestPlanReshard:
+    """Plan-stamped sharded checkpoints across sp (ISSUE 17 satellite):
+    sp shards *activations*, so for the saved parameter/optimizer state
+    it is data-free — a dp=2,sp=2 checkpoint restores onto dp=4 or
+    dp=1,sp=4 as a plain reshard, while a model-extent (pp/ep/tp)
+    change refuses with a clear error (docs/parallelism.md)."""
+
+    LEAVES = [np.arange(10, dtype=np.float32),
+              np.arange(6, dtype=np.float32).reshape(2, 3) + 100.0]
+
+    def _save_all(self, tmp_path, world, plan):
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                           use_orbax=False)
+        spec, flats, trees = _shard_trees(self.LEAVES, world)
+        for r, tree in enumerate(trees):
+            ckpt.save_sharded(0, tree, r, world, plan=plan)
+            ckpt.wait()
+        return ckpt, trees
+
+    @pytest.mark.parametrize("new_plan", ["dp=4", "dp=1,sp=4",
+                                          "dp=2,sp=2", "dp=2,fsdp=2"])
+    def test_sp_restores_across_data_factorizations(self, tmp_path,
+                                                    new_plan):
+        ckpt, trees = self._save_all(tmp_path, 4, plan="dp=2,sp=2")
+        for r in range(4):
+            target = {k: {"m": np.zeros_like(v["m"]),
+                          "count": np.int32(0)}
+                      for k, v in trees[r].items()}
+            out = ckpt.restore_sharded(target, r, 4, plan=new_plan)
+            for k in trees[r]:
+                np.testing.assert_array_equal(out[k]["m"],
+                                              trees[r][k]["m"])
+
+    def test_sp_checkpoint_reshards_to_wider_world(self, tmp_path):
+        # dp=2,sp=2 (4 shards) -> dp=8 (8 shards): sp folds into the
+        # data extent and the flat buffer re-slices like any world
+        # change
+        ckpt, _ = self._save_all(tmp_path, 4, plan="dp=2,sp=2")
+        spec8 = C.make_fusion_spec(self.LEAVES, 8)
+        _, flats, _ = _shard_trees(self.LEAVES, 4)
+        for g in spec8.groups:
+            full = flats[g.key]
+            if g.padded >= full.size:
+                full = np.concatenate(
+                    [full, np.zeros(g.padded - full.size, full.dtype)])
+            else:
+                full = full[:g.padded]
+            for r in (0, 7):
+                target = {k2.key: {"m": np.zeros((k2.shard,),
+                                                 np.float32),
+                                   "count": np.int32(0)}
+                          for k2 in spec8.groups}
+                out = ckpt.restore_sharded(target, r, 8, plan="dp=8")
+                np.testing.assert_array_equal(
+                    out[g.key]["m"],
+                    full[r * g.shard:(r + 1) * g.shard])
+
+    def test_model_extent_change_refuses(self, tmp_path):
+        ckpt, trees = self._save_all(tmp_path, 4, plan="dp=2,sp=2")
+        target = {k: {"m": np.zeros_like(v["m"]), "count": np.int32(0)}
+                  for k, v in trees[0].items()}
+        with pytest.raises(ValueError, match="pp/ep/tp"):
+            ckpt.restore_sharded(target, 0, 4, plan="dp=4,tp=2")
+
+    def test_plan_shard_count_mismatch_is_a_clear_error(self, tmp_path):
+        # a dp=2,sp=2 plan shards the exchange over 4 ranks; stamping
+        # it onto an 8-way save would write a lie into the checkpoint
+        ckpt = hvd.checkpoint.Checkpointer(str(tmp_path / "ck"),
+                                           use_orbax=False)
+        with pytest.raises(ValueError, match=r"dp\*fsdp\*sp"):
+            ckpt.save_sharded(0, {"m": np.ones(3, np.float32)}, 0, 8,
+                              plan="dp=2,sp=2")
+
+    def test_unstamped_checkpoint_restores_under_any_plan(self,
+                                                          tmp_path):
+        # pre-ISSUE-17 checkpoints carry no plan; restore must not
+        # invent a refusal
+        ckpt, trees = self._save_all(tmp_path, 4, plan=None)
+        target = {k: {"m": np.zeros_like(v["m"]), "count": np.int32(0)}
+                  for k, v in trees[0].items()}
+        out = ckpt.restore_sharded(target, 0, 4, plan="dp=1,sp=4")
+        for k in trees[0]:
+            np.testing.assert_array_equal(out[k]["m"],
+                                          trees[0][k]["m"])

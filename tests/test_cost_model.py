@@ -613,3 +613,77 @@ class TestMoeMemoryPlane:
         # deterministic: the certificate is pure arithmetic
         assert CM.plan_memory_bytes(
             "dp=2,fsdp=2,ep=8,tp=2", **kw) == mb
+
+
+class TestSpRingPricing:
+    """Sequence-parallel pricing (ISSUE 17): the K/V ring wire gauge,
+    the 1/sp attention compute split, the fused-vs-unfused exposure,
+    and the 1/sp activation scaling the --sp-budget certification
+    leans on."""
+
+    def test_wire_volume_is_ring_exact(self):
+        # 2 tensors (K and V) x (sp-1) hops x b·t_local·h·d fp32
+        got = CM.sp_ring_wire_bytes(512, 8, 64, sp=4, batch=2)
+        assert got == 2 * 3 * 2 * 512 * 8 * 64 * 4.0
+        assert CM.sp_ring_wire_bytes(512, 8, 64, sp=1) == 0.0
+
+    def test_wire_volume_is_schedule_invariant(self):
+        # fusion changes the exposure, never the bytes — the same
+        # gauge prices the fused and jnp rings
+        assert CM.sp_ring_wire_bytes(128, 4, 32, sp=8) == \
+            CM.sp_ring_wire_bytes(128, 4, 32, sp=8)
+
+    def test_attention_compute_divides_by_sp(self):
+        one = CM.sp_attention_compute_s(4096, 8, 64, sp=1)
+        four = CM.sp_attention_compute_s(4096, 8, 64, sp=4)
+        assert one == pytest.approx(4 * four)
+
+    def test_causal_halves_the_flops(self):
+        full = CM.sp_attention_compute_s(4096, 8, 64, sp=2)
+        causal = CM.sp_attention_compute_s(4096, 8, 64, sp=2,
+                                           causal=True)
+        assert causal == pytest.approx(full / 2)
+
+    def test_fused_exposure_at_most_unfused(self):
+        wire, compute = 1e-3, 5e-3
+        fused = CM.sp_ring_exposed_s(wire, compute, sp=4, fused=True)
+        unfused = CM.sp_ring_exposed_s(wire, compute, sp=4, fused=False)
+        assert unfused == pytest.approx(wire)
+        assert 0.0 <= fused < unfused
+
+    def test_score_prices_the_sp_ring(self):
+        """An sp plan with attention pricing scores strictly below the
+        same-wire dp plan (the ring costs something), and the fused
+        point at least matches the unfused one."""
+        kw = dict(payload_bytes=1e6, n_ici=8, compute_s=1e-3,
+                  sp_attn_wire_s=2e-3, sp_attn_compute_s=8e-3)
+        dp = CM.score_exchange_schedule({"plan": "dp=8"}, **kw)
+        sp_off = CM.score_exchange_schedule(
+            {"plan": "dp=4,sp=2", "fused_collectives": "off"}, **kw)
+        sp_on = CM.score_exchange_schedule(
+            {"plan": "dp=4,sp=2", "fused_collectives": "on"}, **kw)
+        assert sp_off < dp
+        assert sp_on >= sp_off
+
+    def test_plan_memory_activations_divide_by_sp(self):
+        m1 = CM.plan_memory_bytes("dp=2", param_bytes=1e6,
+                                  activation_bytes=8e6)
+        m2 = CM.plan_memory_bytes("dp=2,sp=2", param_bytes=1e6,
+                                  activation_bytes=8e6)
+        m4 = CM.plan_memory_bytes("dp=2,sp=4", param_bytes=1e6,
+                                  activation_bytes=8e6)
+        assert m2.activations == pytest.approx(m1.activations / 2)
+        assert m4.activations == pytest.approx(m1.activations / 4)
+        # sp replicates parameters — only activations shrink
+        assert m2.params == m1.params
+        assert m2.grads == m1.grads
+
+    def test_sp_budget_separates_the_plans(self):
+        """The --sp-budget shape: a budget between the two footprints
+        admits the sp=2 plan and refuses sp=1."""
+        kw = dict(param_bytes=1e6, activation_bytes=64e6)
+        m1 = CM.plan_memory_bytes("dp=4", **kw)
+        m2 = CM.plan_memory_bytes("dp=2,sp=2", **kw)
+        budget = (m1.total + m2.total) / 2
+        assert CM.plan_fits(m2, budget)
+        assert not CM.plan_fits(m1, budget)

@@ -336,3 +336,35 @@ class TestSmokeGate:
         from horovod_tpu.elastic.smoke import run_smoke
 
         assert run_smoke() == []
+
+
+class TestSpDegrade:
+    """sp under live degrade (ISSUE 17 satellite): unlike a checkpoint
+    restart (where sp reshards freely — params are sp-replicated), a
+    running step's ring geometry and exchange schedule are compiled
+    against the sp extent, so the resolver holds sp fixed: data
+    capacity loss shrinks dp around it, and a world too small to host
+    the sp ring waits for capacity instead of silently changing the
+    attention math (docs/parallelism.md)."""
+
+    def make(self, p, n, **kw):
+        kw.setdefault("payload_bytes", 1e6)
+        return DegradedPlanResolver(p, n, **kw)
+
+    def test_sp_shrink_preserves_sequence_extent(self):
+        d = self.make("dp=4,sp=2", 8).resolve(6)
+        assert d.action == "shrink"
+        assert d.plan.sp == 2
+        assert (d.plan.dp or 1) * d.plan.fsdp == 3
+
+    def test_wait_names_sp_when_ring_cannot_fit(self):
+        r = self.make("dp=4,sp=2", 8)
+        d = r.resolve(1)                   # 1 < sp extent 2
+        assert d.action == "wait"
+        assert d.plan is None
+        assert "sp=2" in d.reason
+
+    def test_sp_is_a_model_extent_to_the_candidate_walk(self):
+        base = ShardingPlan.from_string("dp=4,sp=2").resolve(8)
+        cands = base.degrade_candidates(4)
+        assert cands and all(p.sp == 2 for p in cands)

@@ -763,3 +763,55 @@ class TestFusedExpertDispatchHLO:
         kinds = H.count_by_kind(H.collective_ops(text))
         assert kinds.get("all-to-all", 0) == 0, kinds
         assert kinds.get("collective-permute", 0) >= 14, kinds
+
+
+class TestRingFlashHLO:
+    """Guards for the fused sp ring-flash attention (ISSUE 17
+    tentpole): under an sp>1 plan the compiled program must carry ZERO
+    full-sequence all-gathers — the K/V exchange is the ppermute ring,
+    2·(sp−1) hops minimum — and no serial permute tail window.  A
+    silent degeneration to gather-everything is numerically invisible
+    (same softmax) and only shows up as O(T) per-chip memory on a real
+    pod; these guards fail instead."""
+
+    def _lowered_ring(self, sp, fused, causal=True):
+        from horovod_tpu.parallel.mesh import make_parallel_mesh
+        from horovod_tpu.parallel.ring_attention import ring_attention
+
+        mesh = make_parallel_mesh(sp=sp,
+                                  devices=jax.devices("cpu")[:sp])
+        spec = P(None, "sp", None, None)
+        shape = (2, sp * 32, 4, 16)
+        q = jnp.zeros(shape, jnp.float32)
+
+        def f(q_, k_, v_):
+            def loss(qq):
+                o = ring_attention(qq, k_, v_, "sp", causal=causal,
+                                   fused=fused, interpret=True)
+                return (o.astype(jnp.float32) ** 2).sum(), o
+
+            (_, o), dq = jax.value_and_grad(loss, has_aux=True)(q_)
+            return o, dq
+
+        sm = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(spec,) * 3,
+            out_specs=(spec, spec), check_vma=False))
+        return sm.lower(q, q, q).compile().as_text()
+
+    @pytest.mark.parametrize("sp", [2, 4])
+    def test_fused_ring_is_allgather_free(self, hvd_runtime, sp):
+        text = self._lowered_ring(sp, fused=True)
+        kinds = H.count_by_kind(H.collective_ops(text))
+        assert kinds.get("all-gather", 0) == 0, kinds
+        # K and V each hop sp−1 times forward + the dK/dV ring back
+        assert kinds.get("collective-permute", 0) >= 2 * (sp - 1), kinds
+        assert H.serial_tail_collectives(
+            text, kinds=("collective-permute",)) == 0
+
+    def test_jnp_ring_is_also_allgather_free(self, hvd_runtime):
+        """The fallback formulation shares the wire contract: the jnp
+        scan rides the same ppermute ring, never a gather."""
+        text = self._lowered_ring(2, fused=False)
+        kinds = H.count_by_kind(H.collective_ops(text))
+        assert kinds.get("all-gather", 0) == 0, kinds
+        assert kinds.get("collective-permute", 0) >= 2, kinds

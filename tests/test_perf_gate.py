@@ -395,3 +395,48 @@ class TestCiEntry:
     def test_ci_full_scan(self, capsys):
         assert ci_main(["--full"]) == 0
         assert "lint[full]" in capsys.readouterr().out
+
+
+class TestSpComparability:
+    """ISSUE 17 satellite: the sp extent and the sequence length are
+    comparability keys on the transformer throughput — an sp=2
+    seq-4096 long-context run against an sp=1 seq-512 one measures a
+    different attention schedule and a t²-different FLOP mix, never a
+    regression."""
+
+    @staticmethod
+    def _art(name, value, sp=None, seq=None, plan=None):
+        fields = {"transformer_tokens_per_sec": value,
+                  "transformer_params_m": 10.0}
+        if sp is not None:
+            fields["sp"] = sp
+        if seq is not None:
+            fields["transformer_seq_len"] = seq
+        if plan is not None:
+            fields["plan"] = plan
+        return PG._validate(name, fields)
+
+    def test_sp_extent_change_not_diffed(self):
+        base = self._art("base", 60_000.0, sp=1, seq=512)
+        cand = self._art("cand", 20_000.0, sp=2, seq=512)
+        assert PG.diff([base], cand, PG.Tolerances()) == []
+
+    def test_seq_len_change_not_diffed(self):
+        base = self._art("base", 60_000.0, sp=2, seq=512)
+        cand = self._art("cand", 20_000.0, sp=2, seq=4096)
+        assert PG.diff([base], cand, PG.Tolerances()) == []
+
+    def test_same_sp_and_seq_regression_fires(self):
+        base = self._art("base", 60_000.0, sp=2, seq=4096,
+                         plan="dp=4,sp=2")
+        cand = self._art("cand", 20_000.0, sp=2, seq=4096,
+                         plan="dp=4,sp=2")
+        assert [f.rule for f in PG.diff([base], cand,
+                                        PG.Tolerances())] == ["PERF001"]
+
+    def test_legacy_artifacts_without_sp_keys_still_gate(self):
+        # BENCH_r0* rounds predate the keys; None matches None
+        base = self._art("base", 60_000.0)
+        cand = self._art("cand", 20_000.0)
+        assert [f.rule for f in PG.diff([base], cand,
+                                        PG.Tolerances())] == ["PERF001"]

@@ -429,3 +429,32 @@ class TestMoeSeriesSchema:
         snap = self._snap({"hvd_moe_router_entropy": 1.0})
         errs = metrics_schema.validate_snapshot(snap)
         assert any("MOE_SERIES" in e for e in errs), errs
+
+
+class TestSpSeriesSchema:
+    """SP_SERIES (ISSUE 17): the hvd_sp_* namespace is closed — the
+    ring wire gauge and the two launch-schedule counters validate,
+    anything else is a schema error."""
+
+    def _snap(self, counters=None, gauges=None):
+        return {"schema_version": 1, "kind": "hvdtel_snapshot",
+                "run_id": "r", "generation": 0, "step": 0,
+                "counters": counters or {}, "histograms": {},
+                "gauges": gauges or {}}
+
+    def test_known_sp_series_validate(self):
+        snap = self._snap(
+            counters={"hvd_sp_ring_steps": 10.0,
+                      "hvd_sp_skipped_ring_steps": 6.0},
+            gauges={"hvd_sp_ring_wire_bytes": 12582912.0})
+        assert metrics_schema.validate_snapshot(snap) == []
+
+    def test_unknown_sp_series_rejected(self):
+        snap = self._snap(gauges={"hvd_sp_tail_seconds": 0.1})
+        errs = metrics_schema.validate_snapshot(snap)
+        assert any("SP_SERIES" in e for e in errs), errs
+
+    def test_unknown_sp_counter_rejected(self):
+        snap = self._snap(counters={"hvd_sp_bogus_total": 1.0})
+        errs = metrics_schema.validate_snapshot(snap)
+        assert any("SP_SERIES" in e for e in errs), errs
