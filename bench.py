@@ -1641,6 +1641,17 @@ def run_autotune(args, hvd):
     base.num_iters, base.num_batches_per_iter, base.num_warmup_batches = \
         2, 2, 1
 
+    # measured hardware model for every pruning predictor below:
+    # calibration artifact > HOROVOD_HW_PRESET > device_kind preset >
+    # v5e (docs/calibration.md).  device_kind steers the preset only on
+    # real TPU — the CPU twin keeps pruning against the target-chip
+    # default so its autotune walk stays deterministic
+    from horovod_tpu.analysis import cost_model as _CM
+
+    dev0 = jax.devices()[0]
+    hw = _CM.resolve_hardware_model(
+        device_kind=dev0.device_kind if dev0.platform == "tpu" else None)
+
     # exchange-schedule axes ride any model when the sharded exchange
     # is on: bucket cap (0 = monolithic) and hierarchy mode become
     # cold-start-discoverable knobs exactly like spc/flash_block.  The
@@ -1702,34 +1713,34 @@ def run_autotune(args, hvd):
         sp_wire_s = sp_compute_s = 0.0
         if model == "transformer":
             from horovod_tpu.analysis.cost_model import (
-                V5E,
                 sp_attention_compute_s,
             )
 
             d, layers, v = args.tf_d_model, args.tf_layers, 32_000
             payload = 4.0 * (12 * layers * d * d + v * d)
-            # 6 FLOPs/param/token forward+backward, v5e peak bf16
+            # 6 FLOPs/param/token forward+backward at the resolved
+            # chip's matmul peak (measured when calibrated)
             compute_s = (6.0 * (payload / 4.0) * args.tf_batch_size
-                         * args.tf_seq_len) / 197e12
+                         * args.tf_seq_len) / hw.peak_flops_per_s
             # sp pricing, normalized to sp=1 (the scorer rescales by
             # the sampled plan's sp extent): wire = seconds to move
             # one full K+V through ICI, compute = the full t_global²
             # causal attention of one layer stack
             seq, b = args.tf_seq_len, args.tf_batch_size
             sp_wire_s = (2.0 * 4.0 * b * seq * d * layers
-                         / V5E.ici_bytes_per_s)
+                         / hw.ici_bytes_per_s)
             sp_compute_s = layers * sp_attention_compute_s(
                 seq, args.tf_heads, d // args.tf_heads, sp=1,
-                batch=b, causal=True)
+                batch=b, causal=True, hw=hw)
         else:
             payload = 4.0 * 25.6e6          # ResNet-50 fp32 grads
-            compute_s = 3.0 * 4.1e9 * 128 / 197e12
+            compute_s = 3.0 * 4.1e9 * 128 / hw.peak_flops_per_s
         shape = list(rt_state.global_state().mesh.shape.values())
         n_dcn = shape[0] if len(shape) == 2 else 1
         n_ici = shape[-1]
         return lambda point: score_exchange_schedule(
             point, payload, n_dcn=n_dcn, n_ici=n_ici,
-            compute_s=compute_s,
+            compute_s=compute_s, hw=hw,
             sp_attn_wire_s=sp_wire_s, sp_attn_compute_s=sp_compute_s)
 
     def moe_predictor():
@@ -1751,7 +1762,7 @@ def run_autotune(args, hvd):
         ep = _moe_ep_extent(args, hvd)
         return lambda point: score_moe_schedule(
             point, tokens=tokens, d_model=d, d_ff=d_ff,
-            num_experts=experts, ep=ep, fused=True)
+            num_experts=experts, ep=ep, fused=True, hw=hw)
 
     def hbm_feasible():
         """Hard HBM-budget gate for the autotuner (docs/memory.md):
@@ -1803,7 +1814,7 @@ def run_autotune(args, hvd):
                             args.shard_optimizer_states),
                         expert_param_bytes=expert_bytes,
                         moe_capacity_buffer_bytes=buf),
-                    budget)
+                    budget, hw=hw)
 
             return moe_fits
         if model == "transformer":
@@ -1821,7 +1832,7 @@ def run_autotune(args, hvd):
                 shard_optimizer_states=args.shard_optimizer_states,
                 exchange_bucket_bytes=(
                     point.get("exchange_bucket_bytes") or None)),
-            budget)
+            budget, hw=hw)
 
     if model == "transformer":
         axes = {"steps_per_call": [1, 5, 10, 20, 40],
@@ -1876,6 +1887,7 @@ def run_autotune(args, hvd):
             "unit": ("img/sec/chip" if model == "resnet"
                      else "tokens/sec/chip"),
             "vs_baseline": None, "best_point": best,
+            "hw_model": hw.name,
             "autotune_log": log_path}
 
 
@@ -2214,6 +2226,144 @@ def telemetry_fields():
     return {"metrics": telemetry.bench_metrics()}
 
 
+def run_calibrate(args, hvd):
+    """``--calibrate``: the collective microbenchmark suite — sweep
+    every fabric level of the runtime mesh across message sizes for
+    each collective family, time a matmul and an HBM stream, fit the
+    alpha-beta model per (level, collective), and persist the
+    versioned calibration artifact ``HardwareModel.from_calibration``
+    and every pricing consumer read through
+    ``HOROVOD_CALIBRATION_PATH`` (docs/calibration.md).
+
+    ``--calibrate-sim`` swaps the measured sweeps for the seeded
+    simulator (``analysis/calibration.py``) — the deterministic CI
+    path hvdci gate 9 runs twice and requires bit-identical."""
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu import telemetry
+    from horovod_tpu.analysis import calibration as CAL
+    from horovod_tpu.runtime import state as rt_state
+
+    points = telemetry.counter("hvd_calibration_points_total",
+                               "timed sweep points")
+    fits = telemetry.counter("hvd_calibration_fits_total",
+                             "fitted alpha-beta curves")
+    out_path = args.calibrate_out or "CALIBRATION.json"
+
+    if args.calibrate_sim:
+        art = CAL.simulated_calibration(seed=args.calibrate_seed)
+        for name in art["level_order"]:
+            colls = art["levels"][name]["collectives"]
+            fits.inc(len(colls))
+            points.inc(sum(c["n_points"] for c in colls.values()))
+        CAL.save_artifact(art, out_path)
+        log(f"bench: wrote simulated calibration to {out_path} "
+            f"(fingerprint {art['calibration_fingerprint']})")
+        return {"metric": "calibrate", "value": art["fit_residual_max"],
+                "unit": "rms_rel_residual", "vs_baseline": None,
+                "calibration_out": out_path,
+                "calibration_fingerprint":
+                    art["calibration_fingerprint"],
+                "calibration_source": "simulated"}
+
+    mesh = rt_state.global_state().mesh
+    # innermost-first level order, extent-1 axes dropped: a sweep over
+    # a 1-extent axis times a no-op and the fit cannot separate alpha
+    # from beta (non-positive slope)
+    level_names = [n for n in reversed(list(mesh.shape.keys()))
+                   if int(mesh.shape[n]) > 1]
+    if not level_names:
+        raise SystemExit("--calibrate needs a multi-device mesh to "
+                         "time collectives; use --calibrate-sim for "
+                         "the deterministic single-device path")
+    platform = jax.devices()[0].platform
+    sweep = [int(s) for s in CAL.DEFAULT_SWEEP_BYTES
+             if s <= (args.calibrate_max_bytes
+                      or (2 ** 22 if platform != "tpu" else 2 ** 27))]
+
+    def time_s(fn, *xs, reps=3):
+        jax.block_until_ready(fn(*xs))          # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*xs))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    def collective_body(coll, axis_name, n_axis):
+        if coll == "allreduce":
+            return lambda x: lax.psum(x, axis_name)
+        if coll == "reduce_scatter":
+            return lambda x: lax.psum_scatter(x, axis_name, tiled=True)
+        if coll == "all_gather":
+            return lambda x: lax.all_gather(x, axis_name, tiled=True)
+        if coll == "ppermute":
+            perm = [(i, (i + 1) % n_axis) for i in range(n_axis)]
+            return lambda x: lax.ppermute(x, axis_name, perm)
+        return lambda x: lax.all_to_all(
+            x.reshape(n_axis, -1), axis_name, 0, 0).reshape(-1)
+
+    level_fits = {}
+    level_extents = {}
+    for name in level_names:
+        n_axis = int(mesh.shape[name])
+        level_extents[name] = n_axis
+        fits_here = []
+        for coll in CAL.CALIBRATED_COLLECTIVES:
+            sizes, times = [], []
+            for nbytes in sweep:
+                elems = max(n_axis, nbytes // 4)
+                elems += (-elems) % n_axis      # a2a/RS divisibility
+                body = collective_body(coll, name, n_axis)
+                fn = jax.jit(shard_map(
+                    lambda x, _b=body: jnp.sum(_b(x)), mesh=mesh,
+                    in_specs=P(), out_specs=P(), check_rep=False))
+                x = jnp.zeros((elems,), jnp.float32) + 1.0
+                sizes.append(float(elems * 4))
+                times.append(time_s(fn, x))
+                points.inc()
+            fits_here.append(CAL.fit_level(coll, sizes, times))
+            fits.inc()
+        level_fits[name] = fits_here
+
+    # matmul FLOP rate + HBM stream rate on one chip
+    k = 1024 if platform != "tpu" else 4096
+    a = jnp.ones((k, k), jnp.bfloat16)
+    t_mm = time_s(jax.jit(lambda m: m @ m), a)
+    matmul_flops = 2.0 * k ** 3 / t_mm
+    stream = jnp.ones((2 ** 22,), jnp.float32)
+    t_hbm = time_s(jax.jit(lambda v: v * 1.0000001), stream)
+    hbm_rate = 2.0 * stream.size * 4 / t_hbm    # read + write
+
+    art = CAL.build_artifact(
+        device_kind=jax.devices()[0].device_kind,
+        platform=platform,
+        n_devices=hvd.size(),
+        mesh_shape=[int(s) for s in mesh.shape.values()],
+        level_order=level_names,
+        level_fits=level_fits,
+        level_extents=level_extents,
+        matmul_flops_per_s=matmul_flops,
+        hbm_bytes_per_s=hbm_rate,
+        source="measured",
+        jax_version=jax.__version__)
+    errs = CAL.validate_calibration(art)
+    if errs:
+        raise SystemExit("bench --calibrate produced an invalid "
+                         "artifact: " + "; ".join(errs))
+    CAL.save_artifact(art, out_path)
+    log(f"bench: wrote measured calibration to {out_path} "
+        f"(fingerprint {art['calibration_fingerprint']}, max fit "
+        f"residual {art['fit_residual_max']:.4f})")
+    return {"metric": "calibrate", "value": art["fit_residual_max"],
+            "unit": "rms_rel_residual", "vs_baseline": None,
+            "calibration_out": out_path,
+            "calibration_fingerprint": art["calibration_fingerprint"],
+            "calibration_source": "measured"}
+
+
 def artifact_metadata(hvd):
     """BENCH-JSON provenance (``schema_version`` 1, docs/perf_gate.md):
     the perf gate validates these fields and REFUSES to diff artifacts
@@ -2240,6 +2390,23 @@ def artifact_metadata(hvd):
         meta["mesh_shape"] = [int(s) for s in mesh.shape.values()]
     except Exception:  # noqa: BLE001
         meta["mesh_shape"] = [1, hvd.size()]
+    # calibration provenance: when this run priced/pruned against a
+    # measured hardware model, stamp its identity so the perf gate can
+    # refuse cross-hardware diffs (docs/calibration.md)
+    cal_path = os.environ.get("HOROVOD_CALIBRATION_PATH")
+    if cal_path:
+        try:
+            with open(cal_path) as f:
+                cal = json.load(f)
+            from horovod_tpu.analysis import cost_model as CM
+
+            meta["calibration_fingerprint"] = \
+                cal.get("calibration_fingerprint") \
+                or CM.calibration_fingerprint(cal)
+            meta["calibration_device_kind"] = cal.get("device_kind")
+        except Exception:  # noqa: BLE001 — provenance must not sink the bench
+            meta["calibration_fingerprint"] = None
+            meta["calibration_device_kind"] = None
     return meta
 
 
@@ -2431,6 +2598,26 @@ def main():
                         "scaling (25%% bar) and certify the HBM budget "
                         "admits sp=2 while refusing sp=1 "
                         "(docs/fused_kernels.md)")
+    p.add_argument("--calibrate", action="store_true",
+                   help="run the collective microbenchmark suite "
+                        "(allreduce/RS/AG/ppermute/a2a per fabric "
+                        "level + matmul/HBM rates), fit the "
+                        "alpha-beta model and write the versioned "
+                        "calibration artifact every pricing consumer "
+                        "reads via HOROVOD_CALIBRATION_PATH "
+                        "(docs/calibration.md)")
+    p.add_argument("--calibrate-sim", action="store_true",
+                   help="with --calibrate: seeded pure-sim sweeps "
+                        "instead of measured ones — deterministic, "
+                        "single-device-safe (hvdci gate 9 path)")
+    p.add_argument("--calibrate-out", default=None, metavar="PATH",
+                   help="calibration artifact path (default: "
+                        "CALIBRATION.json in the cwd)")
+    p.add_argument("--calibrate-seed", type=int, default=17,
+                   help="noise seed for --calibrate-sim")
+    p.add_argument("--calibrate-max-bytes", type=int, default=None,
+                   help="cap the message-size sweep (default: 128 MiB "
+                        "on TPU, 4 MiB elsewhere)")
     p.add_argument("--autotune", action="store_true",
                    help="tune the jit-path throughput knobs "
                         "(steps_per_call; flash block for the "
@@ -2493,6 +2680,11 @@ def main():
         return
     if args.sp_budget:
         emit(dict(run_sp_budget(args, hvd), **artifact_metadata(hvd),
+                  **telemetry_fields()),
+             args.json_out)
+        return
+    if args.calibrate:
+        emit(dict(run_calibrate(args, hvd), **artifact_metadata(hvd),
                   **telemetry_fields()),
              args.json_out)
         return

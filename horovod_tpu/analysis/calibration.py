@@ -1,0 +1,373 @@
+"""Measured hardware model: collective microbenchmark fitting.
+
+ROADMAP item 5's characterize-then-design loop (the method of
+PAPERS.md arXiv 1810.11112): instead of trusting the hand-coded
+:data:`~horovod_tpu.analysis.cost_model.V5E` constants, ``bench
+--calibrate`` measures every collective the exchange is built from
+(allreduce / reduce-scatter / all-gather / ppermute / all-to-all) per
+fabric level across a message-size sweep, plus the matmul FLOP rate
+and the HBM stream rate, and this module fits the classic alpha-beta
+model per (level, collective):
+
+    t(n) = alpha + n / beta            # latency + bytes/bandwidth
+
+by closed-form least squares (:func:`fit_alpha_beta`).  The fits are
+persisted as a versioned JSON artifact (:func:`build_artifact`,
+schema in docs/calibration.md) that
+``HardwareModel.from_calibration`` turns back into roofline
+constants — the cost model, perf gate, memory planner and
+``ThroughputAutotuner(predict=)`` then consume measured numbers with
+the precedence chain ``calibration artifact > HOROVOD_HW_PRESET >
+builtin preset`` (:func:`~horovod_tpu.analysis.cost_model.
+resolve_hardware_model`).
+
+The module is stdlib-only (plus :mod:`~horovod_tpu.analysis.
+cost_model`, itself stdlib-only): the measurement side lives in
+``bench.py`` (it needs JAX); everything here — fitting, artifact
+schema, the seeded pure-sim smoke hvdci gate 9 runs — works without
+hardware.  Artifacts carry NO wall-clock fields: the same sweep on
+the same seed must serialize bit-identically (the run-twice CI
+determinism contract every smoke in ``analysis/ci.py`` holds).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from horovod_tpu.analysis import cost_model as CM
+
+#: The collectives the sweep measures per level — the exchange's
+#: building blocks (docs/calibration.md "Sweep design").
+CALIBRATED_COLLECTIVES = ("allreduce", "reduce_scatter", "all_gather",
+                         "ppermute", "all_to_all")
+
+#: Default message-size sweep (bytes): 8 log-spaced points from 64 KiB
+#: to 128 MiB — small enough to expose alpha, large enough to pin beta.
+DEFAULT_SWEEP_BYTES = tuple(2 ** p for p in range(16, 28, 2)) + \
+    (2 ** 27,)
+
+
+@dataclasses.dataclass(frozen=True)
+class LevelFit:
+    """One fitted alpha-beta curve: ``t(n) = alpha_s + n /
+    beta_bytes_per_s``.  ``residual`` is the RMS relative error of the
+    fit over its own points — the staleness/quality signal the
+    artifact carries per curve."""
+
+    collective: str
+    alpha_s: float
+    beta_bytes_per_s: float
+    residual: float
+    n_points: int
+
+    def predict_s(self, nbytes: float) -> float:
+        return self.alpha_s + float(nbytes) / self.beta_bytes_per_s
+
+    def as_json(self) -> Dict:
+        return {"alpha_s": self.alpha_s,
+                "beta_bytes_per_s": self.beta_bytes_per_s,
+                "residual": self.residual,
+                "n_points": self.n_points}
+
+
+def fit_alpha_beta(sizes_bytes: Sequence[float],
+                   times_s: Sequence[float]) -> Tuple[float, float, float]:
+    """Closed-form least-squares fit of ``t(n) = alpha + n/beta``.
+
+    Returns ``(alpha_s, beta_bytes_per_s, rms_relative_residual)``.
+    The slope of the ``t``-on-``n`` regression is ``1/beta``, the
+    intercept ``alpha`` (clamped at 0 — a negative latency is noise,
+    not physics).  Degenerate inputs raise: a sweep needs >= 2
+    distinct sizes to separate latency from bandwidth."""
+    xs = [float(x) for x in sizes_bytes]
+    ys = [float(y) for y in times_s]
+    if len(xs) != len(ys) or len(xs) < 2:
+        raise ValueError("fit needs >= 2 (size, time) pairs")
+    xbar = sum(xs) / len(xs)
+    ybar = sum(ys) / len(ys)
+    sxx = sum((x - xbar) ** 2 for x in xs)
+    if sxx == 0:
+        raise ValueError("fit needs >= 2 distinct sizes")
+    slope = sum((x - xbar) * (y - ybar)
+                for x, y in zip(xs, ys)) / sxx
+    if slope <= 0:
+        raise ValueError(
+            "non-positive time-vs-bytes slope: the sweep did not "
+            "resolve a bandwidth (measure larger messages)")
+    alpha = max(0.0, ybar - slope * xbar)
+    beta = 1.0 / slope
+    sq = 0.0
+    for x, y in zip(xs, ys):
+        pred = alpha + x * slope
+        sq += ((pred - y) / y) ** 2 if y > 0 else 0.0
+    residual = math.sqrt(sq / len(xs))
+    return alpha, beta, residual
+
+
+def fit_level(collective: str,
+              sizes_bytes: Sequence[float],
+              times_s: Sequence[float]) -> LevelFit:
+    alpha, beta, residual = fit_alpha_beta(sizes_bytes, times_s)
+    return LevelFit(collective=collective, alpha_s=alpha,
+                    beta_bytes_per_s=beta, residual=residual,
+                    n_points=len(list(sizes_bytes)))
+
+
+# -- simulated measurements (the deterministic CI path) ---------------------
+
+
+#: Per-collective latency/bandwidth scale relative to the fabric's
+#: reduce-scatter curve — the shape the simulator gives synthetic
+#: sweeps (an allreduce moves ~2x the RS wire; a ppermute has no
+#: reduction tree, so less latency).
+_SIM_COLLECTIVE_SHAPE = {
+    "allreduce": (1.5, 0.5), "reduce_scatter": (1.0, 1.0),
+    "all_gather": (1.0, 1.0), "ppermute": (0.5, 1.2),
+    "all_to_all": (1.2, 0.8),
+}
+
+
+def simulate_sweep(alpha_s: float, beta_bytes_per_s: float,
+                   sizes_bytes: Sequence[float], seed: int,
+                   rel_noise: float = 5e-4) -> List[float]:
+    """Synthetic measured times for a known alpha-beta truth, with
+    seeded multiplicative noise — the pure-sim calibration source
+    (``bench --calibrate --calibrate-sim`` and hvdci gate 9).
+    Deterministic: same ``(alpha, beta, sizes, seed, rel_noise)`` →
+    bit-identical floats."""
+    rng = random.Random(seed)
+    out = []
+    for n in sizes_bytes:
+        t = alpha_s + float(n) / beta_bytes_per_s
+        out.append(t * (1.0 + rng.uniform(-rel_noise, rel_noise)))
+    return out
+
+
+def simulate_level_measurements(level_bw_bytes_per_s: float,
+                                level_alpha_s: float,
+                                sizes_bytes: Sequence[float],
+                                seed: int,
+                                rel_noise: float = 5e-4
+                                ) -> Dict[str, Tuple[List[float],
+                                                     List[float]]]:
+    """One level's full collective sweep from its fabric truth:
+    ``{collective: (sizes, times)}``, each collective's curve shaped
+    by :data:`_SIM_COLLECTIVE_SHAPE` and independently seeded."""
+    out = {}
+    for i, coll in enumerate(CALIBRATED_COLLECTIVES):
+        a_scale, b_scale = _SIM_COLLECTIVE_SHAPE[coll]
+        times = simulate_sweep(level_alpha_s * a_scale,
+                               level_bw_bytes_per_s * b_scale,
+                               sizes_bytes, seed=seed * 1000 + i,
+                               rel_noise=rel_noise)
+        out[coll] = (list(float(s) for s in sizes_bytes), times)
+    return out
+
+
+# -- the artifact -----------------------------------------------------------
+
+
+def build_artifact(*,
+                   device_kind: str,
+                   platform: str,
+                   n_devices: int,
+                   mesh_shape: Sequence[int],
+                   level_order: Sequence[str],
+                   level_fits: Dict[str, Sequence[LevelFit]],
+                   level_extents: Dict[str, int],
+                   matmul_flops_per_s: float,
+                   hbm_bytes_per_s: float,
+                   source: str,
+                   seed: Optional[int] = None,
+                   jax_version: Optional[str] = None,
+                   jaxlib_version: Optional[str] = None) -> Dict:
+    """Assemble one versioned calibration artifact (docs/calibration.md
+    "Artifact schema").  ``level_order`` is innermost-first; ``source``
+    is ``"measured"`` or ``"simulated"``.  No wall-clock fields — the
+    artifact of a seeded sim run is bit-reproducible."""
+    if source not in ("measured", "simulated"):
+        raise ValueError(f"source must be measured|simulated, got "
+                         f"{source!r}")
+    levels = {}
+    residual_max = 0.0
+    for name in level_order:
+        fits = {f.collective: f.as_json() for f in level_fits[name]}
+        residual_max = max(
+            [residual_max] + [f.residual for f in level_fits[name]])
+        levels[name] = {"extent": int(level_extents[name]),
+                        "collectives": fits}
+    art = {
+        "schema_version": CM.CALIBRATION_SCHEMA_VERSION,
+        "kind": "horovod_calibration",
+        "device_kind": str(device_kind),
+        "platform": str(platform),
+        "n_devices": int(n_devices),
+        "mesh_shape": [int(s) for s in mesh_shape],
+        "level_order": [str(n) for n in level_order],
+        "levels": levels,
+        "matmul_flops_per_s": float(matmul_flops_per_s),
+        "hbm_bytes_per_s": float(hbm_bytes_per_s),
+        "fit_residual_max": residual_max,
+        "source": source,
+        "seed": seed,
+        "jax_version": jax_version,
+        "jaxlib_version": jaxlib_version,
+    }
+    art["calibration_fingerprint"] = CM.calibration_fingerprint(art)
+    return art
+
+
+def validate_calibration(data: Dict) -> List[str]:
+    """Full schema check of one calibration artifact — the consumer
+    subset (:func:`cost_model._calibration_schema_errors`) plus the
+    per-level fit fields hvdci gate 9 verifies.  Returns the error
+    list ([] = valid)."""
+    errs = CM._calibration_schema_errors(data)
+    if errs:
+        return errs
+    for name in data["level_order"]:
+        lv = data["levels"][name]
+        if int(lv.get("extent", 0)) < 1:
+            errs.append(f"level {name!r}: extent must be >= 1")
+        colls = lv.get("collectives", {})
+        if not colls:
+            errs.append(f"level {name!r}: no collective fits")
+        for coll, fit in colls.items():
+            for field in ("alpha_s", "beta_bytes_per_s", "residual",
+                          "n_points"):
+                if field not in fit:
+                    errs.append(
+                        f"level {name!r} {coll}: missing {field!r}")
+            try:
+                if float(fit.get("beta_bytes_per_s", 0)) <= 0:
+                    errs.append(
+                        f"level {name!r} {coll}: beta must be > 0")
+                if float(fit.get("alpha_s", 0)) < 0:
+                    errs.append(
+                        f"level {name!r} {coll}: alpha must be >= 0")
+            except (TypeError, ValueError):
+                errs.append(f"level {name!r} {coll}: non-numeric fit")
+    fp = data.get("calibration_fingerprint")
+    if fp is not None and fp != CM.calibration_fingerprint(data):
+        errs.append("calibration_fingerprint does not match the "
+                    "identity fields")
+    return errs
+
+
+def save_artifact(data: Dict, path: str) -> None:
+    """Atomic JSON write (tmp + rename), sorted keys — byte-stable."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def load_artifact(path: str) -> Dict:
+    with open(path) as f:
+        data = json.load(f)
+    errs = validate_calibration(data)
+    if errs:
+        raise ValueError(f"{path}: " + "; ".join(errs))
+    return data
+
+
+# -- the pure-sim calibrate→fit→price pipeline (gate 9 substrate) -----------
+
+
+def simulated_calibration(hw: CM.HardwareModel = CM.V5E,
+                          level_order: Sequence[str] = ("ici", "dcn"),
+                          level_extents: Optional[Dict[str, int]] = None,
+                          seed: int = 17,
+                          sizes_bytes: Sequence[float] =
+                          DEFAULT_SWEEP_BYTES,
+                          rel_noise: float = 5e-4) -> Dict:
+    """The whole pipeline without hardware: simulate each level's sweep
+    from a preset's truth, fit, assemble the artifact.  Innermost
+    level takes the preset's ICI figures, every outer level the DCN
+    figures (matching :func:`cost_model.level_bandwidths`)."""
+    level_extents = dict(level_extents or
+                         {n: 2 for n in level_order})
+    n_devices = 1
+    for n in level_order:
+        n_devices *= level_extents[n]
+    level_fits: Dict[str, List[LevelFit]] = {}
+    for li, name in enumerate(level_order):
+        bw = hw.ici_bytes_per_s if li == 0 else hw.dcn_bytes_per_s
+        alpha = 2e-6 if li == 0 else 50e-6   # ICI ~µs, DCN ~tens of µs
+        sweeps = simulate_level_measurements(
+            bw, alpha, sizes_bytes, seed=seed + li,
+            rel_noise=rel_noise)
+        level_fits[name] = [fit_level(coll, sizes, times)
+                            for coll, (sizes, times) in sweeps.items()]
+    return build_artifact(
+        device_kind=f"simulated:{hw.name}", platform="sim",
+        n_devices=n_devices,
+        mesh_shape=[level_extents[n] for n in reversed(level_order)],
+        level_order=level_order, level_fits=level_fits,
+        level_extents=level_extents,
+        matmul_flops_per_s=hw.peak_flops_per_s,
+        hbm_bytes_per_s=hw.hbm_bytes_per_s,
+        source="simulated", seed=seed)
+
+
+def run_smoke(root: Optional[str] = None) -> List[str]:
+    """hvdci gate 9: the seeded pure-sim calibrate→fit→price loop, run
+    twice and required bit-identical, plus the artifact schema check —
+    and, when a ``CALIBRATION*.json`` is checked in at the repo root,
+    its schema too.  Returns the error list ([] = pass); sub-second,
+    no JAX."""
+    errors: List[str] = []
+    runs = []
+    for _ in range(2):
+        art = simulated_calibration(seed=17)
+        errs = validate_calibration(art)
+        if errs:
+            errors.extend(f"sim artifact: {e}" for e in errs)
+            break
+        hw = CM.HardwareModel.from_calibration(art)
+        bw = CM.calibration_level_bandwidths(art)
+        levels = tuple(
+            (name, art["levels"][name]["extent"],
+             8 if name == art["level_order"][-1] else None)
+            for name in art["level_order"])
+        wire = CM.exchange_wire_by_level(1e9, levels)
+        price = CM.exchange_time_by_level(wire, bw)
+        runs.append(json.dumps(
+            {"artifact": art, "hw": dataclasses.asdict(hw),
+             "wire": wire, "price": price}, sort_keys=True))
+    if not errors:
+        if len(runs) != 2 or runs[0] != runs[1]:
+            errors.append(
+                "calibrate→fit→price is not deterministic: two seeded "
+                "sim runs serialized differently")
+        art = simulated_calibration(seed=17)
+        hw = CM.HardwareModel.from_calibration(art)
+        # the sim truth must round-trip through the fit: fitted RS beta
+        # within 1% of the preset bandwidth it was simulated from
+        if abs(hw.ici_bytes_per_s - CM.V5E.ici_bytes_per_s) \
+                > 0.01 * CM.V5E.ici_bytes_per_s:
+            errors.append(
+                f"fitted ICI bandwidth {hw.ici_bytes_per_s:.3e} is "
+                f">1% off the simulated truth "
+                f"{CM.V5E.ici_bytes_per_s:.3e}")
+    if root:
+        import glob
+
+        for path in sorted(glob.glob(os.path.join(root,
+                                                  "CALIBRATION*.json"))):
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                errors.append(f"{os.path.basename(path)}: unreadable: "
+                              f"{e}")
+                continue
+            errors.extend(f"{os.path.basename(path)}: {e}"
+                          for e in validate_calibration(data))
+    return errors

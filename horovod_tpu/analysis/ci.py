@@ -1,7 +1,7 @@
 """``python -m horovod_tpu.analysis ci`` / ``hvdci`` — the one-shot CI
 entry point.
 
-Eight gates, one invocation, one exit code (docs/perf_gate.md):
+Nine gates, one invocation, one exit code (docs/perf_gate.md):
 
 1. **hvdlint** over the pre-commit scope (``--changed``: staged +
    unstaged + untracked files under ``horovod_tpu/``; falls back to the
@@ -29,7 +29,12 @@ Eight gates, one invocation, one exit code (docs/perf_gate.md):
    planner — unconstrained vs budgeted search must pick different
    feasible winners, an infeasible budget must raise naming the
    tightest axis, run twice and required bit-identical
-   (docs/memory.md).
+   (docs/memory.md);
+9. the **calibration smoke** (``analysis/calibration.py``): a seeded
+   pure-sim calibrate → fit → ``HardwareModel.from_calibration`` →
+   price round trip, run twice and required bit-identical, plus the
+   artifact schema check over any checked-in ``CALIBRATION*.json``
+   (docs/calibration.md).
 
 The whole run is a tier-1 test with the same <30 s budget as the
 hvdlint self-run, so "CI passed" and "the analysis suite passed" are
@@ -161,12 +166,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         memory_errors = [f"memory-smoke crashed: "
                          f"{type(e).__name__}: {e}"]
 
+    # 9 — calibration smoke: seeded sim calibrate→fit→price, run twice
+    # bit-identical, + schema check over checked-in CALIBRATION*.json
+    try:
+        from horovod_tpu.analysis.calibration import run_smoke as \
+            run_calibration_smoke
+
+        calibration_errors = run_calibration_smoke(root)
+    except Exception as e:          # noqa: BLE001 — a crash IS a failure
+        calibration_errors = [f"calibration-smoke crashed: "
+                              f"{type(e).__name__}: {e}"]
+
     elapsed = time.perf_counter() - t0
     gate_findings = gate.findings if gate is not None else []
     rc = 2 if (art_error or gate_error) else (
         1 if (lint.findings or art_findings or gate_findings
               or metrics_errors or guard_errors or serve_errors
-              or plan_errors or degrade_errors or memory_errors)
+              or plan_errors or degrade_errors or memory_errors
+              or calibration_errors)
         else 0)
 
     if args.json_out:
@@ -179,6 +196,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "plan_smoke_errors": plan_errors,
             "degrade_smoke_errors": degrade_errors,
             "memory_smoke_errors": memory_errors,
+            "calibration_smoke_errors": calibration_errors,
             "perf_gate": gate.as_json() if gate is not None else None,
             "errors": [e for e in (art_error, gate_error) if e],
             "elapsed_s": round(elapsed, 3),
@@ -202,6 +220,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"hvdci: degrade-smoke: {e}")
     for e in memory_errors:
         print(f"hvdci: memory-smoke: {e}")
+    for e in calibration_errors:
+        print(f"hvdci: calibration-smoke: {e}")
     for f in gate_findings:
         print(f.format())
     for err in (art_error, gate_error):
@@ -215,7 +235,8 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"serve-smoke {len(serve_errors)} · "
           f"plan-smoke {len(plan_errors)} · "
           f"degrade-smoke {len(degrade_errors)} · "
-          f"memory-smoke {len(memory_errors)} finding(s) "
+          f"memory-smoke {len(memory_errors)} · "
+          f"calibration-smoke {len(calibration_errors)} finding(s) "
           f"in {elapsed:.2f}s — {'FAIL' if rc else 'ok'}")
     return rc
 

@@ -39,6 +39,7 @@ import dataclasses
 import json
 import math
 import os
+import warnings
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 from horovod_tpu.utils import hlo as H
@@ -60,6 +61,40 @@ class HardwareModel:
     #: pre-memory-plane behavior, docs/memory.md)
     hbm_capacity_bytes: Optional[float] = None
 
+    @staticmethod
+    def from_calibration(artifact: Union["os.PathLike", str, Dict]
+                         ) -> "HardwareModel":
+        """Build a hardware model from a ``bench --calibrate`` artifact
+        (path or already-loaded dict; schema in docs/calibration.md).
+
+        The roofline constants come from the *measured* fits: the
+        matmul FLOP rate and HBM stream rate directly, the fabric
+        bandwidths from the innermost/outermost level's fitted
+        reduce-scatter beta (the collective the exchange is built
+        from).  HBM capacity cannot be microbenchmarked safely, so it
+        is inherited from the builtin preset of the calibrated
+        ``device_kind`` (None when the kind is unknown — feasibility
+        then falls back to the explicit budget knob)."""
+        if not isinstance(artifact, dict):
+            with open(os.fspath(artifact)) as f:
+                artifact = json.load(f)
+        errs = _calibration_schema_errors(artifact)
+        if errs:
+            raise ValueError(
+                "bad calibration artifact: " + "; ".join(errs))
+        bw = calibration_level_bandwidths(artifact)
+        order = list(artifact["level_order"])
+        kind = str(artifact.get("device_kind", ""))
+        preset = preset_for_device_kind(kind, warn=False)
+        return HardwareModel(
+            name=f"calibrated:{kind or 'unknown'}",
+            peak_flops_per_s=float(artifact["matmul_flops_per_s"]),
+            hbm_bytes_per_s=float(artifact["hbm_bytes_per_s"]),
+            ici_bytes_per_s=bw[order[0]],
+            dcn_bytes_per_s=bw[order[-1]],
+            hbm_capacity_bytes=(preset.hbm_capacity_bytes
+                                if preset is not None else None))
+
 
 #: v5e figures: 197 bf16 TFLOP/s, ~810 GB/s measured HBM
 #: (PERF_NOTES.md hardware-envelope round), 1,600 Gbps ICI per chip,
@@ -68,6 +103,188 @@ class HardwareModel:
 V5E = HardwareModel(name="v5e", peak_flops_per_s=197e12,
                     hbm_bytes_per_s=810e9, ici_bytes_per_s=200e9,
                     dcn_bytes_per_s=25e9, hbm_capacity_bytes=16e9)
+
+#: v5p: 459 bf16 TFLOP/s, ~2.77 TB/s HBM3, 4,800 Gbps ICI per chip,
+#: same ~200 Gbps DCN class; 95 GB HBM per chip.
+V5P = HardwareModel(name="v5p", peak_flops_per_s=459e12,
+                    hbm_bytes_per_s=2765e9, ici_bytes_per_s=600e9,
+                    dcn_bytes_per_s=25e9, hbm_capacity_bytes=95e9)
+
+#: v4: 275 bf16 TFLOP/s, ~1.23 TB/s HBM2, 2,400 Gbps ICI per chip;
+#: 32 GB HBM per chip.
+V4 = HardwareModel(name="v4", peak_flops_per_s=275e12,
+                   hbm_bytes_per_s=1228e9, ici_bytes_per_s=300e9,
+                   dcn_bytes_per_s=25e9, hbm_capacity_bytes=32e9)
+
+#: The CPU twin: honest-order-of-magnitude figures for the
+#: 8-virtual-device host the tier-1 suite runs on.  It exists so
+#: ``device_kind``-keyed selection has somewhere loud-warning-free to
+#: land off-TPU; pricing paths that *model the target chip* (bench
+#: autotune pruning, the perf gate roofline) still default to
+#: :data:`V5E` — see :func:`resolve_hardware_model`.
+CPU_TWIN = HardwareModel(name="cpu-twin", peak_flops_per_s=1e12,
+                         hbm_bytes_per_s=50e9, ici_bytes_per_s=10e9,
+                         dcn_bytes_per_s=1e9, hbm_capacity_bytes=None)
+
+#: Builtin presets by name — the ``HOROVOD_HW_PRESET`` vocabulary.
+HW_PRESETS: Dict[str, HardwareModel] = {
+    "v5e": V5E, "v5p": V5P, "v4": V4, "cpu-twin": CPU_TWIN,
+}
+
+#: ``device_kind`` substrings → preset name, checked in order (the
+#: first match wins; jax spells v5e as "TPU v5 lite" / "TPU v5e").
+_DEVICE_KIND_PRESETS: Tuple[Tuple[str, str], ...] = (
+    ("v5 lite", "v5e"), ("v5litepod", "v5e"), ("v5e", "v5e"),
+    ("v5p", "v5p"), ("v5", "v5p"),
+    ("v4", "v4"),
+    ("cpu", "cpu-twin"),
+)
+
+
+def preset_for_device_kind(device_kind: Optional[str],
+                           warn: bool = True
+                           ) -> Optional[HardwareModel]:
+    """The builtin :class:`HardwareModel` for one jax ``device_kind``
+    string, or ``None`` for an unrecognized chip — after a loud
+    :class:`UserWarning` (``warn=True``): an unknown generation must
+    not silently price as v5e (calibrate it instead;
+    docs/calibration.md)."""
+    kind = (device_kind or "").lower()
+    for needle, name in _DEVICE_KIND_PRESETS:
+        if needle in kind:
+            return HW_PRESETS[name]
+    if warn and device_kind:
+        warnings.warn(
+            f"unrecognized device_kind {device_kind!r}: no builtin "
+            f"HardwareModel preset — run `bench --calibrate` and set "
+            f"HOROVOD_CALIBRATION_PATH (or force one of "
+            f"{sorted(HW_PRESETS)} via HOROVOD_HW_PRESET); pricing "
+            f"falls back to v5e constants until then",
+            UserWarning, stacklevel=2)
+    return None
+
+
+def resolve_hardware_model(calibration_path: Optional[str] = None,
+                           preset: Optional[str] = None,
+                           device_kind: Optional[str] = None,
+                           default: HardwareModel = V5E
+                           ) -> HardwareModel:
+    """Resolve THE hardware model every pricing consumer should use,
+    with explicit precedence (docs/calibration.md):
+
+    1. a calibration artifact — ``calibration_path`` arg, else the
+       ``HOROVOD_CALIBRATION_PATH`` knob (an unreadable/invalid
+       explicit artifact raises: measured constants were promised, a
+       silent fallback to guesses would un-promise them);
+    2. a named preset — ``preset`` arg, else ``HOROVOD_HW_PRESET``
+       (unknown names raise, same reasoning);
+    3. the builtin preset matching ``device_kind`` (unrecognized kinds
+       warn loudly via :func:`preset_for_device_kind` and fall through);
+    4. ``default`` (v5e — the historical constants).
+    """
+    path = calibration_path or os.environ.get("HOROVOD_CALIBRATION_PATH")
+    if path:
+        try:
+            return HardwareModel.from_calibration(path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            raise ValueError(
+                f"HOROVOD_CALIBRATION_PATH={path!r} does not load as a "
+                f"calibration artifact: {e}") from e
+    name = preset or os.environ.get("HOROVOD_HW_PRESET")
+    if name:
+        hw = HW_PRESETS.get(name.strip().lower())
+        if hw is None:
+            raise ValueError(
+                f"unknown HOROVOD_HW_PRESET {name!r}: expected one of "
+                f"{sorted(HW_PRESETS)}")
+        return hw
+    if device_kind:
+        hw = preset_for_device_kind(device_kind)
+        if hw is not None:
+            return hw
+    return default
+
+
+# -- calibration-artifact plumbing (the fit side lives in
+#    analysis/calibration.py; the consumers here read artifacts
+#    directly so the import stays one-way) ----------------------------------
+
+
+#: Fields every calibration artifact must carry (docs/calibration.md).
+CALIBRATION_SCHEMA_VERSION = 1
+_CALIBRATION_REQUIRED = (
+    "schema_version", "kind", "device_kind", "platform", "n_devices",
+    "mesh_shape", "level_order", "levels", "matmul_flops_per_s",
+    "hbm_bytes_per_s", "source",
+)
+#: Identity fields whose digest is the cross-hardware refusal key
+#: (perf_gate.check_comparable): two artifacts calibrated on different
+#: hardware must never be diffed against each other.
+CALIBRATION_IDENTITY_FIELDS = (
+    "device_kind", "platform", "n_devices", "mesh_shape",
+)
+
+
+def _calibration_schema_errors(data: Dict) -> List[str]:
+    """Schema errors of one calibration-artifact dict ([] = valid).
+    The full check (per-level fit fields) lives in
+    ``analysis/calibration.validate_calibration``; this is the subset
+    the consumers need before trusting the numbers."""
+    errs = []
+    if not isinstance(data, dict):
+        return ["artifact is not a JSON object"]
+    for f in _CALIBRATION_REQUIRED:
+        if f not in data:
+            errs.append(f"missing field {f!r}")
+    if errs:
+        return errs
+    if data["kind"] != "horovod_calibration":
+        errs.append(f"kind must be 'horovod_calibration', got "
+                    f"{data['kind']!r}")
+    if int(data["schema_version"]) > CALIBRATION_SCHEMA_VERSION:
+        errs.append(
+            f"schema_version {data['schema_version']} is newer than "
+            f"this reader ({CALIBRATION_SCHEMA_VERSION})")
+    order = data["level_order"]
+    if not order or not isinstance(order, (list, tuple)):
+        errs.append("level_order must be a non-empty list "
+                    "(innermost level first)")
+    elif set(order) != set(data["levels"].keys()):
+        errs.append(f"level_order {list(order)} does not match levels "
+                    f"{sorted(data['levels'])}")
+    for val in ("matmul_flops_per_s", "hbm_bytes_per_s"):
+        try:
+            if float(data[val]) <= 0:
+                errs.append(f"{val} must be > 0")
+        except (TypeError, ValueError):
+            errs.append(f"{val} is not a number")
+    return errs
+
+
+def calibration_fingerprint(data: Dict) -> str:
+    """Stable identity digest of one calibration artifact — the value
+    bench stamps into ``calibration_fingerprint`` and the perf gate
+    refuses to diff across (:data:`CALIBRATION_IDENTITY_FIELDS`)."""
+    import hashlib
+
+    ident = {f: data.get(f) for f in CALIBRATION_IDENTITY_FIELDS}
+    blob = json.dumps(ident, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def calibration_level_bandwidths(data: Dict) -> Dict[str, float]:
+    """Fitted bytes/s per topology level from one calibration artifact:
+    the reduce-scatter beta when present (the collective the exchange
+    composes), else the first fitted collective at that level."""
+    out: Dict[str, float] = {}
+    for name in data["level_order"]:
+        fits = data["levels"][name].get("collectives", {})
+        fit = fits.get("reduce_scatter") or next(iter(fits.values()), None)
+        if fit is None:
+            raise ValueError(f"calibration level {name!r} carries no "
+                             f"collective fits")
+        out[name] = float(fit["beta_bytes_per_s"])
+    return out
 
 
 # -- exchange wire bytes per level ------------------------------------------
@@ -116,13 +333,97 @@ def exchange_wire_bytes(payload_bytes: float,
         raise ValueError(f"hierarchy must be flat|two_level, got "
                          f"{hierarchy!r}")
     n_dcn, n_ici = max(1, int(n_dcn)), max(1, int(n_ici))
-    ici = 2.0 * _ring_factor(n_ici) * payload_bytes
     if hierarchy == "flat":
-        dcn = 2.0 * _ring_factor(n_dcn) * payload_bytes
-    else:
-        dcn = 2.0 * _ring_factor(n_dcn) * (payload_bytes / n_ici) \
-            * (wire_bits_dcn / elem_bits)
-    return WireBytes(ici=ici, dcn=dcn)
+        # single scope, decomposed per fabric with the FULL payload on
+        # both hops — NOT a hierarchical tree (no per-level shrink)
+        return WireBytes(
+            ici=2.0 * _ring_factor(n_ici) * payload_bytes,
+            dcn=2.0 * _ring_factor(n_dcn) * payload_bytes)
+    # two_level IS the 2-deep degenerate tree: full precision inside,
+    # the wire codec on the outermost (slowest) hop
+    by_level = exchange_wire_by_level(
+        payload_bytes,
+        (("ici", n_ici, None), ("dcn", n_dcn, wire_bits_dcn)),
+        elem_bits=elem_bits)
+    return WireBytes(ici=by_level["ici"], dcn=by_level["dcn"])
+
+
+#: Level spec accepted by the tree pricers: ``(name, extent)`` or
+#: ``(name, extent, wire_bits|None)`` tuples, innermost level FIRST
+#: (chip < slice < pod < cluster) — or any object with ``.name`` /
+#: ``.extent`` / ``.wire_bits`` attributes (``runtime/topology.
+#: TopologyLevel`` duck-types in without this module importing JAX).
+LevelSpec = Sequence
+
+
+def _level_triples(levels: LevelSpec
+                   ) -> List[Tuple[str, int, Optional[int]]]:
+    out = []
+    for lv in levels:
+        if hasattr(lv, "name") and hasattr(lv, "extent"):
+            out.append((str(lv.name), max(1, int(lv.extent)),
+                        getattr(lv, "wire_bits", None)))
+        else:
+            name, extent = lv[0], lv[1]
+            bits = lv[2] if len(lv) > 2 else None
+            out.append((str(name), max(1, int(extent)), bits))
+    if not out:
+        raise ValueError("level tree must have at least one level")
+    return out
+
+
+def exchange_wire_by_level(payload_bytes: float,
+                           levels: LevelSpec,
+                           elem_bits: int = 32) -> Dict[str, float]:
+    """Price one hierarchical gradient exchange over an arbitrary
+    N-level topology tree — per-level per-chip bytes, keyed by level
+    name.
+
+    ``levels`` is innermost-first (:data:`LevelSpec`).  Level ℓ
+    reduce-scatters (and later all-gathers) the block surviving the
+    inner levels — ``payload / ∏ inner extents`` — around its own ring
+    at its configured wire width:
+    ``2·(nℓ−1)/nℓ·(B/∏inner)·(bitsℓ/elem)``.  A 2-level
+    ``(ici, dcn)`` tree reproduces :func:`exchange_wire_bytes`'s
+    ``two_level`` numbers exactly (the degenerate-tree pin
+    ``tests/test_calibration.py`` holds)."""
+    out: Dict[str, float] = {}
+    inner = 1
+    for name, extent, bits in _level_triples(levels):
+        width = (bits if bits else elem_bits) / elem_bits
+        out[name] = (2.0 * _ring_factor(extent)
+                     * (float(payload_bytes) / inner) * width)
+        inner *= extent
+    return out
+
+
+def level_bandwidths(levels: LevelSpec,
+                     hw: HardwareModel = V5E) -> Dict[str, float]:
+    """Default bytes/s per level when no calibration artifact supplies
+    measured ones: the innermost level rides ICI, every outer hop the
+    DCN budget (the conservative choice — a middle fabric is at least
+    as fast as the slowest one).  A calibrated model replaces this via
+    :func:`calibration_level_bandwidths`."""
+    triples = _level_triples(levels)
+    return {name: (hw.ici_bytes_per_s if i == 0 else hw.dcn_bytes_per_s)
+            for i, (name, _, _) in enumerate(triples)}
+
+
+def exchange_time_by_level(wire_by_level: Dict[str, float],
+                           bandwidths: Dict[str, float]) -> float:
+    """Serial wire seconds of an N-level exchange: each level at its
+    own fabric bandwidth (levels cannot overlap each other — level
+    ℓ+1 consumes level ℓ's output, exactly like
+    :func:`exchange_time_s`).  ``bandwidths`` maps level name →
+    bytes/s (:func:`level_bandwidths` or a calibration artifact's
+    :func:`calibration_level_bandwidths`)."""
+    t = 0.0
+    for name, b in wire_by_level.items():
+        bw = bandwidths.get(name)
+        if bw is None or bw <= 0:
+            raise ValueError(f"no bandwidth for level {name!r}")
+        t += b / bw
+    return t
 
 
 def exchange_time_s(wire: WireBytes, hw: HardwareModel = V5E) -> float:
@@ -227,7 +528,9 @@ def plan_exchange_wire_bytes(plan: Union[str, Dict],
                              payload_bytes: float,
                              n_dcn: int = 1,
                              n_ici: int = 1,
-                             wire_bits_dcn: int = 8) -> WireBytes:
+                             wire_bits_dcn: int = 8,
+                             topology: Optional[LevelSpec] = None
+                             ) -> Union[WireBytes, Dict[str, float]]:
     """Gradient-exchange wire bytes under a parallelism plan.
 
     The model axes (pp/ep/sp/tp) shard the parameters, so each data
@@ -237,11 +540,29 @@ def plan_exchange_wire_bytes(plan: Union[str, Dict],
     absorbs the DCN extent first, the remainder rides ICI, and the
     exchange goes two-level exactly when both derived extents exceed
     1 — the same decision ``resolve_hierarchy`` makes at trace time.
+
+    ``topology`` (an innermost-first :data:`LevelSpec` whose extents
+    factor the plan's data world) prices the exchange over that
+    N-level tree instead and changes the return to the per-level dict
+    of :func:`exchange_wire_by_level` — the pricing the N-level
+    resolved topology (``runtime/topology.resolve_topology``) feeds
+    in; the 2-level default keeps the :class:`WireBytes` contract.
     """
     ext = parse_plan(plan)
     model = ext["pp"] * ext["ep"] * ext["sp"] * ext["tp"]
     per_replica = float(payload_bytes) / max(1, model)
     data_world = ext["dp"] * ext["fsdp"]
+    if topology is not None:
+        triples = _level_triples(topology)
+        tree_world = 1
+        for _, extent, _ in triples:
+            tree_world *= extent
+        if tree_world != data_world:
+            raise ValueError(
+                f"topology world {tree_world} does not factor the "
+                f"plan's data world {data_world} "
+                f"(dp={ext['dp']}, fsdp={ext['fsdp']})")
+        return exchange_wire_by_level(per_replica, triples)
     d_dcn = min(ext["dp"], max(1, int(n_dcn)))
     while data_world % d_dcn:
         d_dcn -= 1
@@ -756,19 +1077,55 @@ def _op_wire_bytes(op: H.CollectiveOp, world: int) -> float:
 
 def collective_wire_by_level(ops: Sequence[H.CollectiveOp],
                              n_dcn: int = 1,
-                             n_ici: int = 1) -> Dict[str, float]:
+                             n_ici: int = 1,
+                             topology: Optional[LevelSpec] = None
+                             ) -> Dict[str, float]:
     """Attribute each compiled collective's wire bytes to a fabric
-    level: an op whose replica-group size equals the DCN extent (on a
-    factored mesh) runs the cross-slice hop; everything else — the
-    intra-slice scopes and world-sized flat collectives — rides ICI.
-    This is the per-level measurement the overlap probe embeds in bench
-    artifacts (``exchange_wire_bytes_ici``/``_dcn``) for the perf gate
-    to diff."""
-    n_dcn, n_ici = max(1, int(n_dcn)), max(1, int(n_ici))
-    world = n_dcn * n_ici
-    out = {"ici": 0.0, "dcn": 0.0}
+    level of the resolved topology tree.  ``topology`` is an
+    innermost-first :data:`LevelSpec`; the default is the 2-level
+    ``(ici, dcn)`` runtime mesh, keeping the historical
+    ``{"ici": ..., "dcn": ...}`` keys the overlap probe embeds in
+    bench artifacts (``exchange_wire_bytes_ici``/``_dcn``) for the
+    perf gate to diff.
+
+    Attribution consults BOTH the replica-group size and the group
+    *stride* (``utils/hlo.replica_group_stride``): level ℓ of a
+    row-major mesh produces groups of size ``extentℓ`` whose members
+    step by ``∏ inner extents`` device ids, so two levels with equal
+    extents no longer alias (the former size-only rule booked every
+    ``n_dcn``-sized group — including intra-slice ones on an
+    ``n_ici == n_dcn`` mesh — to the DCN hop).  Ops matching no level
+    (world-sized flat collectives, scopeless spellings) ride the
+    innermost fabric, as before."""
+    if topology is None:
+        n_dcn, n_ici = max(1, int(n_dcn)), max(1, int(n_ici))
+        topology = (("ici", n_ici, None), ("dcn", n_dcn, None))
+    triples = _level_triples(topology)
+    # level ℓ's replica groups on a row-major device order: size =
+    # extentℓ, member stride = product of the extents inside it
+    level_sig: List[Tuple[str, int, int]] = []   # (name, size, stride)
+    world = 1
+    for name, extent, _ in triples:
+        level_sig.append((name, extent, world))
+        world *= extent
+    innermost = triples[0][0]
+    out: Dict[str, float] = {name: 0.0 for name, _, _ in triples}
     for op in ops:
-        level = "dcn" if n_dcn > 1 and op.group_size == n_dcn else "ici"
+        stride = H.replica_group_stride(op.replica_groups)
+        candidates = [(name, sz, st) for name, sz, st in level_sig
+                      if sz > 1 and op.group_size == sz]
+        level = innermost
+        if len(candidates) == 1 and (
+                stride is None or candidates[0][2] == stride):
+            level = candidates[0][0]
+        elif len(candidates) > 1:
+            # equal extents at different levels: the stride decides;
+            # a stride matching no level (or unknown) books innermost —
+            # the conservative fabric, same as the no-candidate case
+            for name, _, st in candidates:
+                if stride == st:
+                    level = name
+                    break
         out[level] += _op_wire_bytes(op, world)
     return out
 

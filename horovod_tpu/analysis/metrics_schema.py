@@ -135,6 +135,16 @@ SP_SERIES = frozenset({
     "hvd_sp_skipped_ring_steps",
 })
 
+# the hardware-calibration plane's closed series vocabulary
+# (docs/calibration.md): sweep volume, fitted curves and the worst
+# per-curve RMS residual ``bench --calibrate`` reports, in the
+# hvd_calibration_* namespace
+CALIBRATION_SERIES = frozenset({
+    "hvd_calibration_points_total",
+    "hvd_calibration_fits_total",
+    "hvd_calibration_fit_residual_max",
+})
+
 
 def _check_guard_series(errors: List[str], obj, field: str) -> None:
     if not isinstance(obj, dict):
@@ -218,6 +228,20 @@ def _check_sp_series(errors: List[str], obj, field: str) -> None:
                 errors.append(
                     f"{field}[{k!r}]: unknown sp series {base!r} — "
                     f"not in metrics_schema.SP_SERIES")
+
+
+def _check_calibration_series(errors: List[str], obj,
+                              field: str) -> None:
+    if not isinstance(obj, dict):
+        return      # shape error already reported by _check_series_map
+    for k in obj:
+        if isinstance(k, str) and k.startswith("hvd_calibration"):
+            base = k.split("{", 1)[0]
+            if base not in CALIBRATION_SERIES:
+                errors.append(
+                    f"{field}[{k!r}]: unknown calibration series "
+                    f"{base!r} — not in "
+                    f"metrics_schema.CALIBRATION_SERIES")
 
 
 def _check_series_map(errors: List[str], obj, field: str) -> None:
@@ -307,6 +331,11 @@ def validate_snapshot(obj: Dict) -> List[str]:
     _check_sp_series(errors, obj.get("counters", {}), "counters")
     _check_sp_series(errors, obj.get("gauges", {}), "gauges")
     _check_sp_series(errors, obj.get("histograms", {}), "histograms")
+    _check_calibration_series(errors, obj.get("counters", {}),
+                              "counters")
+    _check_calibration_series(errors, obj.get("gauges", {}), "gauges")
+    _check_calibration_series(errors, obj.get("histograms", {}),
+                              "histograms")
     return errors
 
 
@@ -327,6 +356,8 @@ def validate_bench_metrics(obj: Dict) -> List[str]:
     _check_memory_series(errors, obj.get("counters", {}), "metrics.counters")
     _check_moe_series(errors, obj.get("counters", {}), "metrics.counters")
     _check_sp_series(errors, obj.get("counters", {}), "metrics.counters")
+    _check_calibration_series(errors, obj.get("counters", {}),
+                              "metrics.counters")
     return errors
 
 

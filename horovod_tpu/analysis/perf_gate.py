@@ -22,6 +22,10 @@ artifacts are diffed.  Schema-versioned artifacts
 (``bench.py`` ``schema_version`` ≥ 1) additionally pin device/mesh
 identity, and the gate REFUSES to diff mismatched identities with a
 clear error instead of producing a nonsense verdict (or a KeyError).
+Calibration provenance is identity too: artifacts stamped with
+differing ``calibration_fingerprint`` (the run consumed a measured
+hardware model via ``HOROVOD_CALIBRATION_PATH``; docs/calibration.md)
+were priced against different machines and are likewise refused.
 
 Rules (ids continue the HLO00x pack; docs/perf_gate.md):
 
@@ -246,6 +250,23 @@ def check_comparable(baseline: Sequence[Artifact],
                 f"{base.name} — {'; '.join(diffs)}; a perf diff "
                 f"across different hardware/mesh identities is "
                 f"meaningless, refusing")
+        # calibration provenance: two artifacts priced/pruned against
+        # measured hardware models fitted on DIFFERENT hardware are not
+        # a perf diff, they are a hardware change (docs/calibration.md)
+        base_fp = base.get("calibration_fingerprint")
+        cand_fp = candidate.get("calibration_fingerprint")
+        if base_fp is not None and cand_fp is not None \
+                and base_fp != cand_fp:
+            raise GateError(
+                f"{candidate.name}: not comparable with {base.name} — "
+                f"calibration_fingerprint {base_fp!r} vs {cand_fp!r} "
+                f"(calibrated on "
+                f"{base.get('calibration_device_kind')!r} vs "
+                f"{candidate.get('calibration_device_kind')!r}); a "
+                f"perf diff across different measured hardware models "
+                f"is meaningless — recalibrate on one machine "
+                f"(bench --calibrate) or drop the stale artifact, "
+                f"refusing")
 
 
 def _keys_match(a: Artifact, b: Artifact, keys: Tuple[str, ...]) -> bool:
@@ -441,8 +462,14 @@ def _predictions(trajectory: Sequence[Artifact],
     platform = target.get("platform")
     if platform is not None and platform != "tpu":
         return out
+    # calibration artifact > preset knob > device_kind preset > v5e;
+    # device_kind only steers the preset on real TPU artifacts — the
+    # precedence chain of docs/calibration.md
+    hw = CM.resolve_hardware_model(
+        device_kind=target.get("device_kind")
+        if platform == "tpu" else None)
     cal = CM.calibrate([t.fields for t in trajectory
-                        if t.name != target.name])
+                        if t.name != target.name], hw=hw)
     for w in CM.workloads_from_artifact(target.fields):
         pred = CM.predict_rate(cal, w)
         measured = _numeric(target.get(w.rate_field))

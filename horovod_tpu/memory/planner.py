@@ -116,7 +116,7 @@ def search_memory_plans(plans: Sequence[Union[str, Dict]], *,
                         param_bytes: float,
                         activation_bytes: float,
                         budget_bytes: Optional[float] = None,
-                        hw: CM.HardwareModel = CM.V5E,
+                        hw: Optional[CM.HardwareModel] = None,
                         remat_policies: Sequence[str]
                         = DEFAULT_REMAT_POLICIES,
                         microbatches: Sequence[int]
@@ -154,6 +154,11 @@ def search_memory_plans(plans: Sequence[Union[str, Dict]], *,
     """
     if not plans:
         raise ValueError("search_memory_plans needs at least one plan")
+    if hw is None:
+        # calibration artifact > preset knob > v5e — the same measured
+        # constants the cost model and perf gate price with
+        # (docs/calibration.md "Precedence")
+        hw = CM.resolve_hardware_model()
     scored = []
     for plan in plans:
         ps = _plan_string(plan)

@@ -645,6 +645,163 @@ def exchange_index_axes(outer_axis: str = AXIS_DCN,
     return (inner_axis, outer_axis)
 
 
+@dataclasses.dataclass(frozen=True)
+class ExchangeLevel:
+    """One level of the N-level tree exchange: the mesh axis (or axis
+    tuple, for a degenerate flat level spanning the world) this level's
+    collectives scope to, and the wire-codec width on its hop (None =
+    full precision).  Levels are ordered INNERMOST first — chip <
+    slice < pod < cluster (``runtime/topology.TopologyTree``)."""
+
+    axis: AxisSpec
+    quantized_bits: Optional[int] = None
+
+
+def exchange_levels_from_topology(tree) -> Tuple["ExchangeLevel", ...]:
+    """The :class:`ExchangeLevel` sequence of one resolved
+    ``runtime/topology.TopologyTree``: each level scopes to its own
+    mesh axis at its configured ``wire_bits`` — how the per-level
+    codec knob (``HOROVOD_EXCHANGE_LEVEL_CODECS``) reaches the data
+    plane."""
+    return tuple(ExchangeLevel(axis=lv.axis_spec,
+                               quantized_bits=lv.wire_bits)
+                 for lv in tree.levels)
+
+
+def tree_index_axes(levels: Sequence[ExchangeLevel]) -> Tuple[str, ...]:
+    """Axis tuple whose row-major linearization matches the shard
+    ownership of :func:`tree_reducescatter` — the N-level
+    generalization of :func:`exchange_index_axes`.
+
+    Phase ℓ reduce-scatters the block surviving the inner phases, so
+    the rank holding flat-buffer block ``k`` satisfies ``k = i₀·(n₁·…)
+    + i₁·(n₂·…) + …`` — row-major over the levels innermost-FIRST
+    (level 0 is the slowest digit).  Feed this tuple to
+    :func:`tree_allgather` / :func:`local_fusion_shards` /
+    :func:`axis_index` so slices and reassembly line up."""
+    axes: List[str] = []
+    for lv in levels:
+        ax = lv.axis
+        if isinstance(ax, str):
+            axes.append(ax)
+        else:
+            axes.extend(ax)
+    return tuple(axes)
+
+
+def tree_reducescatter(xs: Sequence[jax.Array],
+                       levels: Sequence[ExchangeLevel],
+                       op: ReduceOp = Sum,
+                       prescale_factor: Optional[float] = None,
+                       postscale_factor: Optional[float] = None,
+                       bucket_bytes: Optional[int] = None,
+                       spec: Optional[FusionSpec] = None,
+                       fused_tail: bool = False,
+                       residuals: Optional[Dict[str, jax.Array]] = None):
+    """N-level topology-aware reduce-scatter: the reduce phase of the
+    tree exchange, composed per level from the resolved topology
+    (``runtime/topology.resolve_topology``).  Phase ℓ reduce-scatters
+    the block surviving phases 0..ℓ-1 over level ℓ's axis, so level
+    ℓ's fabric carries only ``(nℓ−1)/nℓ · B/∏inner`` bytes — the
+    hierarchical shrink that makes the slow hops cheap, now at any
+    depth.  A 1-level tree is the flat exchange, a 2-level tree is
+    exactly :func:`hierarchical_reducescatter` (which delegates here);
+    the parity pins in ``tests/test_hierarchy_smoke.py`` and
+    ``tests/test_collectives.py`` hold the degeneracies.
+
+    Per-level codec: each :class:`ExchangeLevel` with
+    ``quantized_bits`` runs its hop through the shared-scale codec.
+    The INNERMOST level's codec gets per-leaf segment scales (its
+    input buffer is still whole, so segment boundaries are static) and
+    honors ``residuals`` (error feedback, changing the return to
+    ``(shards, spec, new_residuals)``); outer levels share one scale
+    per block — the inner scatter makes segment boundaries
+    rank-dependent, exactly the two-level DCN-hop constraint.
+    ``fused_tail`` splits the LAST group's innermost hop into
+    :data:`FUSED_TAIL_TILES` sub-collectives (codec wins when both are
+    requested, matching :func:`grouped_reducescatter`'s branch order).
+
+    Ownership is row-major over :func:`tree_index_axes`; reassemble
+    with :func:`tree_allgather`.
+    """
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("tree_reducescatter supports op=Sum/Average")
+    levels = tuple(levels)
+    if not levels:
+        raise ValueError("tree_reducescatter needs >= 1 level")
+    if residuals is not None and levels[0].quantized_bits is None:
+        raise ValueError(
+            "residuals carry the innermost hop's codec error "
+            "feedback; give levels[0] quantized_bits to enable it")
+    sizes = [int(axis_size(lv.axis)) for lv in levels]
+    world = 1
+    for n in sizes:
+        world *= n
+    if spec is None:
+        spec = make_fusion_spec(xs, world, bucket_bytes)
+    elif spec.world != world:
+        raise ValueError(
+            f"spec was planned for world {spec.world}, the "
+            f"{len(levels)}-level tree has {world}")
+    shards: Dict[str, jax.Array] = {}
+    new_residuals: Dict[str, jax.Array] = \
+        dict(residuals) if residuals is not None else {}
+    for gi, g in enumerate(spec.groups):
+        block = _group_flat(g, xs, prescale_factor)
+        floating = jnp.issubdtype(block.dtype, jnp.floating)
+        if op == ReduceOp.AVERAGE and not floating:
+            raise ValueError(
+                f"op=Average requires floating dtypes, got {g.dtype}")
+        for li, lv in enumerate(levels):
+            ax = lv.axis if isinstance(lv.axis, str) else tuple(lv.axis)
+            bits = lv.quantized_bits
+            if li == 0 and bits is not None and floating:
+                # innermost hop: whole buffer, static per-leaf segment
+                # boundaries — pad rides the last segment (zeros never
+                # raise its absmax); EF when the caller carries state
+                segs = list(g.sizes)
+                segs[-1] += g.padded - sum(g.sizes)
+                if residuals is not None and g.key in residuals:
+                    block, new_residuals[g.key] = \
+                        ef_quantized_reducescatter(
+                            block, axis=ax, op=ReduceOp.SUM,
+                            residual=residuals[g.key], bits=bits,
+                            segments=tuple(segs))
+                else:
+                    block = quantized_reducescatter(
+                        block, axis=ax, op=ReduceOp.SUM, bits=bits,
+                        segments=tuple(segs))
+            elif li == 0 and fused_tail and gi == len(spec.groups) - 1:
+                block = _tiled_psum_scatter(block, ax, sizes[0])
+            elif bits is not None and floating:
+                # outer hop: the surviving block, one shared scale —
+                # segment boundaries are rank-dependent after the
+                # inner scatter, so per-leaf scales cannot ride here
+                block = quantized_reducescatter(
+                    block, axis=ax, op=ReduceOp.SUM, bits=bits)
+            else:
+                block = lax.psum_scatter(block, ax, tiled=True)
+        if op == ReduceOp.AVERAGE:
+            block = _scale(block, 1.0 / world)
+        shards[g.key] = _scale(block, postscale_factor)
+    if residuals is not None:
+        return shards, spec, new_residuals
+    return shards, spec
+
+
+def tree_allgather(shards: Dict[str, jax.Array], spec: FusionSpec,
+                   levels: Sequence[ExchangeLevel]) -> list:
+    """Reassemble the shards of :func:`tree_reducescatter` — the
+    gather phase of the tree exchange, mirrored outermost-first: each
+    level's all-gather runs while the buffers are still shrunk by
+    every level inside it, so every fabric moves the minimum possible
+    bytes (the N-level form of :func:`hierarchical_allgather`).
+    Gathering over :func:`tree_index_axes` makes the concatenation
+    order row-major over exactly the scatter's ownership
+    linearization, so this is its precise inverse."""
+    return grouped_allgather(shards, spec, axis=tree_index_axes(levels))
+
+
 def hierarchical_reducescatter(xs: Sequence[jax.Array],
                                op: ReduceOp = Sum,
                                outer_axis: str = AXIS_DCN,
@@ -717,54 +874,17 @@ def hierarchical_reducescatter(xs: Sequence[jax.Array],
         raise ValueError(
             f"spec was planned for world {spec.world}, mesh "
             f"({outer_axis},{inner_axis}) has {world}")
-    shards: Dict[str, jax.Array] = {}
-    new_residuals: Dict[str, jax.Array] = \
-        dict(inner_residuals) if inner_residuals is not None else {}
-    for gi, g in enumerate(spec.groups):
-        flat = _group_flat(g, xs, prescale_factor)
-        floating = jnp.issubdtype(flat.dtype, jnp.floating)
-        if op == ReduceOp.AVERAGE and not floating:
-            raise ValueError(
-                f"op=Average requires floating dtypes, got {g.dtype}")
-        # phase 1 — intra-slice (ICI): full-precision reduce-scatter;
-        # g.padded is a multiple of world = n_inner * n_outer, so the
-        # surviving block length is still divisible by n_outer.  With
-        # fused_tail, the LAST group's intra phase goes tile-granular
-        # (the DCN phase already rides the 1/n_inner shard and stays
-        # monolithic so the codec scale agreement is unchanged).
-        # quantize_inner replaces this hop with the shared-scale codec
-        # (per-leaf segments, pad riding the last one — the flat
-        # quantized path's convention), error-fed-back when the caller
-        # carries residuals.
-        if quantize_inner and floating:
-            segs = list(g.sizes)
-            segs[-1] += g.padded - sum(g.sizes)
-            if inner_residuals is not None and g.key in inner_residuals:
-                block, new_residuals[g.key] = ef_quantized_reducescatter(
-                    flat, axis=inner_axis, op=ReduceOp.SUM,
-                    residual=inner_residuals[g.key],
-                    bits=quantized_bits, segments=tuple(segs))
-            else:
-                block = quantized_reducescatter(
-                    flat, axis=inner_axis, op=ReduceOp.SUM,
-                    bits=quantized_bits, segments=tuple(segs))
-        elif fused_tail and gi == len(spec.groups) - 1:
-            block = _tiled_psum_scatter(flat, inner_axis, n_inner)
-        else:
-            block = lax.psum_scatter(flat, inner_axis, tiled=True)
-        # phase 2 — cross-slice (DCN) on the 1/n_inner block
-        if quantized_bits is not None and floating:
-            red = quantized_reducescatter(block, axis=outer_axis,
-                                          op=ReduceOp.SUM,
-                                          bits=quantized_bits)
-        else:
-            red = lax.psum_scatter(block, outer_axis, tiled=True)
-        if op == ReduceOp.AVERAGE:
-            red = _scale(red, 1.0 / world)
-        shards[g.key] = _scale(red, postscale_factor)
-    if inner_residuals is not None:
-        return shards, spec, new_residuals
-    return shards, spec
+    # the two-level exchange is the 2-level degenerate tree: ICI is the
+    # innermost level (per-leaf segment codec iff quantize_inner, the
+    # fused tail), DCN the outer (shared-scale codec iff quantized_bits)
+    levels = (ExchangeLevel(inner_axis,
+                            quantized_bits if quantize_inner else None),
+              ExchangeLevel(outer_axis, quantized_bits))
+    return tree_reducescatter(xs, levels, op=op,
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor,
+                              spec=spec, fused_tail=fused_tail,
+                              residuals=inner_residuals)
 
 
 def hierarchical_allgather(shards: Dict[str, jax.Array], spec: FusionSpec,
@@ -778,8 +898,9 @@ def hierarchical_allgather(shards: Dict[str, jax.Array], spec: FusionSpec,
     ``(inner, outer)`` tuple makes the concatenation order row-major
     over exactly the ownership linearization of the scatter (see
     :func:`exchange_index_axes`), so this is its precise inverse."""
-    return grouped_allgather(
-        shards, spec, axis=exchange_index_axes(outer_axis, inner_axis))
+    return tree_allgather(shards, spec,
+                          (ExchangeLevel(inner_axis),
+                           ExchangeLevel(outer_axis)))
 
 
 def grouped_allgather(shards: Dict[str, jax.Array], spec: FusionSpec,

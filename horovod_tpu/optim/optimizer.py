@@ -28,7 +28,7 @@ opt)``, wrapped in ``optax.MultiSteps`` when ``backward_passes_per_step >
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Sequence, Tuple, Union
+from typing import Dict, NamedTuple, Optional, Sequence, Tuple, Union
 
 import os
 
@@ -41,7 +41,9 @@ from horovod_tpu.ops.collectives import Average, ReduceOp
 from horovod_tpu.runtime.topology import (
     GLOBAL_AXES,
     HIERARCHY_MODES,
+    TOPOLOGY_MODES,
     resolve_hierarchy,
+    resolve_topology,
 )
 
 AxisSpec = Union[str, Sequence[str]]
@@ -270,7 +272,9 @@ def sharded_distributed_update(optimizer: optax.GradientTransformation,
                                world: Optional[int] = None,
                                hierarchy: str = "auto",
                                fused_collectives: str = "auto",
-                               error_feedback: bool = False
+                               error_feedback: bool = False,
+                               level_codecs: Optional[
+                                   Dict[str, Optional[int]]] = None
                                ) -> optax.GradientTransformation:
     """ZeRO-style sharded rewrite of ``chain(distributed_gradients,
     optimizer)``: reduce-scatter the gradients, run ``optimizer`` on
@@ -287,6 +291,16 @@ def sharded_distributed_update(optimizer: optax.GradientTransformation,
     (:func:`horovod_tpu.runtime.topology.resolve_hierarchy`).  With
     ``quantized_bits``, the two-level form scopes the int8 wire codec
     to the DCN hop only — ICI hops stay full precision.
+
+    ``"tree"`` generalizes to the N-level exchange: ``axis`` names the
+    mesh axes outermost-first (cluster > pod > slice > chip), phase ℓ
+    reduce-scatters the block surviving the inner phases over level
+    ℓ's axis (:func:`horovod_tpu.ops.collectives.tree_reducescatter`),
+    and ``level_codecs`` (``{axis_name: wire_bits|None}``, the parsed
+    ``HOROVOD_EXCHANGE_LEVEL_CODECS`` grammar) places the codec per
+    level; without it ``quantized_bits`` rides the outermost hop only,
+    exactly the two-level convention.  A 2-axis tree IS two_level and
+    a 1-axis tree IS flat — the degeneracies the parity pins hold.
 
     ``error_feedback=True`` (requires ``quantized_bits``) carries the
     codec's per-group rounding residual in the optimizer state and adds
@@ -338,9 +352,9 @@ def sharded_distributed_update(optimizer: optax.GradientTransformation,
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError("sharded_distributed_update supports "
                          "op=Sum/Average")
-    if hierarchy not in HIERARCHY_MODES:
+    if hierarchy not in TOPOLOGY_MODES:
         raise ValueError(
-            f"hierarchy must be one of {HIERARCHY_MODES}, got "
+            f"hierarchy must be one of {TOPOLOGY_MODES}, got "
             f"{hierarchy!r}")
     if error_feedback and quantized_bits is None:
         raise ValueError(
@@ -386,9 +400,41 @@ def sharded_distributed_update(optimizer: optax.GradientTransformation,
         # resolved at trace time: inside shard_map the axis extents are
         # static, so the branch compiles away and the program contains
         # exactly one exchange topology
-        mode = resolve_hierarchy(hierarchy, _static_axis_sizes(axis))
+        topo = resolve_topology(hierarchy, _static_axis_sizes(axis),
+                                axis_names=axes_names,
+                                wire_bits=quantized_bits,
+                                level_codecs=level_codecs)
+        mode = topo.mode
         residuals = state.residuals if error_feedback else None
-        if mode == "two_level":
+        if mode == "tree":
+            levels = [C.ExchangeLevel(lv.axis_spec, lv.wire_bits)
+                      for lv in topo.effective().levels]
+            if residuals is not None \
+                    and levels[0].quantized_bits is None:
+                # EF turns on the innermost codec — the tree twin of
+                # quantize_inner (the residual pins that hop)
+                levels[0] = C.ExchangeLevel(levels[0].axis,
+                                            quantized_bits)
+            if residuals is not None:
+                shards, spec, residuals = C.tree_reducescatter(
+                    leaves, levels, op=op,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                    bucket_bytes=bucket_bytes,
+                    fused_tail=fused_tail,
+                    residuals=residuals)
+            else:
+                shards, spec = C.tree_reducescatter(
+                    leaves, levels, op=op,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                    bucket_bytes=bucket_bytes,
+                    fused_tail=fused_tail)
+            # shard ownership is row-major over the levels
+            # innermost-FIRST — the N-level generalization of
+            # exchange_index_axes
+            own_axes = C.tree_index_axes(levels)
+        elif mode == "two_level":
             outer, inner_ax = axes_names
             if residuals is not None:
                 # EF turns on the ICI codec too — the residual pins it
@@ -461,7 +507,9 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          exchange_bucket_bytes: Optional[int] = None,
                          hierarchy: str = "auto",
                          fused_collectives: str = "auto",
-                         error_feedback: bool = False
+                         error_feedback: bool = False,
+                         level_codecs: Optional[
+                             Dict[str, Optional[int]]] = None
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so each update uses cross-replica-reduced
     gradients (reference ``DistributedOptimizer`` factory,
@@ -502,6 +550,10 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         raise ValueError(
             "hierarchy selects the sharded exchange topology; pass "
             "shard_optimizer_states=True to enable it")
+    if level_codecs is not None and not shard_optimizer_states:
+        raise ValueError(
+            "level_codecs places wire codecs on the sharded exchange's "
+            "tree levels; pass shard_optimizer_states=True to enable it")
     if fused_collectives != "auto" and not shard_optimizer_states:
         raise ValueError(
             "fused_collectives schedules the sharded exchange's final "
@@ -552,7 +604,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
             bucket_bytes=exchange_bucket_bytes,
             hierarchy=hierarchy,
             fused_collectives=fused_collectives,
-            error_feedback=error_feedback)
+            error_feedback=error_feedback,
+            level_codecs=level_codecs)
         if backward_passes_per_step > 1:
             return optax.MultiSteps(
                 chained, every_k_schedule=backward_passes_per_step)

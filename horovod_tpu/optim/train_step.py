@@ -300,6 +300,7 @@ class DistributedTrainStep:
                     "it needs a wire-reduction compression "
                     "(Compression.int8)")
         self._error_feedback = bool(error_feedback)
+        level_codecs = None
         if shard_optimizer_states and state.is_initialized():
             # env-contract defaults (HOROVOD_EXCHANGE_*): explicit
             # arguments rule; unset knobs fall back to runtime config
@@ -311,6 +312,12 @@ class DistributedTrainStep:
             if fused_collectives == "auto" and \
                     getattr(cfg, "fused_collectives", "auto") != "auto":
                 fused_collectives = cfg.fused_collectives
+            if getattr(cfg, "exchange_level_codecs", None):
+                from horovod_tpu.runtime.topology import parse_level_codecs
+
+                level_codecs = parse_level_codecs(
+                    cfg.exchange_level_codecs)
+        self._level_codecs = level_codecs
         self._hierarchy = hierarchy
         # the mode the compiled exchange will actually run ("auto" made
         # static against the platform) — an AOT-key field and the value
@@ -529,14 +536,16 @@ class DistributedTrainStep:
                     world=world,
                     hierarchy=hierarchy,
                     fused_collectives=self._fused_collectives,
-                    error_feedback=self._error_feedback)
-                from horovod_tpu.runtime.topology import resolve_hierarchy
+                    error_feedback=self._error_feedback,
+                    level_codecs=self._level_codecs)
+                from horovod_tpu.runtime.topology import resolve_topology
 
                 # the mode the compiled step will actually run (the
                 # "auto" decision made static against this mesh) — what
                 # bench.py emits as exchange_hierarchy
-                self._hierarchy = resolve_hierarchy(
-                    hierarchy, [self._mesh.shape[a] for a in axes])
+                self._hierarchy = resolve_topology(
+                    hierarchy, [self._mesh.shape[a] for a in axes],
+                    axis_names=axes).mode
             elif op is not None:
                 from horovod_tpu.optim.optimizer import distributed_gradients
 

@@ -39,6 +39,13 @@ KNOWN_KNOBS = frozenset({
     "HOROVOD_EXCHANGE_WIRE_DTYPE", "HOROVOD_FUSED_COLLECTIVES",
     "HOROVOD_ADASUM_NUM_CHUNKS", "HOROVOD_DEBUG_SPARSE",
     "HOROVOD_TPU_MESH_SHAPE",
+    # -- N-level exchange codec map (runtime/topology.py,
+    #    docs/calibration.md): "dcn=int8,ici=fp32"-style per-level wire
+    #    dtypes for hierarchy=tree meshes
+    "HOROVOD_EXCHANGE_LEVEL_CODECS",
+    # -- measured hardware model (analysis/cost_model.py,
+    #    docs/calibration.md): calibration artifact > preset > builtin
+    "HOROVOD_CALIBRATION_PATH", "HOROVOD_HW_PRESET",
     # -- parallelism plan (parallel/plan.py, docs/parallelism.md):
     # the ShardingPlan grammar, e.g. "dp=4,tp=2" or "dp=2,pp=2,v=2"
     "HOROVOD_PLAN",
@@ -203,6 +210,10 @@ class Config:
     # (e4m3 floating wire — coarser mantissa, no shared-scale clipping
     # of outlier segments); docs/overlap.md
     exchange_wire_dtype: str = "int8"
+    # per-level wire codec map for N-level (tree) meshes, the
+    # "dcn=int8,ici=fp32" grammar of topology.parse_level_codecs();
+    # None defers to exchange_wire_dtype on the outermost level only
+    exchange_level_codecs: Optional[str] = None
     # tile-fused matmul⊗collective kernels (docs/fused_kernels.md):
     # "auto" enables on TPU only, "on"/"off" force; a new autotune
     # axis next to bucket bytes + hierarchy
@@ -274,6 +285,13 @@ class Config:
     offload_optimizer: bool = False
     offload_depth: int = 2
 
+    # -- measured hardware model (analysis/cost_model.py,
+    # docs/calibration.md): path to a bench --calibrate artifact and/or
+    # a named preset ("v5e"/"v5p"/"v4"/"cpu-twin"); precedence is
+    # calibration artifact > preset > device_kind preset > v5e
+    calibration_path: Optional[str] = None
+    hw_preset: Optional[str] = None
+
     # knobs the user set explicitly must not be autotuned
     # (reference "fixed" flag, operations.cc:436)
     fixed_knobs: frozenset = frozenset()
@@ -294,6 +312,7 @@ class Config:
         mark("HOROVOD_EXCHANGE_BUCKET_BYTES", "exchange_bucket_bytes")
         mark("HOROVOD_EXCHANGE_HIERARCHY", "exchange_hierarchy")
         mark("HOROVOD_EXCHANGE_WIRE_DTYPE", "exchange_wire_dtype")
+        mark("HOROVOD_EXCHANGE_LEVEL_CODECS", "exchange_level_codecs")
         mark("HOROVOD_FUSED_COLLECTIVES", "fused_collectives")
         mark("HOROVOD_PLAN", "plan")
         mark("HOROVOD_REMAT_POLICY", "remat_policy")
@@ -341,6 +360,8 @@ class Config:
                 "HOROVOD_EXCHANGE_HIERARCHY", "auto").lower(),
             exchange_wire_dtype=_env_str(
                 "HOROVOD_EXCHANGE_WIRE_DTYPE", "int8").lower(),
+            exchange_level_codecs=(
+                os.environ.get("HOROVOD_EXCHANGE_LEVEL_CODECS") or None),
             fused_collectives=_env_str(
                 "HOROVOD_FUSED_COLLECTIVES", "auto").lower(),
             autotune=_env_bool("HOROVOD_AUTOTUNE", False),
@@ -387,5 +408,8 @@ class Config:
             offload_optimizer=_env_bool("HOROVOD_OFFLOAD_OPTIMIZER",
                                         False),
             offload_depth=_env_int("HOROVOD_OFFLOAD_DEPTH", 2),
+            calibration_path=(
+                os.environ.get("HOROVOD_CALIBRATION_PATH") or None),
+            hw_preset=(os.environ.get("HOROVOD_HW_PRESET") or None),
             fixed_knobs=frozenset(fixed),
         )

@@ -22,7 +22,8 @@ manual equivalent.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -107,10 +108,223 @@ def num_slices(devices: Optional[Sequence[jax.Device]] = None) -> int:
     return _detect_num_slices(jax.devices() if devices is None else devices)
 
 
+#: Valid values of the generalized topology knob: the exchange
+#: ``hierarchy`` vocabulary plus ``"tree"``, the explicit N-level form
+#: (:func:`resolve_topology`).
+TOPOLOGY_MODES = HIERARCHY_MODES + ("tree",)
+
+#: Canonical level names of an N-level tree, INNERMOST first — the
+#: chip < slice < pod < cluster containment order (docs/calibration.md
+#: "N-level topology").  A 2-axis mesh keeps the historical
+#: (``ici``, ``dcn``) names so every existing artifact field, HLO
+#: guard and parity pin reads unchanged.
+DEFAULT_LEVEL_NAMES = ("chip", "slice", "pod", "cluster")
+
+#: Per-level wire-codec vocabulary (``HOROVOD_EXCHANGE_LEVEL_CODECS``):
+#: dtype name → wire bits (None = full precision).  Mirrors
+#: ``ops.collectives.WIRE_DTYPES`` + fp32 by value (collectives
+#: imports this module, not the reverse).
+LEVEL_CODEC_BITS = {"fp32": None, "int8": 8, "fp8_e4m3": 8}
+
+
+def parse_level_codecs(spec: Optional[str]) -> Dict[str, Optional[int]]:
+    """Parse the per-level codec knob grammar,
+    ``"level=dtype,level=dtype"`` (e.g. ``"dcn=int8,ici=fp32"`` or
+    ``"pod=fp8_e4m3"``), into ``{level name: wire bits}``.  Unknown
+    dtypes raise; an empty/None spec is ``{}`` (level defaults rule:
+    codec on the outermost hop only)."""
+    out: Dict[str, Optional[int]] = {}
+    if not spec:
+        return out
+    for item in str(spec).split(","):
+        item = item.strip()
+        if not item:
+            continue
+        name, sep, dtype = item.partition("=")
+        name, dtype = name.strip(), dtype.strip().lower()
+        if not sep or not name or dtype not in LEVEL_CODEC_BITS:
+            raise ValueError(
+                f"bad level codec term {item!r}: expected "
+                f"level=dtype with dtype in "
+                f"{sorted(LEVEL_CODEC_BITS)}")
+        if name in out:
+            raise ValueError(f"duplicate level {name!r} in {spec!r}")
+        out[name] = LEVEL_CODEC_BITS[dtype]
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyLevel:
+    """One level of the resolved topology tree.
+
+    ``name`` doubles as the mesh axis name the exchange scopes its
+    collectives to (``axes`` widens it for the degenerate flat tree,
+    whose single level spans every mesh axis).  ``wire_bits`` is the
+    codec width on this level's hop (None = full precision) — the
+    per-level generalization of "int8 on the DCN phase only"."""
+
+    name: str
+    extent: int
+    wire_bits: Optional[int] = None
+    axes: Optional[Tuple[str, ...]] = None
+
+    @property
+    def axis_spec(self):
+        """The axis argument collectives scope to at this level."""
+        return self.axes if self.axes is not None else self.name
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyTree:
+    """The resolved N-level topology: levels INNERMOST first (chip <
+    slice < pod < cluster), so ``levels[0]`` rides the fastest fabric
+    and ``levels[-1]`` the slowest.  The 2-level runtime mesh resolves
+    to ``(ici, dcn)`` and the historical ``"flat"``/``"two_level"``
+    modes are the 1- and 2-deep degenerate cases — every consumer of
+    :func:`resolve_hierarchy` keeps its exact behavior
+    (:attr:`mode`)."""
+
+    levels: Tuple[TopologyLevel, ...]
+
+    def __post_init__(self):
+        if not self.levels:
+            raise ValueError("a topology tree needs >= 1 level")
+
+    @property
+    def world(self) -> int:
+        n = 1
+        for lv in self.levels:
+            n *= lv.extent
+        return n
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(lv.name for lv in self.levels)
+
+    @property
+    def mode(self) -> str:
+        """The legacy hierarchy vocabulary this tree degenerates to:
+        1 level = ``"flat"``, 2 = ``"two_level"``, more = ``"tree"``."""
+        return {1: "flat", 2: "two_level"}.get(len(self.levels),
+                                               "tree")
+
+    def effective(self) -> "TopologyTree":
+        """The tree with extent-1 levels dropped (they move no bytes);
+        keeps >= 1 level so a 1-device world stays representable."""
+        keep = tuple(lv for lv in self.levels if lv.extent > 1)
+        return TopologyTree(levels=keep or self.levels[:1])
+
+    def pricing_levels(self) -> Tuple[Tuple[str, int,
+                                            Optional[int]], ...]:
+        """The ``(name, extent, wire_bits)`` triples the stdlib cost
+        model prices (``analysis/cost_model.exchange_wire_by_level``,
+        ``collective_wire_by_level(topology=...)``)."""
+        return tuple((lv.name, lv.extent, lv.wire_bits)
+                     for lv in self.levels)
+
+
+def resolve_topology(hierarchy: str,
+                     axis_sizes: Sequence[int],
+                     axis_names: Optional[Sequence[str]] = None,
+                     wire_bits: Optional[int] = None,
+                     level_codecs: Optional[Dict[str,
+                                                 Optional[int]]] = None
+                     ) -> TopologyTree:
+    """Resolve the topology knob against the mesh factorization into an
+    N-level :class:`TopologyTree` — the generalization of
+    :func:`resolve_hierarchy` from the hard-coded ICI/DCN pair to
+    chip < slice < pod < cluster trees.
+
+    ``axis_sizes``/``axis_names`` are in MESH order (outermost first,
+    the existing ``(dcn, ici)`` convention); the tree stores levels
+    innermost-first.  Default names: ``("ici",)`` for one axis,
+    ``("dcn", "ici")`` for two (the historical mesh), the outermost-
+    first reversal of :data:`DEFAULT_LEVEL_NAMES` beyond that.
+
+    * ``"flat"`` — one level spanning every axis: a single collective
+      scope over the whole world (``wire_bits`` compresses that whole
+      wire, matching the flat quantized path).
+    * ``"two_level"`` — demands exactly 2 axes (an explicit request
+      must not silently flatten) and scopes ``wire_bits`` to the outer
+      hop only.
+    * ``"tree"`` — every axis is a level; ``wire_bits`` rides the
+      outermost (slowest) hop only.
+    * ``"auto"`` — ``two_level``/``tree`` exactly when >= 2 axes have
+      extent > 1 (size-1 axes are dropped: they move no bytes), else
+      ``flat`` — the same decision rule :func:`resolve_hierarchy`
+      makes, extended to N axes.
+
+    ``level_codecs`` (the parsed ``HOROVOD_EXCHANGE_LEVEL_CODECS``
+    knob, :func:`parse_level_codecs`) overrides the per-level codec
+    width by level name — fp8/int8 on any hop, not just the slowest.
+    """
+    if hierarchy not in TOPOLOGY_MODES:
+        raise ValueError(
+            f"hierarchy must be one of {TOPOLOGY_MODES}, got "
+            f"{hierarchy!r}")
+    sizes = [int(s) for s in axis_sizes]
+    if not sizes:
+        raise ValueError("axis_sizes must name >= 1 mesh axis")
+    if axis_names is None:
+        if len(sizes) == 1:
+            axis_names = (AXIS_ICI,)
+        elif len(sizes) == 2:
+            axis_names = GLOBAL_AXES
+        elif len(sizes) <= len(DEFAULT_LEVEL_NAMES):
+            axis_names = tuple(reversed(
+                DEFAULT_LEVEL_NAMES[:len(sizes)]))
+        else:
+            raise ValueError(
+                f"{len(sizes)} axes exceed the default level names "
+                f"{DEFAULT_LEVEL_NAMES}; pass axis_names explicitly")
+    names = tuple(str(n) for n in axis_names)
+    if len(names) != len(sizes):
+        raise ValueError(
+            f"axis_names {names} does not match {len(sizes)} axis "
+            f"size(s)")
+    codecs = dict(level_codecs or {})
+    unknown = set(codecs) - set(names)
+    if unknown:
+        raise ValueError(
+            f"level codec(s) for unknown level(s) {sorted(unknown)}: "
+            f"tree levels are {list(reversed(names))}")
+    # innermost-first
+    inner_first = list(zip(reversed(names), reversed(sizes)))
+
+    def _level(i, name, extent, default_bits):
+        return TopologyLevel(
+            name=name, extent=extent,
+            wire_bits=codecs.get(name, default_bits))
+
+    if hierarchy == "two_level" and len(sizes) != 2:
+        raise ValueError(
+            "hierarchy='two_level' needs a 2-axis (dp_outer, "
+            f"dp_inner) data-parallel spec, got {len(sizes)} axis/es")
+    if hierarchy == "auto":
+        effective = [s for s in sizes if s > 1]
+        hierarchy = "flat" if len(effective) < 2 else \
+            ("two_level" if len(sizes) == 2 else "tree")
+    if hierarchy == "flat":
+        world = 1
+        for s in sizes:
+            world *= s
+        name = names[-1] if len(names) == 1 else "flat"
+        lv = TopologyLevel(name=name, extent=world,
+                           wire_bits=codecs.get(name, wire_bits),
+                           axes=names if len(names) > 1 else None)
+        return TopologyTree(levels=(lv,))
+    levels = tuple(
+        _level(i, name, extent,
+               wire_bits if i == len(inner_first) - 1 else None)
+        for i, (name, extent) in enumerate(inner_first))
+    return TopologyTree(levels=levels)
+
+
 def resolve_hierarchy(hierarchy: str, axis_sizes: Sequence[int]) -> str:
     """Resolve the ``hierarchy="auto"|"flat"|"two_level"`` knob against
     the data-parallel axis factorization — the decision rule of the
-    two-level exchange.
+    two-level exchange, now the 2-axis degenerate case of
+    :func:`resolve_topology`.
 
     ``axis_sizes`` are the extents of the dp axis spec in mesh order,
     i.e. ``(dp_outer, dp_inner)`` = ``(dcn, ici)`` for the runtime mesh.
@@ -126,14 +340,7 @@ def resolve_hierarchy(hierarchy: str, axis_sizes: Sequence[int]) -> str:
         raise ValueError(
             f"hierarchy must be one of {HIERARCHY_MODES}, got "
             f"{hierarchy!r}")
-    sizes = [int(s) for s in axis_sizes]
-    factored = len(sizes) == 2 and all(s > 1 for s in sizes)
-    if hierarchy == "two_level":
-        if len(sizes) != 2:
-            raise ValueError(
-                "hierarchy='two_level' needs a 2-axis (dp_outer, "
-                f"dp_inner) data-parallel spec, got {len(sizes)} axis/es")
-        return "two_level"
-    if hierarchy == "flat":
-        return "flat"
-    return "two_level" if factored else "flat"
+    mode = resolve_topology(hierarchy, axis_sizes).mode
+    # legacy contract: this resolver only ever answered flat|two_level
+    # (an auto'd >2-axis spec flattened before trees existed)
+    return "flat" if mode == "tree" else mode

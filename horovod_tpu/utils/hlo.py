@@ -107,6 +107,102 @@ def _replica_groups(line: str):
     return raw, parts[-1]
 
 
+_IOTA_GROUPS_RE = re.compile(
+    r"^\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?$")
+
+
+def _transposed_iota(dims: List[int], perm: List[int]) -> List[int]:
+    """``transpose(iota(prod(dims)).reshape(dims), perm).flatten()``
+    in pure stdlib — the device-id order of an iota replica-group
+    attribute with a ``T(...)`` permutation."""
+    strides = [1] * len(dims)
+    for i in range(len(dims) - 2, -1, -1):
+        strides[i] = strides[i + 1] * dims[i + 1]
+    shape_t = [dims[p] for p in perm]
+    n = 1
+    for d in dims:
+        n *= d
+    out = []
+    for flat in range(n):
+        rem, idx_t = flat, []
+        for d in reversed(shape_t):
+            idx_t.append(rem % d)
+            rem //= d
+        idx_t.reverse()
+        out.append(sum(idx_t[k] * strides[perm[k]]
+                       for k in range(len(perm))))
+    return out
+
+
+def replica_group_members(raw: Optional[str]
+                          ) -> Optional[List[List[int]]]:
+    """Materialize a replica-groups attribute into explicit member
+    lists — ``[[0,2],[1,3]]`` — from either the explicit
+    ``{{0,2},{1,3}}`` form or the iota ``[G,S]<=[dims]`` /
+    ``[G,S]<=[dims]T(perm)`` form.  Returns ``None`` for absent/empty
+    attributes and spellings this parser cannot expand (the caller
+    then falls back to size-only reasoning).  The iota ids are the
+    row-major iota over ``dims``, transposed by ``perm`` and reshaped
+    to ``[G,S]``; a 1-D ``dims`` with a 2-D ``perm`` (a spelling some
+    dumps use) is read with the source shape implied by the transpose
+    target."""
+    if not raw or raw == "{}":
+        return None
+    if raw.startswith("{{"):
+        inner = raw[2:-2]
+        groups = []
+        for grp in inner.split("},{"):
+            members = [int(x) for x in grp.split(",") if x.strip()]
+            if members:
+                groups.append(members)
+        return groups or None
+    m = _IOTA_GROUPS_RE.match(raw.replace(" ", ""))
+    if m is None:
+        return None
+    g, s = int(m.group(1)), int(m.group(2))
+    dims = [int(x) for x in m.group(3).split(",")]
+    n = g * s
+    prod = 1
+    for d in dims:
+        prod *= d
+    if prod != n or n == 0:
+        return None
+    if m.group(4) is None:
+        order = list(range(n))
+    else:
+        perm = [int(x) for x in m.group(4).split(",")]
+        if len(perm) != len(dims):
+            # 1-D source with an N-D perm: the source shape is the one
+            # whose transpose-by-perm is the [G,S] target
+            target = [g, s]
+            if len(perm) != 2 or sorted(perm) != [0, 1]:
+                return None
+            dims = [0, 0]
+            for k, p in enumerate(perm):
+                dims[p] = target[k]
+        order = _transposed_iota(dims, perm)
+    return [order[i * s:(i + 1) * s] for i in range(g)]
+
+
+def replica_group_stride(raw: Optional[str]) -> Optional[int]:
+    """Device-id step between consecutive members of the first replica
+    group, or ``None`` when unknown (absent attribute, singleton
+    groups, or non-uniform spacing).  On a row-major mesh this is the
+    signature that separates topology levels of EQUAL extent: level ℓ's
+    groups step by the product of the extents inside it (the intra-
+    slice scope strides 1, the cross-slice scope strides ``n_ici``) —
+    the quantity ``analysis/cost_model.collective_wire_by_level`` keys
+    attribution on."""
+    groups = replica_group_members(raw)
+    if not groups or len(groups[0]) < 2:
+        return None
+    first = groups[0]
+    stride = first[1] - first[0]
+    if any(b - a != stride for a, b in zip(first, first[1:])):
+        return None
+    return stride
+
+
 def collective_ops(hlo_text: str) -> List[CollectiveOp]:
     """All collective ops in an (optimized) HLO module dump.
 

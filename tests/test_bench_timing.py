@@ -279,6 +279,51 @@ class TestMoeAutotune:
                  * best["tokens_per_expert"] // 1) <= 131
 
 
+class TestAutotuneConsumesCalibration:
+    """``--autotune`` prices its pruning predictors with the measured
+    hardware model (ISSUE 18): a ``bench --calibrate`` artifact on
+    ``HOROVOD_CALIBRATION_PATH`` replaces the builtin preset, the
+    artifact name lands in the JSON output, and two runs over the same
+    fitted model pick the same winner — calibrated pruning is
+    deterministic, not a noise source."""
+
+    def _run(self, tmp_path, monkeypatch):
+        tmp_path.mkdir(parents=True, exist_ok=True)
+        seen = []
+        helper = TestMoeAutotune()
+        helper._patch_run_moe(monkeypatch, seen)
+        out = bench.run_autotune(TestMoeAutotune._args(tmp_path),
+                                 TestMoeAutotune.FakeHvd())
+        return out, seen
+
+    def test_fitted_model_reaches_the_race_and_is_deterministic(
+            self, tmp_path, monkeypatch):
+        from horovod_tpu.analysis import calibration as CAL
+
+        art = CAL.simulated_calibration(seed=17)
+        path = tmp_path / "CALIBRATION.json"
+        CAL.save_artifact(art, str(path))
+        monkeypatch.setenv("HOROVOD_CALIBRATION_PATH", str(path))
+        monkeypatch.delenv("HOROVOD_HW_PRESET", raising=False)
+        monkeypatch.delenv("HOROVOD_HBM_BUDGET_BYTES", raising=False)
+
+        first, seen_a = self._run(tmp_path / "a", monkeypatch)
+        second, seen_b = self._run(tmp_path / "b", monkeypatch)
+        # the calibrated constants — not a builtin preset — priced it
+        assert first["hw_model"] == "calibrated:simulated:v5e"
+        assert second["hw_model"] == first["hw_model"]
+        # same fitted model, same walk, same winner
+        assert seen_a == seen_b
+        assert second["best_point"] == first["best_point"]
+
+    def test_broken_calibration_path_refuses_to_race(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HOROVOD_CALIBRATION_PATH",
+                           str(tmp_path / "missing.json"))
+        with pytest.raises(Exception, match="HOROVOD_CALIBRATION_PATH"):
+            self._run(tmp_path, monkeypatch)
+
+
 class TestSpRingBench:
     """``--plan`` dp×sp bench surface (ISSUE 17): the plan axis grows
     dp×sp factorizations only at long context, the ring twin probe

@@ -581,6 +581,187 @@ class TestErrorFeedback:
             compression=Compression.int8, error_feedback=True)
 
 
+class TestTreeExchange:
+    """ISSUE 18 tentpole: the N-level tree exchange on a 2x2x2
+    virtual mesh — parity with the flat exchange, exact degeneracy
+    with two_level on the 2-axis runtime mesh, and the per-level wire
+    codec bounds."""
+
+    TREE_AXES = ("pod", "slice", "chip")    # outermost first
+
+    def make_tree_mesh(self):
+        devs = np.asarray(jax.devices("cpu")[:8]).reshape(2, 2, 2)
+        return Mesh(devs, self.TREE_AXES)
+
+    def _levels(self, pod_bits=None, chip_bits=None):
+        # innermost first — the tree_reducescatter convention
+        return (C.ExchangeLevel("chip", chip_bits),
+                C.ExchangeLevel("slice"),
+                C.ExchangeLevel("pod", pod_bits))
+
+    def test_three_level_roundtrip_matches_flat_and_psum(self):
+        """The 3-level flat-parity pin: RS -> AG through the tree
+        equals the flat exchange and the closed-form psum, leaf for
+        leaf."""
+        def inner():
+            r = C.axis_index(self.TREE_AXES)
+            leaves = [jnp.arange(10, dtype=jnp.float32) * (r + 1),
+                      jnp.ones((3, 5), jnp.float32) * (r + 1),
+                      jnp.full((7,), 2.0, jnp.float32) * (r + 1)]
+            levels = self._levels()
+            t_shards, t_spec = C.tree_reducescatter(leaves, levels,
+                                                    op=C.Sum)
+            tree = C.tree_allgather(t_shards, t_spec, levels)
+            f_shards, f_spec = C.grouped_reducescatter(
+                leaves, op=C.Sum, axis=self.TREE_AXES)
+            flat = C.grouped_allgather(f_shards, f_spec,
+                                       axis=self.TREE_AXES)
+            exact = [jax.lax.psum(x, self.TREE_AXES) for x in leaves]
+            return tuple(x[None] for x in tree + flat + exact)
+
+        n = 3
+        out = jax.jit(jax.shard_map(
+            inner, mesh=self.make_tree_mesh(), in_specs=(),
+            out_specs=(P(self.TREE_AXES),) * (3 * n),
+            check_vma=False))()
+        tree, flat, exact = out[:n], out[n:2 * n], out[2 * n:]
+        for t, f, e in zip(tree, flat, exact):
+            np.testing.assert_allclose(np.asarray(t), np.asarray(e),
+                                       rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(t), np.asarray(f),
+                                       rtol=1e-6)
+
+    def _sharded_update(self, hierarchy, level_codecs=None,
+                        quantized_bits=None):
+        from horovod_tpu.optim.optimizer import (
+            sharded_distributed_update,
+        )
+
+        data = np.linspace(-1, 1, 8 * 12).reshape(8, 12) \
+            .astype(np.float32)
+
+        def inner():
+            r = C.axis_index(self.TREE_AXES)
+            tx = sharded_distributed_update(
+                optax.adam(0.1), axis=self.TREE_AXES, world=8,
+                hierarchy=hierarchy, quantized_bits=quantized_bits,
+                level_codecs=level_codecs)
+            params = {"a": jnp.ones((8,)), "b": jnp.zeros((4,))}
+            g = {"a": jnp.asarray(data)[r, :8],
+                 "b": jnp.asarray(data)[r, 8:]}
+            u, _ = tx.update(g, tx.init(params), params)
+            return u["a"][None], u["b"][None]
+
+        return [np.asarray(x) for x in jax.jit(jax.shard_map(
+            inner, mesh=self.make_tree_mesh(), in_specs=(),
+            out_specs=(P(self.TREE_AXES), P(self.TREE_AXES)),
+            check_vma=False))()]
+
+    def test_optimizer_tree_matches_flat(self):
+        """sharded_distributed_update(hierarchy='tree') on the 3-axis
+        mesh: same updates as the flat exchange — and 'auto' resolves
+        to the same tree on a fully factored 3-axis spec."""
+        ta, tb = self._sharded_update("tree")
+        fa, fb = self._sharded_update("flat")
+        np.testing.assert_allclose(ta, fa, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(tb, fb, rtol=1e-5, atol=1e-6)
+        aa, ab = self._sharded_update("auto")
+        np.testing.assert_array_equal(aa, ta)
+        np.testing.assert_array_equal(ab, tb)
+
+    def test_tree_degenerates_to_two_level_on_the_runtime_mesh(self):
+        """A 2-axis tree IS two_level: hierarchy='tree' on the (2, 4)
+        runtime mesh compiles the same exchange as 'two_level', so the
+        trained parameters are bit-identical."""
+        def train(hierarchy, steps=4):
+            step = hvd.DistributedTrainStep(
+                loss_fn, optax.adamw(1e-2), mode="shard_map",
+                donate=False, shard_optimizer_states=True,
+                hierarchy=hierarchy)
+            assert step.exchange_hierarchy == "two_level"
+            params, opt_state = step.init(
+                make_params(jax.random.PRNGKey(7)))
+            batch = step.shard_batch(make_batch())
+            for _ in range(steps):
+                params, opt_state, _ = step(params, opt_state, batch)
+            return jax.device_get(params)
+
+        tree, two = train("tree"), train("two_level")
+        for k in two:
+            np.testing.assert_array_equal(np.asarray(tree[k]),
+                                          np.asarray(two[k]))
+
+    def test_outermost_codec_close_to_exact(self):
+        """quantized_bits on the tree compresses the outermost (pod)
+        hop only — the 2-way quantized phase stays within the
+        shared-scale codec's error bound."""
+        rng = np.random.RandomState(3)
+        data = rng.randn(8, 24).astype(np.float32)
+
+        def inner():
+            r = C.axis_index(self.TREE_AXES)
+            leaves = [jnp.asarray(data)[r]]
+            levels = self._levels(pod_bits=8)
+            shards, spec = C.tree_reducescatter(leaves, levels,
+                                                op=C.Average)
+            (out,) = C.tree_allgather(shards, spec, levels)
+            return out[None]
+
+        out = np.asarray(jax.jit(jax.shard_map(
+            inner, mesh=self.make_tree_mesh(), in_specs=(),
+            out_specs=P(self.TREE_AXES), check_vma=False))())
+        exact = data.mean(axis=0)
+        tol = np.abs(data).sum(axis=0).max() / 127.0
+        np.testing.assert_allclose(out[0], exact, atol=tol)
+
+    def test_level_codecs_knob_places_the_wire_codec(self):
+        """level_codecs={'pod': 8} through the sharded update equals
+        the quantized_bits spelling exactly (same placement) and stays
+        within the codec envelope of the full-precision tree."""
+        ca, cb = self._sharded_update("tree",
+                                      level_codecs={"pod": 8})
+        qa, qb = self._sharded_update("tree", quantized_bits=8)
+        np.testing.assert_array_equal(ca, qa)
+        np.testing.assert_array_equal(cb, qb)
+        fa, fb = self._sharded_update("tree")
+        np.testing.assert_allclose(ca, fa, rtol=0.05, atol=4e-3)
+        np.testing.assert_allclose(cb, fb, rtol=0.05, atol=4e-3)
+
+    def test_innermost_codec_uses_per_segment_scales(self):
+        """The innermost hop's codec rides the segment machinery (one
+        scale per fused leaf), so a tiny leaf next to a large one
+        survives — the same guarantee the flat quantized exchange
+        gives."""
+        def inner():
+            leaves = [jnp.full((8,), 500.0), jnp.full((8,), 1e-3)]
+            levels = self._levels(chip_bits=8)
+            shards, spec = C.tree_reducescatter(leaves, levels,
+                                                op=C.Average)
+            big, small = C.tree_allgather(shards, spec, levels)
+            return big[None], small[None]
+
+        big, small = jax.jit(jax.shard_map(
+            inner, mesh=self.make_tree_mesh(), in_specs=(),
+            out_specs=(P(self.TREE_AXES), P(self.TREE_AXES)),
+            check_vma=False))()
+        np.testing.assert_allclose(
+            np.asarray(big).reshape(-1), 500.0, rtol=0.1)
+        np.testing.assert_allclose(
+            np.asarray(small).reshape(-1), 1e-3, rtol=0.1)
+
+    def test_tree_validation(self):
+        with pytest.raises(ValueError, match="op=Sum/Average"):
+            C.tree_reducescatter([jnp.zeros(8)],
+                                 (C.ExchangeLevel("chip"),),
+                                 op=C.Adasum)
+        with pytest.raises(ValueError, match="quantized_bits"):
+            C.tree_reducescatter([jnp.zeros(8)],
+                                 (C.ExchangeLevel("chip"),),
+                                 op=C.Sum, residuals={})
+        with pytest.raises(ValueError, match=">= 1 level"):
+            C.tree_reducescatter([jnp.zeros(8)], (), op=C.Sum)
+
+
 class TestFusedTailExchange:
     """fused_collectives="on" (ISSUE 9 tentpole, ZeRO side): the
     tile-granular final-bucket exchange is numerically IDENTICAL to
