@@ -395,6 +395,22 @@ def _apply_wire_dtype(wire):
     return hvd.Compression.int8
 
 
+def _apply_reduction(reduction):
+    """Route a ``reduction`` choice (``sum``/``adasum``) into the
+    runtime config + env, mirroring :func:`_apply_wire_dtype`, so a
+    step built after this call resolves it (arg > config > env).
+    Returns the resolved value (None = default plain sum, nothing to
+    report)."""
+    from horovod_tpu.runtime import state as rt_state
+
+    if not reduction or reduction == "sum":
+        return None
+    if rt_state.is_initialized():
+        rt_state.global_state().config.exchange_reduction = reduction
+    os.environ["HOROVOD_EXCHANGE_REDUCTION"] = reduction
+    return reduction
+
+
 def exchange_step_kwargs(args):
     """DistributedTrainStep kwargs for ``--shard-optimizer-states``:
     the ZeRO-style sharded exchange with the bucket/hierarchy/wire
@@ -424,6 +440,9 @@ def exchange_step_kwargs(args):
     compression = _apply_wire_dtype(getattr(args, "wire_dtype", None))
     if compression is not None:
         kw["compression"] = compression
+    reduction = _apply_reduction(getattr(args, "reduction", None))
+    if reduction is not None:
+        kw["reduction"] = reduction
     return kw
 
 
@@ -440,6 +459,8 @@ def exchange_report_fields(args, step):
                    "step_fused_collectives": step.fused_collectives})
     if getattr(args, "wire_dtype", None):
         fields["exchange_wire_dtype"] = args.wire_dtype
+    if getattr(step, "reduction", None) not in (None, "sum"):
+        fields["reduction"] = step.reduction
     return fields
 
 
@@ -1671,6 +1692,10 @@ def run_autotune(args, hvd):
             # wire codec per exchange hop (fp32 = uncompressed) —
             # cost-model-priced via WIRE_DTYPE_BITS
             "wire_dtype": ["fp32", "int8", "fp8_e4m3"],
+            # reduction operator of the outer exchange level
+            # (docs/adasum.md) — the cost model prunes adasum unless
+            # the batch is large enough to pay its extra DCN round
+            "reduction": ["sum", "adasum"],
         }
         plans = _plan_axis_values(
             hvd.size(),
@@ -1695,6 +1720,7 @@ def run_autotune(args, hvd):
             a.hierarchy = point["hierarchy"]
             a.fused_collectives = point["fused_collectives"]
             a.wire_dtype = point["wire_dtype"]
+            a.reduction = point["reduction"]
             if "plan" in point:
                 a.plan = point["plan"]
 
@@ -2208,6 +2234,77 @@ def run_sp_budget(args, hvd):
     }
 
 
+def run_adasum(args, hvd):
+    """``--adasum``: the reduction-operator convergence probe
+    (docs/adasum.md "Batch-scaling procedure").
+
+    Runs the seeded quadratic twin ``analysis/adasum_smoke.py``
+    shares with hvdci gate 10 — three trajectories off one seed:
+    plain sum at the base batch (the reference), adasum at
+    ``--adasum-batch-scale``× the global batch, and plain summation at
+    the same scale (the naive scale-out whose effective step crosses
+    the stability edge) — and emits them plus the cost model's priced
+    extra DCN wire (``adasum_extra_wire_bytes``, for the transformer
+    payload this bench would exchange at the current mesh
+    factorization) into BENCH JSON.  The fields are the artifact half
+    of the acceptance contract: ``reduction`` keys perf-gate
+    comparability, ``adasum_dot_wire_bytes`` is the modeled price the
+    autotuner's batch crossover trades against."""
+    from horovod_tpu.analysis import adasum_smoke as AS
+    from horovod_tpu.analysis import cost_model as CM
+    from horovod_tpu.runtime import state as rt_state
+
+    scale = max(2, int(getattr(args, "adasum_batch_scale", 2)))
+    seed = 42
+    steps = 40
+    # stability edge scales with the replica count: pick the base lr
+    # so the single-replica step is stable while the scaled *summed*
+    # step is not — scale·lr·h_max = 2.4 > 2 > lr·h_max (h_max = 1.5)
+    lr = round(1.6 / scale, 4)
+    base = AS.simulate_convergence(1, "sum", steps=steps, seed=seed,
+                                   lr=lr)
+    ada = AS.simulate_convergence(scale, "adasum", steps=steps,
+                                  seed=seed, lr=lr)
+    summed = AS.simulate_convergence(scale, "sum", steps=steps,
+                                     seed=seed, lr=lr)
+    log(f"bench[adasum]: scale {scale}x, lr {lr}: final loss "
+        f"base {base[-1]:.4g} · adasum {ada[-1]:.4g} · "
+        f"sum {summed[-1]:.4g}")
+
+    # price the extra DCN round for the transformer payload this
+    # bench's sharded exchange would move, at the runtime mesh's
+    # factorization — the same inputs the autotune predictor uses
+    d, layers, v = args.tf_d_model, args.tf_layers, 32_000
+    payload = 4.0 * (12 * layers * d * d + v * d)
+    shape = list(rt_state.global_state().mesh.shape.values())
+    n_dcn = shape[0] if len(shape) == 2 else 1
+    n_ici = shape[-1]
+    dot_wire = CM.adasum_extra_wire_bytes(payload, n_dcn=n_dcn,
+                                          n_ici=n_ici)
+    from horovod_tpu import telemetry
+
+    telemetry.gauge(
+        "hvd_adasum_dot_wire_bytes",
+        "modeled extra per-step DCN bytes of the adasum outer-level "
+        "exchange (analysis/cost_model.py)").set(dot_wire)
+    _apply_reduction("adasum")
+    rnd = lambda xs: [round(float(x), 8) for x in xs]  # noqa: E731
+    return {
+        "metric": "adasum",
+        "unit": "final_loss",
+        "value": round(float(ada[-1]), 8),
+        "reduction": "adasum",
+        "adasum_batch_scale": scale,
+        "adasum_seed": seed,
+        "adasum_steps": steps,
+        "adasum_lr": lr,
+        "adasum_dot_wire_bytes": dot_wire,
+        "adasum_loss_trajectory": rnd(ada),
+        "sum_base_loss_trajectory": rnd(base),
+        "sum_scaled_loss_trajectory": rnd(summed),
+    }
+
+
 def _env_budget_bytes():
     """HOROVOD_HBM_BUDGET_BYTES as a float, or None when unset."""
     raw = os.environ.get("HOROVOD_HBM_BUDGET_BYTES")
@@ -2496,6 +2593,25 @@ def main():
                         "(fp32 = uncompressed; int8/fp8_e4m3 set "
                         "HOROVOD_EXCHANGE_WIRE_DTYPE + the int8-bits "
                         "wire reduction); also an --autotune axis")
+    p.add_argument("--reduction", default=None,
+                   choices=["sum", "adasum"],
+                   help="reduction operator of the sharded exchange's "
+                        "outermost topology level "
+                        "(HOROVOD_EXCHANGE_REDUCTION): adasum = the "
+                        "pairwise adaptive summation that holds the "
+                        "loss trajectory at 2-4x global batch "
+                        "(docs/adasum.md); also an --autotune axis")
+    p.add_argument("--adasum", action="store_true",
+                   help="run the adasum convergence probe instead of "
+                        "the throughput bench: the seeded quadratic "
+                        "twin hvdci gate 10 shares — base-batch sum "
+                        "vs adasum-at-scale vs sum-at-scale "
+                        "trajectories plus the cost model's "
+                        "adasum_dot_wire_bytes (docs/adasum.md)")
+    p.add_argument("--adasum-batch-scale", type=int, default=2,
+                   help="global-batch multiplier of the --adasum "
+                        "probe's scaled trajectories (2-4x is the "
+                        "operator's design envelope)")
     p.add_argument("--hierarchy", default="auto",
                    choices=["auto", "flat", "two_level"],
                    help="exchange topology: two_level reduce-scatters "
@@ -2680,6 +2796,11 @@ def main():
         return
     if args.sp_budget:
         emit(dict(run_sp_budget(args, hvd), **artifact_metadata(hvd),
+                  **telemetry_fields()),
+             args.json_out)
+        return
+    if args.adasum:
+        emit(dict(run_adasum(args, hvd), **artifact_metadata(hvd),
                   **telemetry_fields()),
              args.json_out)
         return

@@ -1,0 +1,219 @@
+"""AdaSum operator smoke: hvdci gate 10 (docs/adasum.md).
+
+The convergence story the AdaSum reduction operator ships
+(``ops/collectives.adasum_pair`` + the outer-level pairwise exchange)
+is a *numerical* claim — orthogonal gradients add, parallel gradients
+average, antiparallel gradients damp — and the CI gate pins it
+without hardware: seeded pure-sim gradient-pair fixtures plus a
+sub-second two-slice convergence loop where
+
+* plain sum at the base batch converges (the reference trajectory),
+* adasum at 2× the global batch tracks that reference, and
+* plain *summation* at 2× (the naive scale-out: N× the mean step,
+  exactly what an untuned learning rate sees) demonstrably degrades,
+
+run twice and required bit-identical (the same determinism contract
+every smoke in ``analysis/ci.py`` holds).
+
+The module is stdlib-only like the rest of the analysis layer — the
+pair rule is mirrored here in pure python (float64) and cross-checked
+against the real ``ops.collectives.adasum_pair`` (fp32) whenever JAX
+imports, so the gate exercises the shipped operator in the test image
+while ``python -m horovod_tpu.analysis`` stays importable without it.
+``bench --adasum`` reuses :func:`simulate_convergence` for its
+trajectory fields, so the BENCH artifact and the CI gate share one
+definition of the twin.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import random
+from typing import List, Optional, Sequence
+
+#: Zero-norm guard threshold — mirrors ``ops.collectives.adasum_pair``
+#: by value (this module stays stdlib-only).
+ZERO_NORM_EPS = 1e-30
+
+
+def _dot(a: Sequence[float], b: Sequence[float]) -> float:
+    return sum(x * y for x, y in zip(a, b))
+
+
+def adasum_pair(a: Sequence[float], b: Sequence[float]) -> List[float]:
+    """Pure-python mirror of the pairwise rule
+    ``a·(1 − ⟨a,b⟩/2‖a‖²) + b·(1 − ⟨a,b⟩/2‖b‖²)`` with the zero-norm →
+    plain-sum guard, in float64 (the shipped operator accumulates in
+    fp32; the cross-check below bounds the drift)."""
+    dot, an, bn = _dot(a, b), _dot(a, a), _dot(b, b)
+    ac = 1.0 - dot / (2.0 * an + ZERO_NORM_EPS) \
+        if an >= ZERO_NORM_EPS else 1.0
+    bc = 1.0 - dot / (2.0 * bn + ZERO_NORM_EPS) \
+        if bn >= ZERO_NORM_EPS else 1.0
+    return [ac * x + bc * y for x, y in zip(a, b)]
+
+
+def adasum_reduce(grads: Sequence[Sequence[float]]) -> List[float]:
+    """Binary adasum tree over a replica list — the same adjacent-pair
+    order as ``ops.collectives._adasum_psum_scatter``'s replicated
+    tree (the pair rule is symmetric, so the pow2 recursive-doubling
+    schedule combines in the same bracketing)."""
+    vals = [list(g) for g in grads]
+    while len(vals) > 1:
+        nxt = [adasum_pair(vals[i], vals[i + 1])
+               for i in range(0, len(vals) - 1, 2)]
+        if len(vals) % 2:
+            nxt.append(vals[-1])
+        vals = nxt
+    return vals[0]
+
+
+def simulate_convergence(n_replicas: int,
+                         reduction: str,
+                         steps: int = 40,
+                         seed: int = 42,
+                         lr: float = 0.75,
+                         dim: int = 8,
+                         noise: float = 0.01) -> List[float]:
+    """Seeded quadratic twin: per-step loss trajectory of ``steps``
+    SGD updates where each of ``n_replicas`` slices contributes a
+    noisy gradient of the same diagonal quadratic and the slices are
+    combined by ``reduction`` ("sum" = plain summation, the naive
+    scale-out that multiplies the effective step by N; "adasum" = the
+    binary pairwise tree).
+
+    The curvature spectrum is a fixed ``[0.5, 1.5]`` spread chosen so
+    the base step is stable (``lr·h_max < 2``) while the summed
+    2-replica step is not (``2·lr·h_max > 2``) — the textbook
+    large-batch blow-up adasum's damping absorbs.  Pure stdlib floats,
+    bit-deterministic for one seed."""
+    if dim < 2:
+        raise ValueError(f"dim must be >= 2, got {dim}")
+    h = [0.5 + i / (dim - 1) for i in range(dim)]
+    rng = random.Random(seed)
+    wstar = [rng.uniform(-1.0, 1.0) for _ in range(dim)]
+    w = [0.0] * dim
+    losses: List[float] = []
+    for _ in range(steps):
+        grads = [[h[i] * (w[i] - wstar[i]) + noise * rng.gauss(0.0, 1.0)
+                  for i in range(dim)]
+                 for _r in range(n_replicas)]
+        if reduction == "adasum":
+            g = adasum_reduce(grads)
+        else:
+            g = [sum(gr[i] for gr in grads) for i in range(dim)]
+        w = [w[i] - lr * g[i] for i in range(dim)]
+        losses.append(0.5 * sum(h[i] * (w[i] - wstar[i]) ** 2
+                                for i in range(dim)))
+    return losses
+
+
+#: The gradient-pair fixtures the gate pins (docs/adasum.md):
+#: identical pair → itself (parallel average), orthogonal pair →
+#: plain sum, antiparallel pair → damped below the plain sum,
+#: zero-norm operand → plain-sum guard.
+_PAIR_FIXTURES = (
+    ("parallel", [1.0, 2.0, -3.0, 0.5], [1.0, 2.0, -3.0, 0.5]),
+    ("orthogonal", [1.0, 0.0, 2.0, 0.0], [0.0, -1.0, 0.0, 3.0]),
+    ("antiparallel", [1.0, 2.0, -3.0, 0.5], [-2.0, -4.0, 6.0, -1.0]),
+    ("zero-norm", [0.0, 0.0, 0.0, 0.0], [1.0, 2.0, -3.0, 0.5]),
+)
+
+
+def _close(a: Sequence[float], b: Sequence[float],
+           rtol: float = 1e-9) -> bool:
+    return all(abs(x - y) <= rtol * max(1.0, abs(x), abs(y))
+               for x, y in zip(a, b))
+
+
+def run_smoke(root: Optional[str] = None) -> List[str]:
+    """hvdci gate 10: the seeded adasum fixtures + two-slice
+    convergence loop, run twice and required bit-identical.  Returns
+    the error list ([] = pass); sub-second, stdlib-only (the real
+    fp32 operator is cross-checked when JAX imports)."""
+    del root  # same signature as the other smokes; nothing on disk
+    errors: List[str] = []
+
+    fix = {name: adasum_pair(a, b) for name, a, b in _PAIR_FIXTURES}
+    g = _PAIR_FIXTURES[0][1]
+    if not _close(fix["parallel"], g):
+        errors.append(
+            f"adasum(g, g) must return g (parallel average), got "
+            f"{fix['parallel']}")
+    a, b = _PAIR_FIXTURES[1][1], _PAIR_FIXTURES[1][2]
+    if not _close(fix["orthogonal"], [x + y for x, y in zip(a, b)]):
+        errors.append(
+            f"adasum of an orthogonal pair must equal the plain sum, "
+            f"got {fix['orthogonal']}")
+    a, b = _PAIR_FIXTURES[2][1], _PAIR_FIXTURES[2][2]
+    # b = -2a: coefficients 2 and 1.25, combine = -a/2 — damped to
+    # half the plain sum's norm
+    if not _close(fix["antiparallel"], [-0.5 * x for x in a]):
+        errors.append(
+            f"adasum of the antiparallel fixture must damp to -a/2, "
+            f"got {fix['antiparallel']}")
+    if math.sqrt(_dot(fix["antiparallel"], fix["antiparallel"])) \
+            >= math.sqrt(_dot(a, a)):
+        errors.append("antiparallel combine is not damped below the "
+                      "operand norm")
+    if not _close(fix["zero-norm"], _PAIR_FIXTURES[3][2]):
+        errors.append(
+            f"zero-norm operand must fall back to the plain sum, got "
+            f"{fix['zero-norm']}")
+
+    # cross-check the pure-python mirror against the shipped fp32
+    # operator (ops/collectives.py) whenever JAX is importable — the
+    # CI image always has it; a JAX-less analysis install skips this
+    # arm without weakening the stdlib fixtures above
+    try:
+        import numpy as np
+
+        from horovod_tpu.ops.collectives import adasum_pair as real_pair
+    except ImportError:
+        pass
+    else:
+        for name, x, y in _PAIR_FIXTURES:
+            got = real_pair(np.asarray(x, np.float32),
+                            np.asarray(y, np.float32), xp=np)
+            if not _close([float(v) for v in got], fix[name],
+                          rtol=1e-5):
+                errors.append(
+                    f"ops.collectives.adasum_pair diverges from the "
+                    f"smoke mirror on the {name} fixture: "
+                    f"{[float(v) for v in got]} vs {fix[name]}")
+
+    runs = []
+    for _ in range(2):
+        base = simulate_convergence(1, "sum", seed=42)
+        ada = simulate_convergence(2, "adasum", seed=42)
+        summed = simulate_convergence(2, "sum", seed=42)
+        runs.append(json.dumps({"base": base, "adasum": ada,
+                                "sum2x": summed}))
+    if runs[0] != runs[1]:
+        errors.append(
+            "adasum convergence twin is not deterministic: two seeded "
+            "runs serialized differently")
+    base = simulate_convergence(1, "sum", seed=42)
+    ada = simulate_convergence(2, "adasum", seed=42)
+    summed = simulate_convergence(2, "sum", seed=42)
+    if not all(math.isfinite(x) for x in base) \
+            or base[-1] >= 0.01 * base[0]:
+        errors.append(
+            f"base sum trajectory failed to converge: "
+            f"{base[0]:.4g} -> {base[-1]:.4g}")
+    if not all(math.isfinite(x) for x in ada) \
+            or ada[-1] >= 0.01 * ada[0]:
+        errors.append(
+            f"adasum-at-2x trajectory failed to converge: "
+            f"{ada[0]:.4g} -> {ada[-1]:.4g}")
+    if ada[-1] > 10.0 * max(base[-1], 1e-6):
+        errors.append(
+            f"adasum-at-2x final loss {ada[-1]:.4g} does not track "
+            f"the base trajectory's {base[-1]:.4g}")
+    if summed[-1] < 100.0 * max(ada[-1], base[-1]):
+        errors.append(
+            f"sum-at-2x was expected to degrade (effective step "
+            f"doubled past the stability edge) but reached "
+            f"{summed[-1]:.4g} vs adasum {ada[-1]:.4g}")
+    return errors

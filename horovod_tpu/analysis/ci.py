@@ -1,7 +1,7 @@
 """``python -m horovod_tpu.analysis ci`` / ``hvdci`` — the one-shot CI
 entry point.
 
-Nine gates, one invocation, one exit code (docs/perf_gate.md):
+Ten gates, one invocation, one exit code (docs/perf_gate.md):
 
 1. **hvdlint** over the pre-commit scope (``--changed``: staged +
    unstaged + untracked files under ``horovod_tpu/``; falls back to the
@@ -34,7 +34,13 @@ Nine gates, one invocation, one exit code (docs/perf_gate.md):
    pure-sim calibrate → fit → ``HardwareModel.from_calibration`` →
    price round trip, run twice and required bit-identical, plus the
    artifact schema check over any checked-in ``CALIBRATION*.json``
-   (docs/calibration.md).
+   (docs/calibration.md);
+10. the **adasum smoke** (``analysis/adasum_smoke.py``): seeded
+    gradient-pair fixtures of the pairwise reduction operator
+    (parallel/orthogonal/antiparallel/zero-norm) plus a two-slice
+    convergence loop — adasum at 2× tracks the base-batch sum
+    trajectory while plain sum at 2× degrades — run twice and
+    required bit-identical (docs/adasum.md).
 
 The whole run is a tier-1 test with the same <30 s budget as the
 hvdlint self-run, so "CI passed" and "the analysis suite passed" are
@@ -177,13 +183,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         calibration_errors = [f"calibration-smoke crashed: "
                               f"{type(e).__name__}: {e}"]
 
+    # 10 — adasum smoke: seeded pair fixtures + the two-slice
+    # convergence loop, run twice bit-identical (sub-second, stdlib)
+    try:
+        from horovod_tpu.analysis.adasum_smoke import run_smoke as \
+            run_adasum_smoke
+
+        adasum_errors = run_adasum_smoke(root)
+    except Exception as e:          # noqa: BLE001 — a crash IS a failure
+        adasum_errors = [f"adasum-smoke crashed: "
+                         f"{type(e).__name__}: {e}"]
+
     elapsed = time.perf_counter() - t0
     gate_findings = gate.findings if gate is not None else []
     rc = 2 if (art_error or gate_error) else (
         1 if (lint.findings or art_findings or gate_findings
               or metrics_errors or guard_errors or serve_errors
               or plan_errors or degrade_errors or memory_errors
-              or calibration_errors)
+              or calibration_errors or adasum_errors)
         else 0)
 
     if args.json_out:
@@ -197,6 +214,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "degrade_smoke_errors": degrade_errors,
             "memory_smoke_errors": memory_errors,
             "calibration_smoke_errors": calibration_errors,
+            "adasum_smoke_errors": adasum_errors,
             "perf_gate": gate.as_json() if gate is not None else None,
             "errors": [e for e in (art_error, gate_error) if e],
             "elapsed_s": round(elapsed, 3),
@@ -222,6 +240,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"hvdci: memory-smoke: {e}")
     for e in calibration_errors:
         print(f"hvdci: calibration-smoke: {e}")
+    for e in adasum_errors:
+        print(f"hvdci: adasum-smoke: {e}")
     for f in gate_findings:
         print(f.format())
     for err in (art_error, gate_error):
@@ -236,7 +256,8 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"plan-smoke {len(plan_errors)} · "
           f"degrade-smoke {len(degrade_errors)} · "
           f"memory-smoke {len(memory_errors)} · "
-          f"calibration-smoke {len(calibration_errors)} finding(s) "
+          f"calibration-smoke {len(calibration_errors)} · "
+          f"adasum-smoke {len(adasum_errors)} finding(s) "
           f"in {elapsed:.2f}s — {'FAIL' if rc else 'ok'}")
     return rc
 

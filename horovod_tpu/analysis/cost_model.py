@@ -458,6 +458,49 @@ def fused_tail_exchange_s(wire_s: float, compute_s: float,
     return startup + max(0.0, wire_s - max(0.0, float(compute_s)))
 
 
+# -- adasum reduction-operator pricing --------------------------------------
+
+
+#: Statistical-efficiency credit of the adasum operator, as a fraction
+#: of per-step compute seconds.  AdaSum buys nothing at a fixed batch —
+#: it strictly *adds* wire (the dot/norm pairwise exchange below) — its
+#: value is that it holds the loss trajectory at 2–4× the global batch
+#: where plain sum degrades (docs/adasum.md, the pinned convergence
+#: test).  The autotuner's objective is throughput at the sampled
+#: batch, so the model books the batch-scaling headroom as a credit
+#: proportional to compute seconds: compute_s grows linearly with the
+#: per-chip batch while the exchange wire does not, which is exactly
+#: what makes the ``reduction`` axis flip to adasum only above a batch
+#: crossover — small batches never pay the extra DCN round.
+ADASUM_COMPUTE_CREDIT_FRACTION = 0.05
+
+
+def adasum_extra_wire_bytes(payload_bytes: float,
+                            n_dcn: int = 1,
+                            n_ici: int = 1) -> float:
+    """Extra per-chip DCN bytes the adasum outer-level exchange moves
+    *beyond* the plain ring reduce-scatter it replaces.
+
+    The operator is pairwise and order-sensitive, so the outer level
+    cannot ring-RS 1/n-sized shards: it runs a recursive-halving
+    doubling schedule (``ops.collectives._adasum_psum_scatter``) that
+    ppermutes the **full** inner-reduced block every round —
+    ``⌈log2(n_dcn)⌉ · (payload/n_ici)`` per chip, each round carrying
+    the operands the per-pair fp32 dot/norms are computed from (the
+    "extra dot/norm round" is this full-block traffic; the scalar
+    coefficients themselves ride along for free).  The ring RS it
+    displaces would have moved ``(n_dcn−1)/n_dcn`` of the same block,
+    so the extra is the difference, floored at 0.  ``n_dcn <= 1``
+    prices 0: a single-slice world degenerates adasum to plain sum
+    bit-for-bit and the schedule never engages."""
+    n_dcn, n_ici = max(1, int(n_dcn)), max(1, int(n_ici))
+    if n_dcn <= 1:
+        return 0.0
+    block = float(payload_bytes) / n_ici
+    rounds = math.ceil(math.log2(n_dcn))
+    return max(0.0, (rounds - _ring_factor(n_dcn)) * block)
+
+
 # -- parallelism-plan pricing -----------------------------------------------
 
 
@@ -580,14 +623,21 @@ def plan_cost_s(plan: Union[str, Dict],
                 compute_s: float = 0.0,
                 microbatches: int = PLAN_SCORE_MICROBATCHES,
                 hw: HardwareModel = V5E,
-                wire_bits_dcn: int = 8) -> float:
+                wire_bits_dcn: int = 8,
+                reduction: str = "sum") -> float:
     """Predicted per-step seconds of one plan: compute stretched by the
     pipeline bubble (``t / (1 - bubble)`` — the idle ticks are pure
     loss) plus the serial wire time of the plan-scoped gradient
     exchange.  The quantity ``ThroughputAutotuner(predict=)`` ranks the
     ``plan`` axis with (:func:`score_exchange_schedule`), and the
     1F1B-beats-GPipe acceptance check reads straight off: same plan
-    with ``v>1`` has a strictly smaller bubble term."""
+    with ``v>1`` has a strictly smaller bubble term.
+    ``reduction="adasum"`` adds the outer-level dot/norm round's extra
+    DCN wire time (:func:`adasum_extra_wire_bytes`, priced under the
+    plan's derived dp factorization) — a pure step-time penalty here;
+    the batch-scaling *credit* lives in the ranking-side
+    :func:`score_exchange_schedule`, not in the honest per-step
+    clock."""
     ext = parse_plan(plan)
     bubble = 0.0
     if ext["pp"] > 1:
@@ -596,7 +646,18 @@ def plan_cost_s(plan: Union[str, Dict],
     wire = plan_exchange_wire_bytes(plan, payload_bytes, n_dcn=n_dcn,
                                     n_ici=n_ici,
                                     wire_bits_dcn=wire_bits_dcn)
-    return float(compute_s) / (1.0 - bubble) + exchange_time_s(wire, hw)
+    t = float(compute_s) / (1.0 - bubble) + exchange_time_s(wire, hw)
+    if reduction == "adasum":
+        model = ext["pp"] * ext["ep"] * ext["sp"] * ext["tp"]
+        per_replica = float(payload_bytes) / max(1, model)
+        data_world = ext["dp"] * ext["fsdp"]
+        d_dcn = min(ext["dp"], max(1, int(n_dcn)))
+        while data_world % d_dcn:
+            d_dcn -= 1
+        d_ici = max(1, data_world // d_dcn)
+        t += adasum_extra_wire_bytes(per_replica, n_dcn=d_dcn,
+                                     n_ici=d_ici) / hw.dcn_bytes_per_s
+    return t
 
 
 def rank_plans(plans: Sequence[Union[str, Dict]],
@@ -831,7 +892,14 @@ def score_exchange_schedule(point: Dict,
     priced for sp=1 by the caller and rescaled here to the sampled
     extent) exposed per :func:`sp_ring_exposed_s`, fused when the
     point's ``fused_collectives`` is ``"on"`` — the fused-vs-unfused
-    ring the dp×sp autotune prunes on.  Returns ``None`` when the
+    ring the dp×sp autotune prunes on.  A ``reduction`` knob
+    (``"sum"`` | ``"adasum"``) charges the adasum outer-level exchange
+    its extra DCN wire (:func:`adasum_extra_wire_bytes`) and credits
+    its batch-scaling headroom
+    (:data:`ADASUM_COMPUTE_CREDIT_FRACTION` × ``compute_s``) — since
+    ``compute_s`` grows with the per-chip batch and the wire penalty
+    does not, the axis flips to adasum only above a batch crossover.
+    Returns ``None`` when the
     point carries no
     exchange knob at all — the caller then skips pruning entirely (the
     ParameterManager ``predict=`` contract: a predictor that cannot
@@ -840,9 +908,20 @@ def score_exchange_schedule(point: Dict,
     fused = point.get("fused_collectives")
     wire_dtype = point.get("wire_dtype")
     plan = point.get("plan")
+    reduction = point.get("reduction")
     if hierarchy is None and fused is None and wire_dtype is None \
-            and plan is None:
+            and plan is None and reduction is None:
         return None
+
+    def _with_reduction(score: float) -> float:
+        if reduction != "adasum":
+            return score
+        extra_s = adasum_extra_wire_bytes(
+            float(payload_bytes), n_dcn=n_dcn, n_ici=n_ici) \
+            / hw.dcn_bytes_per_s
+        return (score - extra_s
+                + ADASUM_COMPUTE_CREDIT_FRACTION * float(compute_s))
+
     wire_bits = WIRE_DTYPE_BITS.get(wire_dtype, 8)
     if plan is not None:
         ext = parse_plan(plan)
@@ -870,8 +949,9 @@ def score_exchange_schedule(point: Dict,
                 sp_w, sp_c, ext["sp"], fused=(fused == "on"))
         # penalty form of the bubble stretch: the constant compute_s
         # offset cancels in the ranking
-        return -(float(compute_s) * bubble / (1.0 - bubble) + exch
-                 + sp_cost)
+        return _with_reduction(
+            -(float(compute_s) * bubble / (1.0 - bubble) + exch
+              + sp_cost))
     hierarchy = hierarchy if hierarchy in ("flat", "two_level") else "flat"
     wire = exchange_wire_bytes(float(payload_bytes), n_dcn=n_dcn,
                                n_ici=n_ici, hierarchy=hierarchy,
@@ -882,8 +962,9 @@ def score_exchange_schedule(point: Dict,
                          dcn=wire.dcn * wire_bits / 32.0)
     serial = exchange_time_s(wire, hw)
     if fused == "on":
-        return -fused_tail_exchange_s(serial, compute_s, n_tiles)
-    return -serial
+        return _with_reduction(
+            -fused_tail_exchange_s(serial, compute_s, n_tiles))
+    return _with_reduction(-serial)
 
 
 # -- sequence-parallel (sp ring) pricing ------------------------------------

@@ -145,6 +145,29 @@ CALIBRATION_SERIES = frozenset({
     "hvd_calibration_fit_residual_max",
 })
 
+# the adasum reduction-operator plane's closed series vocabulary
+# (docs/adasum.md): outer-level exchange constructions (trace-time,
+# labelled by the level's mesh axis), the cost-model-priced extra DCN
+# bytes of the pairwise dot/norm round, and the zero-norm → plain-sum
+# guard activations, in the hvd_adasum_* namespace
+ADASUM_SERIES = frozenset({
+    "hvd_adasum_steps_total",
+    "hvd_adasum_dot_wire_bytes",
+    "hvd_adasum_zero_norm_fallbacks_total",
+})
+
+
+def _check_adasum_series(errors: List[str], obj, field: str) -> None:
+    if not isinstance(obj, dict):
+        return      # shape error already reported by _check_series_map
+    for k in obj:
+        if isinstance(k, str) and k.startswith("hvd_adasum"):
+            base = k.split("{", 1)[0]
+            if base not in ADASUM_SERIES:
+                errors.append(
+                    f"{field}[{k!r}]: unknown adasum series {base!r} — "
+                    f"not in metrics_schema.ADASUM_SERIES")
+
 
 def _check_guard_series(errors: List[str], obj, field: str) -> None:
     if not isinstance(obj, dict):
@@ -336,6 +359,10 @@ def validate_snapshot(obj: Dict) -> List[str]:
     _check_calibration_series(errors, obj.get("gauges", {}), "gauges")
     _check_calibration_series(errors, obj.get("histograms", {}),
                               "histograms")
+    _check_adasum_series(errors, obj.get("counters", {}), "counters")
+    _check_adasum_series(errors, obj.get("gauges", {}), "gauges")
+    _check_adasum_series(errors, obj.get("histograms", {}),
+                         "histograms")
     return errors
 
 
@@ -358,6 +385,8 @@ def validate_bench_metrics(obj: Dict) -> List[str]:
     _check_sp_series(errors, obj.get("counters", {}), "metrics.counters")
     _check_calibration_series(errors, obj.get("counters", {}),
                               "metrics.counters")
+    _check_adasum_series(errors, obj.get("counters", {}),
+                         "metrics.counters")
     return errors
 
 

@@ -80,21 +80,28 @@ _V1_IDENTITY = ("platform", "device_kind", "n_devices", "mesh_shape")
 #: artifacts agreeing on the key's value are diffed (None key field on
 #: both sides also matches).  ``plan`` guards every field: a dp=8 run
 #: against a dp=4,fsdp=2 run measures two different exchange
-#: schedules, not a regression (bench.py --plan; docs/parallelism.md)
+#: schedules, not a regression (bench.py --plan; docs/parallelism.md).
+#: ``reduction`` guards them the same way: a sum→adasum switch moves
+#: the outer exchange level onto the pairwise full-block schedule —
+#: a schedule change, never a throughput regression (bench.py
+#: --reduction; docs/adasum.md); legacy artifacts without the field
+#: keep gating via the None-matches-None rule
 THROUGHPUT_FIELDS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
-    ("value", ("metric", "plan")),
+    ("value", ("metric", "plan", "reduction")),
     # sp extent + sequence length guard the transformer diff: an
     # sp=2 seq-4096 long-context run against an sp=1 seq-512 one
     # measures a different attention schedule and a t²-different
     # FLOP mix, never a regression (bench.py --plan dp×sp)
     ("transformer_tokens_per_sec",
-     ("transformer_params_m", "plan", "sp", "transformer_seq_len")),
+     ("transformer_params_m", "plan", "sp", "transformer_seq_len",
+      "reduction")),
     # routing config guards the MoE diff: a capacity-factor or ep-extent
     # change is a schedule change (different dispatch geometry + drop
     # behavior), never a throughput regression
     ("moe_tokens_per_sec",
-     ("moe_params_m", "plan", "moe_capacity_factor", "moe_ep")),
-    ("vit_img_sec_per_chip", ("vit_params_m", "plan")),
+     ("moe_params_m", "plan", "moe_capacity_factor", "moe_ep",
+      "reduction")),
+    ("vit_img_sec_per_chip", ("vit_params_m", "plan", "reduction")),
     ("serve_throughput_rps", ("serve_offered_rps", "plan")),
 )
 

@@ -214,6 +214,75 @@ def _resolve_wire_dtype(wire_dtype: Optional[str]) -> str:
     return wire_dtype
 
 
+#: Reduction operators of the sharded exchange
+#: (``HOROVOD_EXCHANGE_REDUCTION``): plain summation, or AdaSum
+#: adaptive summation (arXiv 2006.02924) on the OUTERMOST topology
+#: level only — orthogonal gradients add, near-parallel gradients
+#: average, so a 2-4x larger global batch keeps the small-batch loss
+#: trajectory where plain averaging stalls (docs/adasum.md).
+REDUCTIONS = ("sum", "adasum")
+
+
+def _resolve_reduction(reduction: Optional[str]) -> str:
+    """Reduction-operator resolution: explicit argument > runtime config
+    (``HOROVOD_EXCHANGE_REDUCTION``) > plain-sum default."""
+    if reduction is None:
+        from horovod_tpu.runtime import state as _rt
+
+        if _rt.is_initialized():
+            reduction = getattr(_rt.global_state().config,
+                                "exchange_reduction", "sum")
+        else:
+            import os
+
+            reduction = os.environ.get(
+                "HOROVOD_EXCHANGE_REDUCTION", "sum").lower() or "sum"
+    if reduction not in REDUCTIONS:
+        raise ValueError(
+            f"exchange reduction must be one of {REDUCTIONS}, got "
+            f"{reduction!r}")
+    return reduction
+
+
+def adasum_pair(a, b, xp=jnp):
+    """One pairwise AdaSum combine (arXiv 2006.02924, the reference's
+    ``adasum.h`` coefficient rule)::
+
+        a·(1 − ⟨a,b⟩/2‖a‖²) + b·(1 − ⟨a,b⟩/2‖b‖²)
+
+    which is ``a+b`` for orthogonal gradients and the average for
+    parallel ones.  Dot/norms accumulate in fp32 regardless of input
+    dtype (the reference widens its fp16 path the same way), and a
+    zero-norm operand degrades its coefficient to 1 — the plain-sum
+    guard, so all-zero gradients pass through exactly.
+
+    ``xp``-generic (jnp or numpy) so the pure-sim smoke gate
+    (``analysis/adasum_smoke.py``) and the traced exchange share these
+    exact numerics; the eager numpy path additionally counts actual
+    zero-norm fallbacks into telemetry (the traced path cannot observe
+    data-dependent events at trace time).
+    """
+    af = a.astype(xp.float32)
+    bf = b.astype(xp.float32)
+    dot = xp.vdot(af, bf)
+    anormsq = xp.vdot(af, af)
+    bnormsq = xp.vdot(bf, bf)
+    acoeff = xp.where(anormsq >= 1e-30,
+                      1.0 - dot / (2.0 * anormsq + 1e-30), 1.0)
+    bcoeff = xp.where(bnormsq >= 1e-30,
+                      1.0 - dot / (2.0 * bnormsq + 1e-30), 1.0)
+    if xp is np:
+        fallbacks = int(anormsq < 1e-30) + int(bnormsq < 1e-30)
+        if fallbacks:
+            from horovod_tpu import telemetry
+
+            telemetry.counter(
+                "hvd_adasum_zero_norm_fallbacks_total",
+                "zero-norm plain-sum guard activations in adasum_pair"
+            ).inc(fallbacks)
+    return (acoeff * af + bcoeff * bf).astype(a.dtype)
+
+
 def quantized_allreduce(x: jax.Array, axis: AxisSpec = GLOBAL_AXES,
                         op: ReduceOp = Average,
                         bits: int = 8,
@@ -689,6 +758,122 @@ def tree_index_axes(levels: Sequence[ExchangeLevel]) -> Tuple[str, ...]:
     return tuple(axes)
 
 
+def _is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def _count_adasum_exchange(ax) -> None:
+    from horovod_tpu import telemetry
+
+    telemetry.counter(
+        "hvd_adasum_steps_total",
+        "adasum outer-level exchange constructions per level"
+    ).inc(level=str(ax))
+
+
+def _quantized_pair_exchange(x: jax.Array, ax, perm,
+                             wire_dtype: Optional[str] = None):
+    """One codec-compressed ``ppermute`` round of the adasum schedule.
+
+    The absmax scale is agreed over the whole level with one ``pmax``
+    (every rank holds the identical scale), so the XOR partner
+    dequantizes the received payload exactly; BOTH sides of the combine
+    see dequantized wire values — the pairwise rule stays symmetric, so
+    partners compute identical results and the recursive doubling keeps
+    its all-ranks-converge property under quantization."""
+    wire = _resolve_wire_dtype(wire_dtype)
+    x32 = x.astype(jnp.float32)
+    scale = _shared_wire_scale(x32, (), ax, qmax=_WIRE_QMAX[wire])
+    if wire == "fp8_e4m3":
+        q = jnp.clip(x32 / scale, -448.0, 448.0) \
+            .astype(jnp.float8_e4m3fn)
+    else:
+        q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
+    own = (q.astype(jnp.float32) * scale).astype(x.dtype)
+    partner = (lax.ppermute(q, ax, perm=perm).astype(jnp.float32)
+               * scale).astype(x.dtype)
+    return own, partner
+
+
+def _adasum_combine(a: jax.Array, b: jax.Array,
+                    scalar_axes=()) -> jax.Array:
+    """:func:`adasum_pair` with the fp32 dot/norm scalars additionally
+    psummed over ``scalar_axes`` — the inner topology levels the fused
+    bucket is already scattered across.  Each inner rank holds a
+    different segment of the bucket, so the local partial dots only
+    become the whole-bucket ⟨a,b⟩/‖a‖²/‖b‖² after the (cheap, scalar,
+    intra-slice) reduction; every rank then applies the SAME
+    coefficients and the damping is consistent across the bucket's
+    segments."""
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    dot = jnp.vdot(af, bf)
+    anormsq = jnp.vdot(af, af)
+    bnormsq = jnp.vdot(bf, bf)
+    if scalar_axes:
+        dot = lax.psum(dot, scalar_axes)
+        anormsq = lax.psum(anormsq, scalar_axes)
+        bnormsq = lax.psum(bnormsq, scalar_axes)
+    acoeff = jnp.where(anormsq >= 1e-30,
+                       1.0 - dot / (2.0 * anormsq + 1e-30), 1.0)
+    bcoeff = jnp.where(bnormsq >= 1e-30,
+                       1.0 - dot / (2.0 * bnormsq + 1e-30), 1.0)
+    return (acoeff * af + bcoeff * bf).astype(a.dtype)
+
+
+def _adasum_psum_scatter(block: jax.Array, ax, n: int,
+                         bits: Optional[int] = None,
+                         wire_dtype: Optional[str] = None,
+                         scalar_axes=()) -> jax.Array:
+    """Recursive-doubling AdaSum reduce-scatter over one (outermost)
+    topology level — the operator analogue of
+    ``lax.psum_scatter(tiled=True)``, with :func:`adasum_pair` as the
+    combine.  log2(n) XOR-partner ``ppermute`` rounds exchange the full
+    surviving block; the dot/norms are whole-bucket per fused
+    (bucket, dtype) group — the local partials over this rank's
+    surviving segment are psummed over ``scalar_axes`` (the inner
+    levels, :func:`_adasum_combine`), so every rank applies identical
+    coefficients even though the inner scatter made segment ownership
+    rank-dependent.  Every rank then slices its own tiled 1/n shard, so
+    ownership matches :func:`tree_index_axes` and :func:`tree_allgather`
+    reassembles unchanged.
+
+    ``bits`` runs each round's wire through the shared-scale codec
+    (:func:`_quantized_pair_exchange`) — the codec quantizes the wire,
+    the operator combines the payload.  Non-power-of-two levels (and
+    degenerate axis-tuple levels) gather once and run the identical
+    binary tree replicated on every rank, like ``ops/adasum.py``'s
+    fallback.  An extent-1 level is the identity scatter.
+    """
+    if n == 1:
+        return lax.psum_scatter(block, ax, tiled=True)
+    _count_adasum_exchange(ax)
+    shard = block.shape[0] // n
+    x = block
+    if isinstance(ax, str) and _is_pow2(n):
+        for r in range(n.bit_length() - 1):
+            dist = 1 << r
+            perm = [(i, i ^ dist) for i in range(n)]
+            if bits is not None:
+                own, partner = _quantized_pair_exchange(
+                    x, ax, perm, wire_dtype)
+                x = _adasum_combine(own, partner, scalar_axes)
+            else:
+                x = _adasum_combine(x, lax.ppermute(x, ax, perm=perm),
+                                    scalar_axes)
+    else:
+        stacked = allgather(x, ax, tiled=False).reshape((n,) + x.shape)
+        vals = [stacked[i] for i in range(n)]
+        while len(vals) > 1:
+            nxt = [_adasum_combine(vals[i], vals[i + 1], scalar_axes)
+                   for i in range(0, len(vals) - 1, 2)]
+            if len(vals) % 2:
+                nxt.append(vals[-1])
+            vals = nxt
+        x = vals[0]
+    return lax.dynamic_slice(x, (axis_index(ax) * shard,), (shard,))
+
+
 def tree_reducescatter(xs: Sequence[jax.Array],
                        levels: Sequence[ExchangeLevel],
                        op: ReduceOp = Sum,
@@ -697,7 +882,8 @@ def tree_reducescatter(xs: Sequence[jax.Array],
                        bucket_bytes: Optional[int] = None,
                        spec: Optional[FusionSpec] = None,
                        fused_tail: bool = False,
-                       residuals: Optional[Dict[str, jax.Array]] = None):
+                       residuals: Optional[Dict[str, jax.Array]] = None,
+                       reduction: str = "sum"):
     """N-level topology-aware reduce-scatter: the reduce phase of the
     tree exchange, composed per level from the resolved topology
     (``runtime/topology.resolve_topology``).  Phase ℓ reduce-scatters
@@ -721,11 +907,29 @@ def tree_reducescatter(xs: Sequence[jax.Array],
     :data:`FUSED_TAIL_TILES` sub-collectives (codec wins when both are
     requested, matching :func:`grouped_reducescatter`'s branch order).
 
+    ``reduction="adasum"`` swaps the OUTERMOST level's combine for the
+    AdaSum operator (:func:`_adasum_psum_scatter`): plain sum/RS within
+    the inner levels where replicas barely diverge, adaptive summation
+    on the slow outer hop where they diverge most.  The operator is
+    orthogonal to hierarchy and codec — inner-level RS, per-level wire
+    codecs, and error-feedback residuals stack unchanged (the codec
+    quantizes the wire; the operator combines the payload).  A 1-level
+    tree (single-slice world: no outer hop) and an extent-1 outermost
+    level degenerate to the bit-identical plain-sum path.  With
+    ``op=Average`` the inner levels deliver the inner-replica mean
+    (1/inner scale folded in before the outer round) and the final
+    1/world divide is skipped — adasum is itself the average-like
+    cross-replica combine.
+
     Ownership is row-major over :func:`tree_index_axes`; reassemble
     with :func:`tree_allgather`.
     """
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError("tree_reducescatter supports op=Sum/Average")
+    if reduction not in REDUCTIONS:
+        raise ValueError(
+            f"tree_reducescatter reduction must be one of {REDUCTIONS}, "
+            f"got {reduction!r}")
     levels = tuple(levels)
     if not levels:
         raise ValueError("tree_reducescatter needs >= 1 level")
@@ -743,12 +947,30 @@ def tree_reducescatter(xs: Sequence[jax.Array],
         raise ValueError(
             f"spec was planned for world {spec.world}, the "
             f"{len(levels)}-level tree has {world}")
+    # adasum rides the outermost level only, and only when there IS an
+    # outer hop to ride: single-level trees and extent-1 outer levels
+    # take the plain-sum path bit-identically
+    adasum_outer = (reduction == "adasum" and len(levels) >= 2
+                    and sizes[-1] > 1)
+    adasum_scalar_axes: tuple = ()
+    if adasum_outer:
+        # the inner levels the bucket is scattered across at the outer
+        # hop — the dot/norm partials reduce over these so every rank
+        # applies whole-bucket coefficients (_adasum_combine)
+        inner_axes = []
+        for lv in levels[:-1]:
+            if isinstance(lv.axis, str):
+                inner_axes.append(lv.axis)
+            else:
+                inner_axes.extend(lv.axis)
+        adasum_scalar_axes = tuple(inner_axes)
     shards: Dict[str, jax.Array] = {}
     new_residuals: Dict[str, jax.Array] = \
         dict(residuals) if residuals is not None else {}
     for gi, g in enumerate(spec.groups):
         block = _group_flat(g, xs, prescale_factor)
         floating = jnp.issubdtype(block.dtype, jnp.floating)
+        adasum_done = False
         if op == ReduceOp.AVERAGE and not floating:
             raise ValueError(
                 f"op=Average requires floating dtypes, got {g.dtype}")
@@ -773,6 +995,18 @@ def tree_reducescatter(xs: Sequence[jax.Array],
                         segments=tuple(segs))
             elif li == 0 and fused_tail and gi == len(spec.groups) - 1:
                 block = _tiled_psum_scatter(block, ax, sizes[0])
+            elif adasum_outer and li == len(levels) - 1 and floating:
+                # outermost hop: AdaSum adaptive combine; Average means
+                # the inner levels must deliver the inner-replica mean
+                # (fold the 1/inner scale in now) and the final 1/world
+                # divide is skipped — adasum IS the cross-replica
+                # average-like operator
+                if op == ReduceOp.AVERAGE:
+                    block = _scale(block, float(sizes[li]) / world)
+                block = _adasum_psum_scatter(
+                    block, ax, sizes[li], bits=bits,
+                    scalar_axes=adasum_scalar_axes)
+                adasum_done = True
             elif bits is not None and floating:
                 # outer hop: the surviving block, one shared scale —
                 # segment boundaries are rank-dependent after the
@@ -781,7 +1015,7 @@ def tree_reducescatter(xs: Sequence[jax.Array],
                     block, axis=ax, op=ReduceOp.SUM, bits=bits)
             else:
                 block = lax.psum_scatter(block, ax, tiled=True)
-        if op == ReduceOp.AVERAGE:
+        if op == ReduceOp.AVERAGE and not adasum_done:
             block = _scale(block, 1.0 / world)
         shards[g.key] = _scale(block, postscale_factor)
     if residuals is not None:
@@ -814,7 +1048,8 @@ def hierarchical_reducescatter(xs: Sequence[jax.Array],
                                fused_tail: bool = False,
                                quantize_inner: bool = False,
                                inner_residuals: Optional[
-                                   Dict[str, jax.Array]] = None):
+                                   Dict[str, jax.Array]] = None,
+                               reduction: str = "sum"):
     """Topology-aware two-level reduce-scatter — the reduce phase of the
     hierarchical exchange (reference ``NCCLHierarchicalAllreduce``,
     ``nccl_operations.cc:191-341``: NCCL inside the node, MPI across).
@@ -850,6 +1085,10 @@ def hierarchical_reducescatter(xs: Sequence[jax.Array],
     scales *do* ride the inner hop (the input buffer is still whole,
     unlike the DCN phase), so small leaves keep their own codec step.
 
+    ``reduction="adasum"`` puts the AdaSum combine on the DCN phase
+    (plain RS stays on ICI) — see :func:`tree_reducescatter`; a size-1
+    ``outer_axis`` degenerates it to the bit-identical plain sum.
+
     Degenerate axes (size-1 dcn on a single slice, or size-1 ici) fall
     through cleanly: a ``psum_scatter`` over a 1-extent axis is the
     local value, so the two-level form equals the flat one.
@@ -884,7 +1123,8 @@ def hierarchical_reducescatter(xs: Sequence[jax.Array],
                               prescale_factor=prescale_factor,
                               postscale_factor=postscale_factor,
                               spec=spec, fused_tail=fused_tail,
-                              residuals=inner_residuals)
+                              residuals=inner_residuals,
+                              reduction=reduction)
 
 
 def hierarchical_allgather(shards: Dict[str, jax.Array], spec: FusionSpec,

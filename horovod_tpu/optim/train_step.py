@@ -106,7 +106,8 @@ class DistributedTrainStep:
                  plan=None,
                  guard=None,
                  moe_fused: Optional[str] = None,
-                 moe_capacity_factor: Optional[float] = None):
+                 moe_capacity_factor: Optional[float] = None,
+                 reduction: Optional[str] = None):
         """``steps_per_call > 1`` scans that many optimizer steps inside
         the one compiled program (the Keras ``steps_per_execution``
         knob): one dispatch amortizes per-call host/launch overhead —
@@ -288,6 +289,12 @@ class DistributedTrainStep:
                 "fused_collectives schedules the sharded exchange's "
                 "final bucket; pass shard_optimizer_states=True to "
                 "enable it")
+        elif reduction not in (None, "sum"):
+            raise ValueError(
+                "reduction selects the sharded exchange's combine "
+                "operator; pass shard_optimizer_states=True to enable "
+                "it (the replicated path's adasum is op=Adasum / "
+                "DistributedAdasumOptimizer)")
         if error_feedback:
             if not shard_optimizer_states:
                 raise ValueError(
@@ -318,6 +325,26 @@ class DistributedTrainStep:
                 level_codecs = parse_level_codecs(
                     cfg.exchange_level_codecs)
         self._level_codecs = level_codecs
+        # reduction operator of the sharded exchange: explicit arg >
+        # runtime config > HOROVOD_EXCHANGE_REDUCTION env > plain sum.
+        # The env var is read directly (not only via the init-time
+        # config snapshot) so a knob set after hvd.init() still reaches
+        # the step — the same late-binding contract as the MoE knobs
+        # below.  None when no sharded exchange is active: the knob has
+        # nothing to steer there.
+        if shard_optimizer_states:
+            if reduction is None and state.is_initialized():
+                cfg_red = getattr(state.global_state().config,
+                                  "exchange_reduction", "sum")
+                if cfg_red and cfg_red != "sum":
+                    reduction = cfg_red
+            if reduction is None:
+                env_red = os.environ.get("HOROVOD_EXCHANGE_REDUCTION")
+                if env_red:
+                    reduction = env_red.lower()
+            self._reduction = C._resolve_reduction(reduction)
+        else:
+            self._reduction = None
         self._hierarchy = hierarchy
         # the mode the compiled exchange will actually run ("auto" made
         # static against the platform) — an AOT-key field and the value
@@ -537,7 +564,8 @@ class DistributedTrainStep:
                     hierarchy=hierarchy,
                     fused_collectives=self._fused_collectives,
                     error_feedback=self._error_feedback,
-                    level_codecs=self._level_codecs)
+                    level_codecs=self._level_codecs,
+                    reduction=self._reduction)
                 from horovod_tpu.runtime.topology import resolve_topology
 
                 # the mode the compiled step will actually run (the
@@ -709,6 +737,16 @@ class DistributedTrainStep:
         return self._moe_capacity_factor
 
     @property
+    def reduction(self) -> Optional[str]:
+        """The sharded exchange's combine operator (``"sum"`` |
+        ``"adasum"``) once resolved (explicit argument > runtime config
+        > ``HOROVOD_EXCHANGE_REDUCTION``); ``None`` when no sharded
+        exchange is active.  An AOT-key field — a warm start never
+        serves a sum executable to an adasum config (docs/adasum.md);
+        ``bench.py`` emits it as the ``reduction`` BENCH field."""
+        return self._reduction
+
+    @property
     def remat_policy(self) -> str:
         """The resolved remat policy (``none|dots|full|offload``) this
         step was built under — explicit ``remat=`` argument or the
@@ -743,6 +781,7 @@ class DistributedTrainStep:
             "guard": self._guard is not None,
             "plan": None if self._plan is None else self._plan.to_string(),
             "error_feedback": self._error_feedback,
+            "reduction": self._reduction,
             "remat": self._remat_policy,
             "moe_fused": self._moe_fused,
             "moe_capacity_factor": self._moe_capacity_factor,
@@ -891,6 +930,17 @@ class DistributedTrainStep:
                 "level (analysis/cost_model.py)")
             g.set(wire.ici, level="ici")
             g.set(wire.dcn, level="dcn")
+            if self._reduction == "adasum":
+                from horovod_tpu.analysis.cost_model import (
+                    adasum_extra_wire_bytes,
+                )
+
+                telemetry.gauge(
+                    "hvd_adasum_dot_wire_bytes",
+                    "modeled extra per-step DCN bytes of the adasum "
+                    "outer-level exchange (analysis/cost_model.py)"
+                ).set(adasum_extra_wire_bytes(
+                    float(payload), n_dcn=n_dcn, n_ici=n_ici))
         except Exception:  # noqa: BLE001 — observability must not sink a step
             pass
 

@@ -36,7 +36,8 @@ KNOWN_KNOBS = frozenset({
     "HOROVOD_CYCLE_TIME", "HOROVOD_CACHE_CAPACITY",
     "HOROVOD_HIERARCHICAL_ALLREDUCE", "HOROVOD_HIERARCHICAL_ALLGATHER",
     "HOROVOD_EXCHANGE_BUCKET_BYTES", "HOROVOD_EXCHANGE_HIERARCHY",
-    "HOROVOD_EXCHANGE_WIRE_DTYPE", "HOROVOD_FUSED_COLLECTIVES",
+    "HOROVOD_EXCHANGE_WIRE_DTYPE", "HOROVOD_EXCHANGE_REDUCTION",
+    "HOROVOD_FUSED_COLLECTIVES",
     "HOROVOD_ADASUM_NUM_CHUNKS", "HOROVOD_DEBUG_SPARSE",
     "HOROVOD_TPU_MESH_SHAPE",
     # -- N-level exchange codec map (runtime/topology.py,
@@ -214,6 +215,11 @@ class Config:
     # "dcn=int8,ici=fp32" grammar of topology.parse_level_codecs();
     # None defers to exchange_wire_dtype on the outermost level only
     exchange_level_codecs: Optional[str] = None
+    # combine operator of the sharded exchange: "sum" (plain RS), or
+    # "adasum" — AdaSum adaptive summation (arXiv 2006.02924) on the
+    # OUTERMOST topology level only, the large-batch scale-out
+    # operator (docs/adasum.md)
+    exchange_reduction: str = "sum"
     # tile-fused matmul⊗collective kernels (docs/fused_kernels.md):
     # "auto" enables on TPU only, "on"/"off" force; a new autotune
     # axis next to bucket bytes + hierarchy
@@ -313,6 +319,7 @@ class Config:
         mark("HOROVOD_EXCHANGE_HIERARCHY", "exchange_hierarchy")
         mark("HOROVOD_EXCHANGE_WIRE_DTYPE", "exchange_wire_dtype")
         mark("HOROVOD_EXCHANGE_LEVEL_CODECS", "exchange_level_codecs")
+        mark("HOROVOD_EXCHANGE_REDUCTION", "exchange_reduction")
         mark("HOROVOD_FUSED_COLLECTIVES", "fused_collectives")
         mark("HOROVOD_PLAN", "plan")
         mark("HOROVOD_REMAT_POLICY", "remat_policy")
@@ -362,6 +369,8 @@ class Config:
                 "HOROVOD_EXCHANGE_WIRE_DTYPE", "int8").lower(),
             exchange_level_codecs=(
                 os.environ.get("HOROVOD_EXCHANGE_LEVEL_CODECS") or None),
+            exchange_reduction=_env_str(
+                "HOROVOD_EXCHANGE_REDUCTION", "sum").lower(),
             fused_collectives=_env_str(
                 "HOROVOD_FUSED_COLLECTIVES", "auto").lower(),
             autotune=_env_bool("HOROVOD_AUTOTUNE", False),

@@ -1,0 +1,409 @@
+"""AdaSum as a *reduction-operator axis* of the hierarchical exchange
+(docs/adasum.md).
+
+Pins the ISSUE-19 contract: ``reduction="adasum"`` swaps the OUTERMOST
+topology level's combine for the pairwise adaptive rule while the inner
+levels keep their plain reduce-scatter, composing with per-level wire
+codecs and EF residuals unchanged.  The oracle is the whole-vector
+NumPy pairwise rule applied to the plain inner-level reductions — which
+simultaneously proves the inner levels are untouched and that every
+rank applies the same whole-bucket coefficients (the fp32 dot/norm
+scalars are psum'd over the inner axes, not computed per shard).
+
+Companion suites: ``test_adasum.py`` (the PR-12 delta-allreduce
+operator), ``test_hierarchy_smoke.py`` (the N-level tree itself),
+``analysis/adasum_smoke.py`` (the hvdci gate-10 twin these convergence
+pins share their simulator with).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.analysis import adasum_smoke as AS
+from horovod_tpu.analysis import cost_model as CM
+from horovod_tpu.ops import collectives as C
+from horovod_tpu.optim.optimizer import (
+    ShardedOptimizerState,
+    sharded_distributed_update,
+)
+from horovod_tpu.runtime.topology import parse_level_codecs
+
+TREE_AXES = ("pod", "slice", "chip")    # outermost first
+
+
+@pytest.fixture(autouse=True)
+def runtime():
+    hvd.init()
+    yield
+    hvd.shutdown()
+    os.environ.pop("HOROVOD_EXCHANGE_REDUCTION", None)
+
+
+def make_tree_mesh(shape=(2, 2, 2)):
+    devs = np.asarray(jax.devices("cpu")[:8]).reshape(shape)
+    return Mesh(devs, TREE_AXES)
+
+
+def tree_levels(pod_bits=None, chip_bits=None):
+    # innermost first — the tree_reducescatter convention
+    return (C.ExchangeLevel("chip", chip_bits),
+            C.ExchangeLevel("slice"),
+            C.ExchangeLevel("pod", pod_bits))
+
+
+def np_adasum_pair(a, b):
+    """The whole-vector pairwise rule in float64 (reference numerics —
+    same formula as test_adasum.py's oracle)."""
+    a64, b64 = a.astype(np.float64), b.astype(np.float64)
+    dot = np.dot(a64.ravel(), b64.ravel())
+    anormsq = np.dot(a64.ravel(), a64.ravel())
+    bnormsq = np.dot(b64.ravel(), b64.ravel())
+    acoeff = 1.0 - dot / (2 * anormsq) if anormsq >= 1e-30 else 1.0
+    bcoeff = 1.0 - dot / (2 * bnormsq) if bnormsq >= 1e-30 else 1.0
+    return (acoeff * a64 + bcoeff * b64).astype(a.dtype)
+
+
+def tree_exchange(data, levels, op=C.Average, reduction="sum",
+                  mesh=None):
+    """RS → AG through the tree on the 8-rank virtual mesh; returns the
+    gathered (replicated) result."""
+    mesh = mesh if mesh is not None else make_tree_mesh()
+
+    def inner():
+        r = C.axis_index(TREE_AXES)
+        shards, spec = C.tree_reducescatter(
+            [jnp.asarray(data)[r]], levels, op=op, reduction=reduction)
+        (out,) = C.tree_allgather(shards, spec, levels)
+        return out[None]
+
+    return np.asarray(jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=(), out_specs=P(TREE_AXES),
+        check_vma=False))())[0]
+
+
+class TestOperatorTopologyComposition:
+    """Satellite 4: adasum on the outer level of the 3-level tree —
+    oracle parity, per-level codec composition, and the degeneracy
+    pins."""
+
+    def _data(self, seed=0, n=24):
+        rng = np.random.RandomState(seed)
+        return rng.randn(8, n).astype(np.float32)
+
+    def test_average_oracle_inner_levels_untouched(self):
+        """adasum ⊗ AVERAGE on (pod=2, slice=2, chip=2): the result is
+        the pairwise rule applied to the two plain pod-block *means* —
+        proving both the outer-level operator swap and that the inner
+        slice/chip levels still run the untouched plain RS."""
+        data = self._data()
+        got = tree_exchange(data, tree_levels(), op=C.Average,
+                            reduction="adasum")
+        exp = np_adasum_pair(data[0:4].mean(axis=0),
+                             data[4:8].mean(axis=0))
+        np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-6)
+
+    def test_sum_oracle(self):
+        """Same composition under op=Sum: adasum of the plain
+        pod-block sums."""
+        data = self._data(seed=1)
+        got = tree_exchange(data, tree_levels(), op=C.Sum,
+                            reduction="adasum")
+        exp = np_adasum_pair(data[0:4].sum(axis=0),
+                             data[4:8].sum(axis=0))
+        np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-5)
+
+    def test_outer_codec_composes_within_quantization_bound(self):
+        """int8 on the pod hop + adasum on the pod hop: the quantized
+        pairwise exchange stays within the shared-scale codec's error
+        bound of the exact adasum result."""
+        data = self._data(seed=3)
+        got = tree_exchange(data, tree_levels(pod_bits=8),
+                            op=C.Average, reduction="adasum")
+        exp = np_adasum_pair(data[0:4].mean(axis=0),
+                             data[4:8].mean(axis=0))
+        tol = np.abs(data).sum(axis=0).max() / 127.0
+        np.testing.assert_allclose(got, exp, atol=tol)
+
+    def test_level_codecs_knob_grammar_places_codec_on_adasum_level(
+            self):
+        """The HOROVOD_EXCHANGE_LEVEL_CODECS grammar ("pod=int8")
+        drives the same composition: parse → per-level bits → the
+        quantized adasum outer hop, same bound as the direct spelling."""
+        codecs = parse_level_codecs("pod=int8,slice=fp32")
+        assert codecs == {"pod": 8, "slice": None}
+        data = self._data(seed=3)
+        got = tree_exchange(data, tree_levels(pod_bits=codecs["pod"]),
+                            op=C.Average, reduction="adasum")
+        direct = tree_exchange(data, tree_levels(pod_bits=8),
+                               op=C.Average, reduction="adasum")
+        np.testing.assert_array_equal(got, direct)
+
+    def test_single_level_degenerates_bit_identical(self):
+        """A flat (single-level) topology has no outer hop: adasum is
+        bit-identical to plain sum."""
+        data = self._data(seed=4)
+        flat = (C.ExchangeLevel(TREE_AXES),)
+        ada = tree_exchange(data, flat, op=C.Average,
+                            reduction="adasum")
+        plain = tree_exchange(data, flat, op=C.Average,
+                              reduction="sum")
+        np.testing.assert_array_equal(ada, plain)
+
+    def test_extent_one_outer_level_degenerates_bit_identical(self):
+        """A pod axis of extent 1 (single-slice world on a 3-axis
+        mesh) never engages the pairwise schedule."""
+        data = self._data(seed=5)
+        mesh = make_tree_mesh(shape=(1, 2, 4))
+        ada = tree_exchange(data, tree_levels(), op=C.Average,
+                            reduction="adasum", mesh=mesh)
+        plain = tree_exchange(data, tree_levels(), op=C.Average,
+                              reduction="sum", mesh=mesh)
+        np.testing.assert_array_equal(ada, plain)
+
+    def test_reduction_validation(self):
+        """Unknown reduction strings raise everywhere the knob lands;
+        the historical op=Adasum rejection stays pinned — the operator
+        rides the reduction= axis, not the ReduceOp enum."""
+        with pytest.raises(ValueError, match="reduction"):
+            C._resolve_reduction("bogus")
+        with pytest.raises(ValueError, match="reduction"):
+            C.tree_reducescatter([jnp.ones((4,))], tree_levels(),
+                                 reduction="bogus")
+        with pytest.raises(ValueError, match="reduction"):
+            sharded_distributed_update(optax.sgd(0.1),
+                                       reduction="bogus")
+        with pytest.raises(ValueError, match="op=Sum/Average"):
+            sharded_distributed_update(optax.sgd(0.1), op=C.Adasum)
+
+
+class TestShardedAdasumUpdate:
+    """The reduction knob through sharded_distributed_update: the full
+    RS → shard-update → AG path with the operator on the outer hop."""
+
+    def _updates(self, reduction, level_codecs=None, lr=1.0):
+        data = np.random.RandomState(7).randn(8, 24).astype(np.float32)
+
+        def inner():
+            r = C.axis_index(TREE_AXES)
+            tx = sharded_distributed_update(
+                optax.sgd(lr), axis=TREE_AXES, world=8,
+                hierarchy="tree", level_codecs=level_codecs,
+                reduction=reduction)
+            params = {"w": jnp.zeros((24,))}
+            g = {"w": jnp.asarray(data)[r]}
+            u, _ = tx.update(g, tx.init(params), params)
+            return u["w"][None]
+
+        out = np.asarray(jax.jit(jax.shard_map(
+            inner, mesh=make_tree_mesh(), in_specs=(),
+            out_specs=P(TREE_AXES), check_vma=False))())
+        return data, out[0]
+
+    def test_sgd_update_matches_pairwise_oracle(self):
+        """With sgd(1.0) the update IS −(reduced gradient), so the
+        optimizer-path oracle is exact: −adasum(mean(pod0),
+        mean(pod1))."""
+        data, u = self._updates("adasum")
+        exp = -np_adasum_pair(data[0:4].mean(axis=0),
+                              data[4:8].mean(axis=0))
+        np.testing.assert_allclose(u, exp, rtol=1e-5, atol=1e-6)
+
+    def test_differs_from_sum_and_codec_path_runs(self):
+        data, ada = self._updates("adasum",
+                                  level_codecs={"pod": 8})
+        _, plain = self._updates("sum")
+        assert np.all(np.isfinite(ada))
+        assert np.abs(ada - plain).max() > 0
+
+
+class TestEfResidualReset:
+    """Satellite 1: ShardedOptimizerState.reset_residuals — the hook a
+    reduction switch calls so one operator's rounding residuals never
+    bias the other's first step."""
+
+    def test_none_residuals_is_identity(self):
+        s = ShardedOptimizerState(inner=("opt",), residuals=None)
+        assert s.reset_residuals() is s
+
+    def test_reset_zeroes_residuals_keeps_inner(self):
+        s = ShardedOptimizerState(
+            inner=("opt",),
+            residuals={"g0": jnp.full((6,), 0.25, jnp.float32)})
+        r = s.reset_residuals()
+        assert r.inner is s.inner
+        np.testing.assert_array_equal(np.asarray(r.residuals["g0"]),
+                                      np.zeros((6,), np.float32))
+
+    def test_no_stale_residual_leak_across_reduction_switch(self):
+        """Train one EF step under reduction="sum", switch the state to
+        an adasum transformation: through reset_residuals the next
+        update is bit-identical to a fresh start, while carrying the
+        stale residuals over verifiably perturbs it — the leak the
+        hook exists to prevent."""
+        data = np.random.RandomState(11).randn(8, 24) \
+            .astype(np.float32)
+
+        def inner():
+            r = C.axis_index(TREE_AXES)
+            kw = dict(axis=TREE_AXES, world=8, hierarchy="tree",
+                      quantized_bits=8, error_feedback=True)
+            tx_sum = sharded_distributed_update(
+                optax.sgd(0.1), reduction="sum", **kw)
+            tx_ada = sharded_distributed_update(
+                optax.sgd(0.1), reduction="adasum", **kw)
+            params = {"w": jnp.zeros((24,))}
+            g = {"w": jnp.asarray(data)[r]}
+            _, s_sum = tx_sum.update(g, tx_sum.init(params), params)
+            u_fresh, _ = tx_ada.update(g, tx_ada.init(params), params)
+            u_reset, _ = tx_ada.update(g, s_sum.reset_residuals(),
+                                       params)
+            u_stale, _ = tx_ada.update(g, s_sum, params)
+            res = jnp.concatenate(
+                [v for v in s_sum.residuals.values()])
+            return (u_fresh["w"][None], u_reset["w"][None],
+                    u_stale["w"][None], res[None])
+
+        fresh, reset, stale, res = [np.asarray(x) for x in jax.jit(
+            jax.shard_map(inner, mesh=make_tree_mesh(), in_specs=(),
+                          out_specs=(P(TREE_AXES),) * 4,
+                          check_vma=False))()]
+        # the sum step really left rounding residuals behind
+        assert np.abs(res).max() > 0
+        # reset: the adasum step forgets them — bit-identical to fresh
+        np.testing.assert_array_equal(reset, fresh)
+        # no reset: the stale residuals leak into the adasum wire
+        assert np.abs(stale - fresh).max() > 0
+
+
+class TestAdasumConvergencePinned:
+    """The acceptance convergence proof, pinned on the seeded CPU twin
+    (analysis/adasum_smoke.py — the same simulator hvdci gate 10 and
+    bench --adasum run): adasum at 2–4× the global batch holds the
+    base-batch sum trajectory while plain sum at the same scale crosses
+    the stability edge and diverges."""
+
+    def _trajs(self, scale, lr):
+        base = AS.simulate_convergence(1, "sum", steps=40, seed=42,
+                                       lr=lr)
+        ada = AS.simulate_convergence(scale, "adasum", steps=40,
+                                      seed=42, lr=lr)
+        summed = AS.simulate_convergence(scale, "sum", steps=40,
+                                         seed=42, lr=lr)
+        return base, ada, summed
+
+    @pytest.mark.parametrize("scale,lr", [(2, 0.75), (4, 0.45)])
+    def test_adasum_matches_base_while_sum_degrades(self, scale, lr):
+        base, ada, summed = self._trajs(scale, lr)
+        # the base-batch reference converges two orders of magnitude
+        assert base[-1] < 1e-2 * base[0]
+        # adasum at scale× tracks it (same order of final loss)
+        assert ada[-1] < 1e-2 * ada[0]
+        assert ada[-1] <= 10 * max(base[-1], 1e-6)
+        # plain summation at scale× blows through the stability edge
+        assert summed[-1] > 1e2 * base[0]
+
+    def test_bit_identical_across_runs(self):
+        one = json.dumps(self._trajs(4, 0.45))
+        two = json.dumps(self._trajs(4, 0.45))
+        assert one == two
+
+    def test_hvdci_gate_is_green(self):
+        assert AS.run_smoke(None) == []
+
+
+class TestAdasumCostModel:
+    """The pricing side of the tentpole: the extra DCN round and the
+    autotune batch crossover."""
+
+    def test_extra_wire_single_slice_is_free(self):
+        assert CM.adasum_extra_wire_bytes(1e9, n_dcn=1, n_ici=64) == 0.0
+
+    def test_extra_wire_closed_form(self):
+        # n_dcn=2: 1 doubling round of the payload/n_ici block minus
+        # the (n-1)/n ring RS it displaces
+        assert CM.adasum_extra_wire_bytes(800.0, n_dcn=2, n_ici=4) \
+            == pytest.approx((1 - 0.5) * 200.0)
+        # n_dcn=4: 2 rounds vs the 3/4 ring factor
+        assert CM.adasum_extra_wire_bytes(400.0, n_dcn=4, n_ici=1) \
+            == pytest.approx((2 - 0.75) * 400.0)
+
+    def test_plan_cost_adds_pure_penalty(self):
+        kw = dict(payload_bytes=1e9, n_dcn=2, n_ici=2, compute_s=0.1)
+        plain = CM.plan_cost_s("dp=4", **kw)
+        ada = CM.plan_cost_s("dp=4", reduction="adasum", **kw)
+        extra = CM.adasum_extra_wire_bytes(1e9, n_dcn=2, n_ici=2) \
+            / CM.V5E.dcn_bytes_per_s
+        assert ada == pytest.approx(plain + extra)
+        assert extra > 0
+        # single-slice world: same clock, adasum never engages
+        assert CM.plan_cost_s("dp=4", reduction="adasum", n_dcn=1,
+                              payload_bytes=1e9, compute_s=0.1) \
+            == pytest.approx(CM.plan_cost_s("dp=4", n_dcn=1,
+                                            payload_bytes=1e9,
+                                            compute_s=0.1))
+
+    def test_reduction_only_point_is_rankable(self):
+        assert CM.score_exchange_schedule(
+            {"reduction": "sum"}, 1e9, n_dcn=2, n_ici=4) is not None
+        assert CM.score_exchange_schedule({}, 1e9) is None
+
+    def test_autotune_batch_crossover(self):
+        """The reduction axis flips to adasum only above a batch
+        crossover: at tiny compute (small per-chip batch) the extra
+        DCN round loses; once compute_s — which grows with batch —
+        clears extra_s / credit_fraction, adasum wins the ranking."""
+        def score(reduction, compute_s):
+            return CM.score_exchange_schedule(
+                {"hierarchy": "two_level", "reduction": reduction},
+                1e9, n_dcn=2, n_ici=4, compute_s=compute_s)
+
+        assert score("sum", 0.0) > score("adasum", 0.0)
+        assert score("adasum", 1e4) > score("sum", 1e4)
+        # the crossover sits exactly where the credit pays the wire
+        extra_s = CM.adasum_extra_wire_bytes(1e9, n_dcn=2, n_ici=4) \
+            / CM.V5E.dcn_bytes_per_s
+        edge = extra_s / CM.ADASUM_COMPUTE_CREDIT_FRACTION
+        assert score("sum", 0.5 * edge) > score("adasum", 0.5 * edge)
+        assert score("adasum", 2.0 * edge) > score("sum", 2.0 * edge)
+
+
+class TestBenchAdasumArtifact:
+    """bench --adasum: the BENCH JSON fields of the convergence probe
+    validate against the telemetry contract and repeat bit-identically."""
+
+    def _args(self, scale=2):
+        import argparse
+
+        return argparse.Namespace(adasum_batch_scale=scale,
+                                  tf_d_model=64, tf_layers=2)
+
+    def test_fields_deterministic_and_schema_clean(self):
+        import bench
+        from horovod_tpu.analysis import metrics_schema
+
+        out1 = bench.run_adasum(self._args(), hvd)
+        out2 = bench.run_adasum(self._args(), hvd)
+        assert json.dumps(out1, sort_keys=True) \
+            == json.dumps(out2, sort_keys=True)
+        assert out1["reduction"] == "adasum"
+        assert out1["metric"] == "adasum"
+        assert out1["adasum_batch_scale"] == 2
+        assert out1["adasum_dot_wire_bytes"] >= 0
+        for k in ("adasum_loss_trajectory", "sum_base_loss_trajectory",
+                  "sum_scaled_loss_trajectory"):
+            assert len(out1[k]) == 40
+        # the final-loss headline is the adasum trajectory's tail
+        assert out1["value"] == out1["adasum_loss_trajectory"][-1]
+        # assembled the way bench emits it, the artifact passes the
+        # hvdtel schema check (ADASUM_SERIES is a closed vocabulary)
+        art = dict(out1, **bench.artifact_metadata(hvd),
+                   **bench.telemetry_fields())
+        assert metrics_schema.validate_artifact_metrics(art) == []

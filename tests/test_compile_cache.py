@@ -300,6 +300,47 @@ class TestPlanKey:
                                          off._aot_extras())
 
 
+class TestReductionKey:
+    """ISSUE 19: the exchange's reduction operator is an AOT-key
+    field — an adasum program runs a different outer-level schedule
+    (pairwise doubling + psum'd dot/norm scalars), so a warm start
+    must never serve it to a plain-sum config or vice versa."""
+
+    def test_key_differs_on_reduction_field(self):
+        base = compile_cache.executable_key("module @m {}",
+                                            {"reduction": "sum"})
+        assert compile_cache.executable_key(
+            "module @m {}", {"reduction": "adasum"}) != base
+        assert compile_cache.executable_key(
+            "module @m {}", {"reduction": None}) != base
+
+    def test_step_extras_carry_resolved_reduction(self, cache_dir):
+        step = _make_step(mode="shard_map",
+                          shard_optimizer_states=True,
+                          reduction="adasum")
+        assert step._aot_extras()["reduction"] == "adasum"
+        plain = _make_step(mode="shard_map",
+                           shard_optimizer_states=True)
+        assert plain._aot_extras()["reduction"] == "sum"
+        assert compile_cache.executable_key(
+            "module @m {}", step._aot_extras()) != \
+            compile_cache.executable_key("module @m {}",
+                                         plain._aot_extras())
+        # no sharded exchange → the knob has nothing to steer
+        bare = _make_step()
+        assert bare._aot_extras()["reduction"] is None
+
+    def test_env_knob_reaches_the_key(self, cache_dir, monkeypatch):
+        monkeypatch.setenv("HOROVOD_EXCHANGE_REDUCTION", "adasum")
+        step = _make_step(mode="shard_map",
+                          shard_optimizer_states=True)
+        assert step._aot_extras()["reduction"] == "adasum"
+
+    def test_replicated_path_rejects_the_knob(self, cache_dir):
+        with pytest.raises(ValueError, match="shard_optimizer_states"):
+            _make_step(mode="shard_map", reduction="adasum")
+
+
 class TestMoeRoutingKey:
     """ISSUE 16: the MoE dispatch schedule and capacity factor are
     AOT-key fields — a warm start must never serve a fused-ring

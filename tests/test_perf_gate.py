@@ -233,6 +233,50 @@ class TestPlanComparability:
                                      "plan": "dp=2,fsdp=4"})
         assert PG.diff([base], cand, PG.Tolerances()) == []
 
+
+class TestReductionComparability:
+    """ISSUE 19 satellite: the reduction operator is a comparability
+    key on every throughput field — sum→adasum runs a different
+    outer-level schedule (plus its dot/norm wire), so a rate shift
+    across the switch is a schedule change, never PERF001; legacy
+    artifacts without the field keep gating (None matches None)."""
+
+    def _art(self, name, value, reduction=None):
+        parsed = {"metric": "resnet50_img_sec_per_chip",
+                  "value": value}
+        if reduction is not None:
+            parsed["reduction"] = reduction
+        return PG._validate(name, parsed)
+
+    def test_reduction_switch_not_diffed(self):
+        base = self._art("base", 3000.0)
+        base_r = self._art("base_r", 3000.0, reduction="sum")
+        cand = self._art("cand", 1000.0, reduction="adasum")
+        # operator switch: not diffed (sum-vs-adasum AND legacy
+        # None-vs-adasum are both schedule changes)
+        assert PG.diff([base_r], cand, PG.Tolerances()) == []
+        assert PG.diff([base], cand, PG.Tolerances()) == []
+        # same operator: the regression still fires
+        cand_same = PG._validate("cand_same", dict(
+            {"metric": "resnet50_img_sec_per_chip", "value": 1000.0},
+            reduction="adasum"))
+        assert [f.rule for f in PG.diff([cand], cand_same,
+                                        PG.Tolerances())] == []
+        slow = PG._validate("slow", dict(
+            {"metric": "resnet50_img_sec_per_chip", "value": 500.0},
+            reduction="adasum"))
+        assert [f.rule for f in PG.diff([cand], slow,
+                                        PG.Tolerances())] == ["PERF001"]
+
+    def test_legacy_artifacts_still_gate(self):
+        # legacy artifacts without the field: None matches None
+        base = self._art("base", 3000.0)
+        legacy = self._art("legacy", 1000.0)
+        assert [f.rule for f in PG.diff([base], legacy,
+                                        PG.Tolerances())] == ["PERF001"]
+
+
+class TestMoeComparability:
     def test_moe_routing_config_guards_the_diff(self):
         """ISSUE 16 satellite: capacity_factor and the ep extent are
         comparability keys on the MoE throughput — a routing-config
