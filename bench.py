@@ -1477,7 +1477,17 @@ def run_serve(args, hvd):
       the survivor, and p99 inflation bounded by
       ``--serve-p99-inflation-max``;
     * both passes run **twice**; ``serve_deterministic`` is the
-      bit-identity of the full result dicts.
+      bit-identity of the full result dicts;
+    * the **fleet** pass (``--serve-models``, default 3) drives the
+      hvdfleet stack: ``--serve-models`` tenant models behind the
+      weighted-fair scheduler, a live weight swap staged mid-load and
+      flipped atomically between batches (every post-flip response
+      must carry the new fingerprint), a chaos replica kill whose
+      lease re-enqueues exactly once AND feeds the autoscale loop,
+      and a scale-up that must recover p99 within the probe window.
+      The emitted ``serve_models`` / ``serve_tenant_mix`` fields are
+      comparability keys: a fleet artifact is never diffed against a
+      single-model one (PERF001/PERF005).
     """
     import numpy as np
 
@@ -1486,16 +1496,21 @@ def run_serve(args, hvd):
     from horovod_tpu.serve import (
         ADMITTED,
         AdmissionQueue,
+        AutoscaleController,
         ContinuousBatcher,
+        FleetBatcher,
         InferenceRequest,
+        MultiTenantQueue,
         Replica,
         ReplicaPool,
+        WeightRefresher,
     )
 
     seed = args.serve_seed
     n_requests = args.serve_requests
     rps = float(args.serve_rps)
     max_batch = args.serve_max_batch
+    n_models = max(int(args.serve_models), 1)
 
     def scenario(crash_at=None):
         plan = None
@@ -1579,26 +1594,194 @@ def run_serve(args, hvd):
             if plan is not None:
                 faults.clear_plan()
 
+    _classes = ("interactive", "standard", "batch")
+    _weights = (4.0, 2.0, 1.0)
+
+    def fleet_scenario(crash_at):
+        """The hvdfleet pass: tenancy + live refresh + closed-loop
+        autoscale under a seeded chaos kill, all on the logical
+        clock (module docstring bullet 4)."""
+        plan = FaultPlan(seed=seed, sim=True).add(
+            "serve.batch", "crash", at=crash_at)
+        faults.set_plan(plan)
+        try:
+            now = [0.0]
+
+            def clock():
+                return now[0]
+
+            def executor(payloads, model_id=None, weights=None):
+                now[0] += 0.004 + 0.001 * len(payloads)
+                w = float(np.asarray(weights).sum())
+                return [round(float(np.asarray(p).sum()) + w, 6)
+                        for p in payloads]
+
+            fleet = MultiTenantQueue(clock=clock)
+            models = [f"m{i}" for i in range(n_models)]
+            for i, model_id in enumerate(models):
+                fleet.add_model(
+                    model_id, weight=_weights[i % len(_weights)],
+                    slo_class=_classes[i % len(_classes)],
+                    depth=max(2 * n_requests // n_models, 32))
+
+            refresher = WeightRefresher(clock=clock)
+            old_fp = {m: refresher.register(
+                m, np.full(8, i + 1.0, np.float32))
+                for i, m in enumerate(models)}
+
+            pool = ReplicaPool(fleet, drain_timeout_s=1.0,
+                               scale_up_depth=3 * max_batch,
+                               scale_down_depth=0,
+                               scale_hold_s=0.01, clock=clock)
+            for i in range(2):
+                pool.add_replica(Replica(
+                    f"r{i}", executor, host=f"serve-host-{i}",
+                    clock=clock))
+
+            got = {}
+            flips_at_response = {}
+
+            def on_response(r):
+                got.setdefault(r.request_id, []).append(
+                    (r.model_id, r.weights_fp, r.latency_s,
+                     r.requeues))
+                flips_at_response.setdefault(
+                    r.request_id, refresher.flips)
+
+            batcher = FleetBatcher(
+                fleet, pool, refresher=refresher,
+                max_batch=max_batch, clock=clock,
+                on_response=on_response)
+
+            names = [0]
+
+            def acquire():
+                names[0] += 1
+                return Replica(f"scale-{names[0]}", executor,
+                               host=f"serve-scale-{names[0]}",
+                               clock=clock)
+
+            scale_t = [None]
+            controller = AutoscaleController(
+                pool, acquire, cooldown_s=0.02, min_replicas=1,
+                max_replicas=4, clock=clock)
+
+            rng = np.random.RandomState(seed)
+            payloads = [rng.rand(8).astype(np.float32)
+                        for _ in range(n_requests)]
+            arrivals = [i / rps for i in range(n_requests)]
+            refresh_at = n_requests // 3
+            admitted = []
+            i = 0
+            while i < n_requests or len(fleet):
+                if i < n_requests and now[0] >= arrivals[i]:
+                    req = InferenceRequest(
+                        request_id=f"req-{i:04d}",
+                        payload=payloads[i],
+                        model_id=models[i % n_models],
+                        arrival_s=arrivals[i],
+                        deadline_s=arrivals[i] + 2.0)
+                    if fleet.submit(req) == ADMITTED:
+                        admitted.append(req.request_id)
+                    if i == refresh_at:
+                        # the live weight swap, staged mid-load
+                        refresher.stage(
+                            "m0", np.full(8, 9.0, np.float32))
+                    i += 1
+                    continue
+                if len(fleet) and pool.serving_count():
+                    batcher.step()
+                    if controller.poll() > 0 and scale_t[0] is None:
+                        scale_t[0] = now[0]
+                    continue
+                if i < n_requests:
+                    now[0] = arrivals[i]
+                    continue
+                break
+            drains = [pool.drain(r) for r in pool.replicas()
+                      if r.alive]
+
+            new_fp = refresher.fingerprint_of("m0")
+            # freshness proof: every m0 response minted after the flip
+            # carries the new fingerprint, every pre-flip one the old
+            post_flip_fp_ok = all(
+                (rs[0][1] == new_fp) if flips_at_response[rid] > 0
+                else (rs[0][1] == old_fp["m0"])
+                for rid, rs in got.items() if rs[0][0] == "m0")
+            # recovery probe: p99 over requests that ARRIVED after the
+            # scale-up actuated — the acquired capacity must pull the
+            # tail back inside the inflation budget
+            req_arrival = {f"req-{j:04d}": arrivals[j]
+                           for j in range(n_requests)}
+            recover = sorted(
+                rs[0][2] for rid, rs in got.items()
+                if scale_t[0] is not None
+                and req_arrival[rid] >= scale_t[0])
+            lat = sorted(rs[0][2] for rs in got.values())
+            return {
+                "admitted": len(admitted),
+                "lost": len(set(admitted) - set(got)),
+                "duplicates": sum(1 for ls in got.values()
+                                  if len(ls) != 1),
+                "requeued": sum(1 for ls in got.values()
+                                if any(r[3] > 0 for r in ls)),
+                "flips": refresher.flips,
+                "rollbacks": refresher.rollbacks,
+                "post_flip_fp_ok": post_flip_fp_ok,
+                "scale_ups": controller.scale_ups,
+                "deaths": pool.deaths,
+                "p99": round(float(np.percentile(lat, 99)), 6)
+                if lat else None,
+                "recover_p99": round(
+                    float(np.percentile(recover, 99)), 6)
+                if recover else None,
+                "picks": dict(sorted(fleet.pick_counts.items())),
+                "drains": drains,
+                "makespan_s": round(max(now[0], 1e-9), 6),
+            }
+        finally:
+            faults.clear_plan()
+
     crash_at = max(2, n_requests // (2 * max_batch))
     base1, base2 = scenario(), scenario()
     chaos1, chaos2 = scenario(crash_at=crash_at), scenario(crash_at=crash_at)
-    deterministic = base1 == base2 and chaos1 == chaos2
+    fleet1, fleet2 = fleet_scenario(crash_at), fleet_scenario(crash_at)
+    deterministic = base1 == base2 and chaos1 == chaos2 \
+        and fleet1 == fleet2
 
     inflation = round(chaos1["p99"] / base1["p99"], 4) \
         if base1["p99"] else None
+    mix = {}
+    for i in range(n_models):
+        cls = _classes[i % len(_classes)]
+        mix[cls] = mix.get(cls, 0) + 1
+    tenant_mix = "|".join(f"{c}:{n}" for c, n in sorted(mix.items()))
+    fleet_recovered = (fleet1["recover_p99"] is not None
+                      and base1["p99"] is not None
+                      and fleet1["recover_p99"]
+                      <= args.serve_p99_inflation_max * base1["p99"])
     ok = (deterministic
           and base1["lost"] == 0 and base1["duplicates"] == 0
           and chaos1["lost"] == 0 and chaos1["duplicates"] == 0
           and chaos1["requeued"] > 0
           and all(chaos1["drains"])
           and inflation is not None
-          and inflation <= args.serve_p99_inflation_max)
+          and inflation <= args.serve_p99_inflation_max
+          and fleet1["lost"] == 0 and fleet1["duplicates"] == 0
+          and fleet1["requeued"] > 0
+          and fleet1["flips"] == 1 and fleet1["rollbacks"] == 0
+          and fleet1["post_flip_fp_ok"]
+          and fleet1["scale_ups"] >= 1
+          and fleet_recovered
+          and all(fleet1["drains"]))
     return {
         "metric": "serve",
         "ok": ok,
         "serve_offered_rps": rps,
         "serve_requests": n_requests,
         "serve_max_batch": max_batch,
+        "serve_models": n_models,
+        "serve_tenant_mix": tenant_mix,
         "serve_admitted": base1["admitted"],
         "serve_p50_latency_s": base1["p50"],
         "serve_p99_latency_s": base1["p99"],
@@ -1610,6 +1793,20 @@ def run_serve(args, hvd):
         "serve_chaos_p99_latency_s": chaos1["p99"],
         "serve_chaos_p99_inflation": inflation,
         "serve_chaos_drain_graceful": all(chaos1["drains"]),
+        "serve_fleet_admitted": fleet1["admitted"],
+        "serve_fleet_lost": fleet1["lost"],
+        "serve_fleet_duplicates": fleet1["duplicates"],
+        "serve_fleet_requeued": fleet1["requeued"],
+        "serve_fleet_refresh_flips": fleet1["flips"],
+        "serve_fleet_refresh_rollbacks": fleet1["rollbacks"],
+        "serve_fleet_post_flip_fp_ok": fleet1["post_flip_fp_ok"],
+        "serve_fleet_scale_ups": fleet1["scale_ups"],
+        "serve_fleet_deaths": fleet1["deaths"],
+        "serve_fleet_p99_latency_s": fleet1["p99"],
+        "serve_fleet_recover_p99_latency_s": fleet1["recover_p99"],
+        "serve_fleet_p99_recovered": fleet_recovered,
+        "serve_fleet_picks": fleet1["picks"],
+        "serve_fleet_drain_graceful": all(fleet1["drains"]),
     }
 
 
@@ -2694,6 +2891,11 @@ def main():
                         "comparability key")
     p.add_argument("--serve-max-batch", type=int, default=4,
                    help="continuous-batcher packing limit for --serve")
+    p.add_argument("--serve-models", type=int, default=3,
+                   help="tenant models in the --serve fleet pass "
+                        "(weighted-fair scheduling, live weight "
+                        "refresh, autoscale); also a PERF001/PERF005 "
+                        "comparability key")
     p.add_argument("--serve-seed", type=int, default=42,
                    help="traffic / FaultPlan seed for --serve")
     p.add_argument("--serve-p99-inflation-max", type=float, default=5.0,

@@ -1,7 +1,7 @@
 """``python -m horovod_tpu.analysis ci`` / ``hvdci`` — the one-shot CI
 entry point.
 
-Ten gates, one invocation, one exit code (docs/perf_gate.md):
+Eleven gates, one invocation, one exit code (docs/perf_gate.md):
 
 1. **hvdlint** over the pre-commit scope (``--changed``: staged +
    unstaged + untracked files under ``horovod_tpu/``; falls back to the
@@ -40,7 +40,12 @@ Ten gates, one invocation, one exit code (docs/perf_gate.md):
     (parallel/orthogonal/antiparallel/zero-norm) plus a two-slice
     convergence loop — adasum at 2× tracks the base-batch sum
     trajectory while plain sum at 2× degrades — run twice and
-    required bit-identical (docs/adasum.md).
+    required bit-identical (docs/adasum.md);
+11. the **fleet smoke** (``serve/fleet_smoke.py``): the hvdfleet
+    story — 3-model weighted-fair enqueue → live weight refresh
+    mid-load (fingerprint-verified flip) → kill-replica →
+    autoscale-up → drain, seeded, run twice and required
+    bit-identical (docs/serving.md).
 
 The whole run is a tier-1 test with the same <30 s budget as the
 hvdlint self-run, so "CI passed" and "the analysis suite passed" are
@@ -194,13 +199,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         adasum_errors = [f"adasum-smoke crashed: "
                          f"{type(e).__name__}: {e}"]
 
+    # 11 — fleet smoke: the multi-tenant serving plane's weighted-fair
+    # enqueue → refresh-mid-load → kill → scale-up → drain loop,
+    # seeded and deterministic (sub-second, CPU-only)
+    try:
+        from horovod_tpu.serve.fleet_smoke import run_smoke as \
+            run_fleet_smoke
+
+        fleet_errors = run_fleet_smoke()
+    except Exception as e:          # noqa: BLE001 — a crash IS a failure
+        fleet_errors = [f"fleet-smoke crashed: "
+                        f"{type(e).__name__}: {e}"]
+
     elapsed = time.perf_counter() - t0
     gate_findings = gate.findings if gate is not None else []
     rc = 2 if (art_error or gate_error) else (
         1 if (lint.findings or art_findings or gate_findings
               or metrics_errors or guard_errors or serve_errors
               or plan_errors or degrade_errors or memory_errors
-              or calibration_errors or adasum_errors)
+              or calibration_errors or adasum_errors or fleet_errors)
         else 0)
 
     if args.json_out:
@@ -215,6 +232,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "memory_smoke_errors": memory_errors,
             "calibration_smoke_errors": calibration_errors,
             "adasum_smoke_errors": adasum_errors,
+            "fleet_smoke_errors": fleet_errors,
             "perf_gate": gate.as_json() if gate is not None else None,
             "errors": [e for e in (art_error, gate_error) if e],
             "elapsed_s": round(elapsed, 3),
@@ -242,6 +260,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"hvdci: calibration-smoke: {e}")
     for e in adasum_errors:
         print(f"hvdci: adasum-smoke: {e}")
+    for e in fleet_errors:
+        print(f"hvdci: fleet-smoke: {e}")
     for f in gate_findings:
         print(f.format())
     for err in (art_error, gate_error):
@@ -257,7 +277,8 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"degrade-smoke {len(degrade_errors)} · "
           f"memory-smoke {len(memory_errors)} · "
           f"calibration-smoke {len(calibration_errors)} · "
-          f"adasum-smoke {len(adasum_errors)} finding(s) "
+          f"adasum-smoke {len(adasum_errors)} · "
+          f"fleet-smoke {len(fleet_errors)} finding(s) "
           f"in {elapsed:.2f}s — {'FAIL' if rc else 'ok'}")
     return rc
 

@@ -62,6 +62,20 @@ SERVE_SERIES = frozenset({
     "hvd_serve_drains_total",
     "hvd_serve_drain_timeouts_total",
     "hvd_serve_scale_events_total",
+    # hvdfleet (ISSUE 20): tenancy / live refresh / closed-loop
+    # autoscale — serve/tenancy.py, serve/refresh.py, serve/autoscale.py
+    "hvd_serve_tenant_admitted_total",
+    "hvd_serve_tenant_shed_total",
+    "hvd_serve_tenant_picks_total",
+    "hvd_serve_tenant_share",
+    "hvd_serve_refresh_staged_total",
+    "hvd_serve_refresh_flips_total",
+    "hvd_serve_refresh_rollbacks_total",
+    "hvd_serve_refresh_superseded_total",
+    "hvd_serve_scale_ups_total",
+    "hvd_serve_scale_downs_total",
+    "hvd_serve_scale_suppressed_total",
+    "hvd_serve_scale_target",
 })
 
 # the elastic plane's closed series vocabulary (docs/elastic.md,

@@ -102,15 +102,26 @@ THROUGHPUT_FIELDS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
      ("moe_params_m", "plan", "moe_capacity_factor", "moe_ep",
       "reduction")),
     ("vit_img_sec_per_chip", ("vit_params_m", "plan", "reduction")),
-    ("serve_throughput_rps", ("serve_offered_rps", "plan")),
+    # model count + tenant-class mix guard the serving diff: a fleet
+    # artifact (3 tenants behind weighted-fair scheduling) measures a
+    # different arbitration/hot-swap schedule than a single-model one,
+    # never a regression; legacy single-model artifacts carry neither
+    # key and stay comparable with each other (None matches None)
+    ("serve_throughput_rps",
+     ("serve_offered_rps", "plan", "serve_models",
+      "serve_tenant_mix")),
 )
 
 #: latency (lower-is-better) fields and their comparability keys —
 #: PERF005 fails on *growth* beyond the throughput tolerance, so
 #: ``bench.py --serve`` tail latency is gateable like throughput
 LATENCY_FIELDS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
-    ("serve_p50_latency_s", ("serve_offered_rps", "plan")),
-    ("serve_p99_latency_s", ("serve_offered_rps", "plan")),
+    ("serve_p50_latency_s",
+     ("serve_offered_rps", "plan", "serve_models",
+      "serve_tenant_mix")),
+    ("serve_p99_latency_s",
+     ("serve_offered_rps", "plan", "serve_models",
+      "serve_tenant_mix")),
 )
 
 #: memory (lower-is-better) fields and their comparability keys —

@@ -42,6 +42,13 @@ Sites currently instrumented (grep ``faults.inject`` for ground truth):
                             models a wedged queue feeder
 ``serve.drain``             replica drain completion — ``raise``/``hang``
                             models a drain wedged past its grace window
+``serve.tenant``            each weighted-fair scheduler pick over the
+                            tenant queues (serve/tenancy.py)
+``serve.refresh``           each live weight-flip attempt — ``corrupt``
+                            tampers the staged tree and must be caught by
+                            the fingerprint verify (rollback path)
+``serve.scale``             each autoscale controller poll
+                            (serve/autoscale.py)
 ``degrade.resolve``         each degraded-plan resolution verdict
                             (elastic/degrade.py)
 ``degrade.reshard``         degrade-transition reshard restore, before any

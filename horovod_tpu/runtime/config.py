@@ -93,6 +93,12 @@ KNOWN_KNOBS = frozenset({
     "HOROVOD_SERVE_QUEUE_DEPTH", "HOROVOD_SERVE_MAX_REQUEUES",
     "HOROVOD_SERVE_MAX_BATCH", "HOROVOD_SERVE_DRAIN_TIMEOUT_S",
     "HOROVOD_SERVE_SCALE_UP_DEPTH", "HOROVOD_SERVE_SCALE_DOWN_DEPTH",
+    # -- hvdfleet: tenancy, live weight refresh, closed-loop autoscale
+    #    (serve/tenancy.py, serve/refresh.py, serve/autoscale.py)
+    "HOROVOD_SERVE_OVERLOAD_FRACTION", "HOROVOD_SERVE_REFRESH_VERIFY",
+    "HOROVOD_SERVE_SCALE_HOLD_S", "HOROVOD_SERVE_SCALE_COOLDOWN_S",
+    "HOROVOD_SERVE_SCALE_MIN_REPLICAS",
+    "HOROVOD_SERVE_SCALE_MAX_REPLICAS",
     # -- perf regression gate (analysis/perf_gate.py, docs/perf_gate.md)
     "HOROVOD_PERF_GATE_TOLERANCE", "HOROVOD_PERF_GATE_OVERLAP_TOLERANCE",
     "HOROVOD_PERF_GATE_WIRE_TOLERANCE",

@@ -21,6 +21,7 @@ there models a wedged queue feeder (docs/faults.md).
 
 from __future__ import annotations
 
+import inspect
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -40,22 +41,35 @@ _TEL_OCCUPANCY = telemetry.histogram(
 
 
 class ExecutableCache:
-    """Executable hot-swap keyed by ``(signature, padded batch size)``.
+    """Executable hot-swap keyed by ``(model_id, signature, padded
+    batch size)``.
 
     ``build(signature, padded_size) -> executor`` is invoked once per
-    key; use :meth:`from_jitted` to route it through
+    key (a builder taking a third ``model_id`` argument receives it —
+    the fleet shape, one AOT executable set per tenant model); use
+    :meth:`from_jitted` to route it through
     ``compile_cache.aot_compile`` so warm starts deserialize instead of
     recompiling.  Short batches are padded up to the next bucket (by
     repeating the tail payload) and the results truncated, so the
     executable set stays small and every size hits a cached entry.
+    ``model_id=None`` keys the single-model plane of PR 12 — its
+    entries never collide with a named tenant's.
     """
 
-    def __init__(self, build: Callable[[Tuple, int], Callable],
+    def __init__(self, build: Callable[..., Callable],
                  bucket_sizes: Sequence[int] = DEFAULT_BUCKET_SIZES):
         self._build = build
+        try:
+            params = inspect.signature(build).parameters
+            self._build_takes_model = len(params) >= 3 or any(
+                p.kind == inspect.Parameter.VAR_POSITIONAL
+                for p in params.values())
+        except (TypeError, ValueError):    # builtins, C callables
+            self._build_takes_model = False
         self.bucket_sizes = tuple(sorted(bucket_sizes))
         self._lock = threading.Lock()
-        self._cache: Dict[Tuple[Tuple, int], Callable] = {}
+        self._cache: Dict[Tuple[Optional[str], Tuple, int],
+                          Callable] = {}
 
     @classmethod
     def from_jitted(cls, jitted, example_batch: Callable[[Tuple, int], Any],
@@ -81,24 +95,42 @@ class ExecutableCache:
                 return b
         return n
 
-    def get(self, signature: Tuple, n: int) -> Callable:
-        key = (signature, self.padded_size(n))
+    def get(self, signature: Tuple, n: int,
+            model_id: Optional[str] = None) -> Callable:
+        key = (model_id, signature, self.padded_size(n))
         with self._lock:
             ex = self._cache.get(key)
         if ex is None:
-            built = self._build(*key)
+            if self._build_takes_model and model_id is not None:
+                built = self._build(signature, key[2], model_id)
+            else:
+                built = self._build(signature, key[2])
             with self._lock:
                 ex = self._cache.setdefault(key, built)
         return ex
 
-    def run(self, payloads: Sequence[Any]) -> List[Any]:
+    def run(self, payloads: Sequence[Any],
+            model_id: Optional[str] = None, **kwargs) -> List[Any]:
         """Replica-executor entry point: pad to the bucket, execute,
-        truncate — shaped to plug straight into ``Replica(executor=)``."""
+        truncate — shaped to plug straight into ``Replica(executor=)``
+        (extra replica keywords like ``weights`` pass through to the
+        built executor when it accepts them, and are dropped when it
+        does not — a weight-less executable set stays valid)."""
         payloads = list(payloads)
         signature = payload_signature(payloads[0])
         padded = self.padded_size(len(payloads))
-        ex = self.get(signature, len(payloads))
+        ex = self.get(signature, len(payloads), model_id=model_id)
         full = payloads + [payloads[-1]] * (padded - len(payloads))
+        if kwargs:
+            try:
+                accepts = any(
+                    p.kind == inspect.Parameter.VAR_KEYWORD
+                    or p.name in kwargs
+                    for p in inspect.signature(ex).parameters.values())
+            except (TypeError, ValueError):
+                accepts = False
+            if accepts:
+                return list(ex(full, **kwargs))[:len(payloads)]
         return list(ex(full))[:len(payloads)]
 
     def __len__(self) -> int:

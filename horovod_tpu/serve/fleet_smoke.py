@@ -1,0 +1,201 @@
+"""Seeded fleet-chaos smoke for ``hvdci`` (analysis/ci.py gate 11).
+
+A sub-second, CPU-only, logical-clock run of the hvdfleet story end to
+end: three tenant models (weights 4/2/1 across the three SLO classes)
+admit a seeded open-loop stream through the weighted-fair scheduler; a
+live weight refresh for the heavy tenant stages mid-load and flips
+atomically between batches (responses before the flip carry the old
+fingerprint, responses after it the new one — never a mix inside one
+batch); a seeded ``serve.batch`` crash kills a replica mid-load and
+its lease re-enqueues exactly once; the autoscale controller sees the
+death plus the deep queue and acquires a replacement (scale-up); the
+stream completes with zero lost and zero duplicated responses and the
+survivors drain gracefully — twice, so determinism itself is gated.
+
+Returns error strings (empty = pass) in the same idiom as
+``serve.smoke`` so ci.py folds it straight into its exit code.
+Budget: well under a second — pure numpy payloads, a logical clock the
+fake executor advances, ~30 requests, no offload engine (the engine
+path is covered by tests/test_serve_fleet.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from horovod_tpu import faults
+from horovod_tpu.faults import FaultPlan
+from horovod_tpu.serve.autoscale import AutoscaleController
+from horovod_tpu.serve.pool import ReplicaPool
+from horovod_tpu.serve.queue import ADMITTED
+from horovod_tpu.serve.refresh import WeightRefresher
+from horovod_tpu.serve.replica import Replica
+from horovod_tpu.serve.request import InferenceRequest
+from horovod_tpu.serve.tenancy import FleetBatcher, MultiTenantQueue
+
+SEED = 20240
+N_REQUESTS = 30
+MAX_BATCH = 4
+CRASH_AT = 4       # fourth serve.batch hit → kill mid-load
+REFRESH_AT = 12    # stage the m0 weight swap after this many submits
+MAX_STEPS = 300    # engine-loop runaway guard
+
+MODELS = (("m0", 4.0, "interactive"), ("m1", 2.0, "standard"),
+          ("m2", 1.0, "batch"))
+
+
+def _scenario() -> Dict[str, Any]:
+    plan = FaultPlan(seed=SEED, sim=True).add(
+        "serve.batch", "crash", at=CRASH_AT)
+    faults.set_plan(plan)
+    try:
+        now = [0.0]
+
+        def clock() -> float:
+            return now[0]
+
+        def executor(payloads, model_id=None, weights=None):
+            # service time is a pure function of occupancy, result a
+            # pure function of payload + weights → bit-identical runs
+            now[0] += 0.004 + 0.001 * len(payloads)
+            w = float(np.asarray(weights).sum())
+            return [round(float(np.asarray(p).sum()) + w, 6)
+                    for p in payloads]
+
+        fleet = MultiTenantQueue(clock=clock)
+        for model_id, weight, slo in MODELS:
+            fleet.add_model(model_id, weight=weight, slo_class=slo,
+                            depth=32)
+
+        refresher = WeightRefresher(clock=clock)
+        fps = {m: refresher.register(
+            m, np.full(4, i + 1.0, np.float32))
+            for i, (m, _, _) in enumerate(MODELS)}
+
+        pool = ReplicaPool(fleet, drain_timeout_s=1.0,
+                           scale_up_depth=6, scale_down_depth=0,
+                           scale_hold_s=0.05, clock=clock)
+        for i in range(2):
+            pool.add_replica(Replica(f"r{i}", executor,
+                                     host=f"fleet-host-{i}",
+                                     clock=clock))
+
+        got: Dict[str, List[Any]] = {}
+        batcher = FleetBatcher(
+            fleet, pool, refresher=refresher, max_batch=MAX_BATCH,
+            clock=clock,
+            on_response=lambda r: got.setdefault(
+                r.request_id, []).append(
+                    (r.model_id, r.weights_fp, r.result, r.requeues)))
+
+        names = [0]
+
+        def acquire() -> Replica:
+            names[0] += 1
+            return Replica(f"scale-{names[0]}", executor,
+                           host=f"fleet-scale-{names[0]}", clock=clock)
+
+        controller = AutoscaleController(
+            pool, acquire, cooldown_s=0.05, min_replicas=1,
+            max_replicas=4, clock=clock)
+
+        rng = np.random.RandomState(SEED)
+        new_fp = None
+        admitted: List[str] = []
+        for i in range(N_REQUESTS):
+            model_id = MODELS[i % len(MODELS)][0]
+            req = InferenceRequest(
+                request_id=f"req-{i:03d}",
+                payload=rng.rand(4).astype(np.float32),
+                model_id=model_id, deadline_s=now[0] + 10.0)
+            if fleet.submit(req) == ADMITTED:
+                admitted.append(req.request_id)
+            if i == REFRESH_AT:
+                # the live weight swap, staged mid-load: the flip
+                # itself waits for the next between-batches window
+                refresher.stage("m0",
+                                np.full(4, 9.0, np.float32))
+            if i % 2:
+                batcher.step()   # interleave so pre-flip batches run
+            now[0] += 0.001      # open-loop: arrivals march on
+
+        steps = 0
+        while len(fleet) and steps < MAX_STEPS:
+            batcher.step()
+            controller.poll()
+            steps += 1
+            if pool.serving_count() == 0:
+                break
+
+        drains = [pool.drain(r) for r in pool.replicas() if r.alive]
+        new_fp = refresher.fingerprint_of("m0")
+        m0_fps = [fp for rs in got.values() for m, fp, _, _ in [rs[0]]
+                  if m == "m0"]
+        return {
+            "admitted": admitted,
+            "responses": sorted((rid, tuple(rs))
+                                for rid, rs in got.items()),
+            "requeued_ids": sorted(rid for rid, rs in got.items()
+                                   if any(r[3] > 0 for r in rs)),
+            "flips": refresher.flips,
+            "rollbacks": refresher.rollbacks,
+            "old_fp_m0": fps["m0"],
+            "new_fp_m0": new_fp,
+            "m0_fp_mix": sorted(set(m0_fps)),
+            "scale_ups": controller.scale_ups,
+            "deaths": pool.deaths,
+            "picks": dict(fleet.pick_counts),
+            "drains": drains,
+            "steps": steps,
+            "clock": round(now[0], 6),
+        }
+    finally:
+        faults.clear_plan()
+
+
+def run_smoke() -> List[str]:
+    """Run the seeded fleet-chaos scenario twice; returns a list of
+    error strings (empty = pass)."""
+    errors: List[str] = []
+    r1 = _scenario()
+    r2 = _scenario()
+    responded = {rid for rid, _ in r1["responses"]}
+    lost = sorted(set(r1["admitted"]) - responded)
+    if lost:
+        errors.append(f"fleet-smoke: {len(lost)} admitted request(s) "
+                      f"lost ({lost[:3]}...)")
+    dupes = sorted(rid for rid, rs in r1["responses"] if len(rs) != 1)
+    if dupes:
+        errors.append(f"fleet-smoke: duplicated responses for "
+                      f"{dupes[:3]}")
+    if not r1["requeued_ids"]:
+        errors.append("fleet-smoke: crash fired but no request was "
+                      "re-executed (requeue path untested)")
+    if r1["deaths"] != 1:
+        errors.append(f"fleet-smoke: expected exactly 1 replica death, "
+                      f"saw {r1['deaths']}")
+    if r1["flips"] != 1 or r1["rollbacks"] != 0:
+        errors.append(f"fleet-smoke: expected 1 clean flip, saw "
+                      f"flips={r1['flips']} "
+                      f"rollbacks={r1['rollbacks']}")
+    if r1["new_fp_m0"] == r1["old_fp_m0"]:
+        errors.append("fleet-smoke: refresh flipped but the active "
+                      "fingerprint did not change")
+    want_mix = sorted({r1["old_fp_m0"], r1["new_fp_m0"]})
+    if r1["m0_fp_mix"] != want_mix:
+        errors.append(f"fleet-smoke: m0 responses carried fps "
+                      f"{r1['m0_fp_mix']}, expected pre-flip + "
+                      f"post-flip {want_mix}")
+    if r1["scale_ups"] < 1:
+        errors.append("fleet-smoke: replica killed under load but the "
+                      "autoscale loop never acquired a replacement")
+    if min(r1["picks"].values()) < 1:
+        errors.append(f"fleet-smoke: a tenant was starved of scheduler "
+                      f"picks entirely: {r1['picks']}")
+    if not all(r1["drains"]):
+        errors.append("fleet-smoke: survivor drain was not graceful")
+    if r1 != r2:
+        errors.append("fleet-smoke: two seeded runs were not identical")
+    return errors

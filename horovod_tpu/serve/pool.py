@@ -18,9 +18,15 @@ exactly-once transitions (docs/serving.md):
   back to the dead path;
 * **scale signals** — queue depth against
   ``HOROVOD_SERVE_SCALE_UP_DEPTH`` / ``HOROVOD_SERVE_SCALE_DOWN_DEPTH``
-  yields +1/0/−1 deltas the elastic driver's discovery plane acts on
-  (a deep queue asks for a replica, an idle pool releases one through
-  the same graceful drain).
+  yields +1/0/−1 deltas the :class:`~horovod_tpu.serve.autoscale.
+  AutoscaleController` closes into actual acquire/release actions (a
+  deep queue asks for a replica, an idle pool releases one through the
+  same graceful drain).  The signal source carries its own hysteresis
+  (``HOROVOD_SERVE_SCALE_HOLD_S``): after a nonzero signal, the
+  *opposite* direction is suppressed for the hold window, so a queue
+  depth oscillating across a threshold cannot emit alternating ±1
+  every poll — flap damping belongs at the sensor too, not only in
+  the controller's cooldown.
 
 Every lifecycle transition lands in the ``hvd_serve_*`` registry
 (closed vocabulary: ``analysis/metrics_schema.py SERVE_SERIES``).
@@ -42,6 +48,7 @@ from horovod_tpu.utils import logging as hvd_logging
 DEFAULT_DRAIN_TIMEOUT_S = 30.0
 DEFAULT_SCALE_UP_DEPTH = 32
 DEFAULT_SCALE_DOWN_DEPTH = 2
+DEFAULT_SCALE_HOLD_S = 5.0
 
 _TEL_REPLICAS = telemetry.gauge(
     "hvd_serve_replicas", "replicas currently able to take batches")
@@ -57,6 +64,10 @@ _TEL_DRAIN_TIMEOUTS = telemetry.counter(
 _TEL_SCALE = telemetry.counter(
     "hvd_serve_scale_events_total",
     "scale signals emitted (direction=up|down)")
+_TEL_SCALE_SUPPRESSED = telemetry.counter(
+    "hvd_serve_scale_suppressed_total",
+    "scale signals swallowed by source hysteresis "
+    "(HOROVOD_SERVE_SCALE_HOLD_S)")
 _TEL_LATENCY = telemetry.histogram(
     "hvd_serve_latency_seconds",
     "request latency, admission to response")
@@ -91,6 +102,7 @@ class ReplicaPool:
                  drain_timeout_s: Optional[float] = None,
                  scale_up_depth: Optional[int] = None,
                  scale_down_depth: Optional[int] = None,
+                 scale_hold_s: Optional[float] = None,
                  clock: Callable[[], float] = time.monotonic):
         self._queue = queue
         self._bridge = bridge or ElasticServeBridge()
@@ -106,11 +118,20 @@ class ReplicaPool:
             if scale_down_depth is not None \
             else _env_int("HOROVOD_SERVE_SCALE_DOWN_DEPTH",
                           DEFAULT_SCALE_DOWN_DEPTH)
+        self.scale_hold_s = scale_hold_s if scale_hold_s is not None \
+            else _env_float("HOROVOD_SERVE_SCALE_HOLD_S",
+                            DEFAULT_SCALE_HOLD_S)
         self._clock = clock
         self._lock = threading.Lock()
         self._replicas: List[Replica] = []
         self._leases: Dict[str, List[InferenceRequest]] = {}
         self._rr = 0
+        #: replica deaths observed so far — the autoscale controller
+        #: diffs this to treat a chaos kill as lost capacity (a killed
+        #: replica both requeues its lease AND feeds the scale loop)
+        self.deaths = 0
+        self._last_signal = 0
+        self._last_signal_t = float("-inf")
 
     # -- membership ---------------------------------------------------------
 
@@ -145,16 +166,31 @@ class ReplicaPool:
     # -- execution ----------------------------------------------------------
 
     def execute(self, replica: Replica,
-                reqs: List[InferenceRequest]) -> List[InferenceResponse]:
+                reqs: List[InferenceRequest],
+                model_id: Optional[str] = None,
+                weights=None,
+                weights_fp: Optional[int] = None
+                ) -> List[InferenceResponse]:
         """Run one leased batch.  Success completes every id; a crash
         (``WorkerCrash`` or executor error) marks the replica dead and
-        re-enqueues the lease exactly once."""
+        re-enqueues the lease exactly once.
+
+        Fleet callers (serve/tenancy.py FleetBatcher) pass the leased
+        batch's ``model_id`` plus the weights buffer + fingerprint
+        snapshotted *once* before this call — every request in the
+        batch runs against that single snapshot (never mixed weights)
+        and every response carries its fingerprint."""
         if not reqs:
             return []
         with self._lock:
             self._leases[replica.name] = list(reqs)
         try:
-            results = replica.run_batch([r.payload for r in reqs])
+            if model_id is None:
+                results = replica.run_batch([r.payload for r in reqs])
+            else:
+                results = replica.run_batch(
+                    [r.payload for r in reqs], model_id=model_id,
+                    weights=weights)
         except (faults.WorkerCrash, Exception) as e:  # noqa: BLE001
             self.mark_dead(replica, reason=f"{type(e).__name__}: {e}")
             return []
@@ -169,7 +205,8 @@ class ReplicaPool:
             responses.append(InferenceResponse(
                 request_id=req.request_id, result=result,
                 replica=replica.name, latency_s=latency,
-                requeues=req.requeues))
+                requeues=req.requeues, model_id=model_id or "",
+                weights_fp=weights_fp))
         return responses
 
     def mark_dead(self, replica: Replica, reason: str = "") -> int:
@@ -184,6 +221,8 @@ class ReplicaPool:
             _TEL_REPLICAS.set(self._serving_count_locked())
         if already_dead and not lease:
             return 0
+        with self._lock:
+            self.deaths += 1
         _TEL_DEATHS.inc()
         requeued = self._queue.requeue(lease)
         hvd_logging.warning(
@@ -254,14 +293,30 @@ class ReplicaPool:
 
     def scale_signal(self) -> int:
         """+1 (add a replica), −1 (drain one), or 0 — queue depth vs
-        the scale thresholds.  The elastic driver's discovery plane is
-        the actuator; this is the sensor."""
+        the scale thresholds.  The autoscale controller (or the elastic
+        driver's discovery plane) is the actuator; this is the sensor.
+
+        Source hysteresis: after a nonzero signal, the *opposite*
+        direction is suppressed (0, counted on
+        ``hvd_serve_scale_suppressed_total``) until ``scale_hold_s``
+        elapses — a depth flapping across ``scale_up_depth`` emits one
+        +1 and then silence, not an alternating ±1 train."""
         depth = len(self._queue)
         serving = self.serving_count()
+        raw = 0
         if depth >= self.scale_up_depth:
-            _TEL_SCALE.inc(direction="up")
-            return 1
-        if depth <= self.scale_down_depth and serving > 1:
-            _TEL_SCALE.inc(direction="down")
-            return -1
-        return 0
+            raw = 1
+        elif depth <= self.scale_down_depth and serving > 1:
+            raw = -1
+        if raw == 0:
+            return 0
+        now = self._clock()
+        with self._lock:
+            if raw == -self._last_signal and \
+                    now < self._last_signal_t + self.scale_hold_s:
+                _TEL_SCALE_SUPPRESSED.inc()
+                return 0
+            self._last_signal = raw
+            self._last_signal_t = now
+        _TEL_SCALE.inc(direction="up" if raw > 0 else "down")
+        return raw

@@ -46,6 +46,10 @@ SHED_FULL = "shed_full"
 SHED_DEADLINE = "shed_deadline"
 SHED_DUPLICATE = "shed_duplicate"
 SHED_REQUEUE_BUDGET = "shed_requeue_budget"
+#: emitted by the tenancy layer (serve/tenancy.py), not this queue: a
+#: sheddable SLO class rejected while the fleet is past its overload
+#: watermark — counted on ``hvd_serve_tenant_shed_total``
+SHED_OVERLOAD = "shed_overload"
 
 _TEL_DEPTH = telemetry.gauge(
     "hvd_serve_queue_depth", "requests waiting for a batch slot")
@@ -68,7 +72,8 @@ class AdmissionQueue:
 
     def __init__(self, depth: Optional[int] = None,
                  max_requeues: Optional[int] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 service_est_s: Optional[float] = None):
         self.depth = depth if depth is not None \
             else _env_int("HOROVOD_SERVE_QUEUE_DEPTH", DEFAULT_QUEUE_DEPTH)
         self.max_requeues = max_requeues if max_requeues is not None \
@@ -80,8 +85,13 @@ class AdmissionQueue:
         self._state: Dict[str, str] = {}
         # EWMA of observed batch service time — the admission
         # controller's "could this run in time if it ran right now"
-        # estimate; fed back by the batcher after every batch
-        self._service_est_s = 0.0
+        # estimate; fed back by the batcher after every batch.  Seed it
+        # (``service_est_s``, typically the cost model's plan_cost_s
+        # for the model's plan — serve/tenancy.py does) so the FIRST
+        # wave of deadline-tiered requests is judged against a real
+        # estimate instead of the unseeded zero that admitted
+        # guaranteed-late work until the first batch completed.
+        self._service_est_s = float(service_est_s or 0.0)
         self._admitting = True
 
     # -- admission ----------------------------------------------------------
@@ -191,6 +201,12 @@ class AdmissionQueue:
         with self._lock:
             self._service_est_s = service_s if not self._service_est_s \
                 else 0.8 * self._service_est_s + 0.2 * service_s
+
+    @property
+    def service_estimate_s(self) -> float:
+        """Current EWMA batch-service estimate (seeded or observed)."""
+        with self._lock:
+            return self._service_est_s
 
     def __len__(self) -> int:
         with self._lock:

@@ -26,7 +26,7 @@ the grace window, which must fall back to the dead path.
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, List, Sequence
+from typing import Any, Callable, List, Optional, Sequence
 
 from horovod_tpu import faults, telemetry
 
@@ -65,13 +65,26 @@ class Replica:
     def alive(self) -> bool:
         return self.state in (SERVING, DRAINING)
 
-    def run_batch(self, payloads: Sequence[Any]) -> List[Any]:
+    def run_batch(self, payloads: Sequence[Any],
+                  model_id: Optional[str] = None,
+                  weights: Any = None) -> List[Any]:
         """Execute one packed batch.  The ``serve.batch`` fault site
         fires first: a sim ``crash`` here raises
         :class:`~horovod_tpu.faults.WorkerCrash` mid-batch, which the
-        pool converts into the dead path (requeue the lease)."""
+        pool converts into the dead path (requeue the lease).
+
+        Fleet callers pass ``model_id`` (the executable hot-swap key —
+        serve/batcher.py ExecutableCache) and ``weights`` (the param
+        buffer snapshotted once for the whole batch by the refresher's
+        atomic flip discipline — serve/refresh.py); both are forwarded
+        to the executor as keywords.  Single-model callers keep the
+        bare ``executor(payloads)`` contract of PR 12."""
         faults.inject("serve.batch")
-        results = self.executor(payloads)
+        if model_id is None:
+            results = self.executor(payloads)
+        else:
+            results = self.executor(payloads, model_id=model_id,
+                                    weights=weights)
         self.batches += 1
         _TEL_BATCHES.inc(replica=self.name)
         return results

@@ -11,6 +11,14 @@ The **signature** is the batch-compatibility key: requests sharing a
 signature (same input shape/dtype, same model entry point) may be
 packed into one executable call by the continuous batcher.  Use
 :func:`payload_signature` for array-like payloads.
+
+Under the fleet model (serve/tenancy.py) a request also names the
+**model** it targets — the tenancy layer routes it to that model's
+admission queue and the batcher hot-swaps the model's executable per
+leased batch — and every response carries the **weights fingerprint**
+(guard/checksum.py) of the exact parameter buffer that produced it,
+so weight freshness after a live refresh (serve/refresh.py) is
+verifiable end to end.
 """
 
 from __future__ import annotations
@@ -51,6 +59,9 @@ class InferenceRequest:
     arrival_s: float = 0.0
     deadline_s: float = 0.0
     requeues: int = 0
+    #: fleet routing key — which model's admission queue this request
+    #: belongs to ("" = the single-model plane of PR 12)
+    model_id: str = ""
 
     def __post_init__(self) -> None:
         if not self.signature:
@@ -68,6 +79,11 @@ class InferenceResponse:
     latency_s: float = 0.0
     requeues: int = 0
     error: Optional[str] = None
+    #: fleet provenance: the model that served it and the fingerprint
+    #: of the weights buffer the batch ran against (None on the
+    #: single-model plane or when no refresher is wired)
+    model_id: str = ""
+    weights_fp: Optional[int] = None
 
     @property
     def ok(self) -> bool:

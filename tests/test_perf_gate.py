@@ -276,6 +276,51 @@ class TestReductionComparability:
                                         PG.Tolerances())] == ["PERF001"]
 
 
+class TestServeFleetComparability:
+    """ISSUE 20 satellite: serve_models + serve_tenant_mix are
+    comparability keys on every serve field — a 3-tenant fleet run
+    measures a different arbitration/hot-swap schedule than a
+    single-model run, so rate/tail shifts across that switch are never
+    PERF001/PERF005; legacy artifacts without the keys keep gating
+    each other (None matches None)."""
+
+    def _art(self, name, rps, p99, models=None, mix=None):
+        parsed = {"metric": "serve", "serve_offered_rps": 400.0,
+                  "serve_throughput_rps": rps,
+                  "serve_p99_latency_s": p99}
+        if models is not None:
+            parsed["serve_models"] = models
+            parsed["serve_tenant_mix"] = mix
+        return PG._validate(name, parsed)
+
+    def test_fleet_switch_not_diffed(self):
+        base = self._art("base", 380.0, 0.012)
+        fleet = self._art("fleet", 150.0, 0.05, models=3,
+                          mix="batch:1|interactive:1|standard:1")
+        # single-model (legacy, no keys) vs fleet: different experiment
+        assert PG.diff([base], fleet, PG.Tolerances()) == []
+        # a different tenant mix at the same model count: also guarded
+        other_mix = self._art("other", 300.0, 0.02, models=3,
+                              mix="interactive:3")
+        assert PG.diff([fleet], other_mix, PG.Tolerances()) == []
+
+    def test_same_fleet_shape_still_gates(self):
+        fleet = self._art("fleet", 300.0, 0.02, models=3,
+                          mix="batch:1|interactive:1|standard:1")
+        slow = self._art("slow", 100.0, 0.09, models=3,
+                         mix="batch:1|interactive:1|standard:1")
+        rules = {f.rule for f in PG.diff([fleet], slow,
+                                         PG.Tolerances())}
+        assert rules == {"PERF001", "PERF005"}
+
+    def test_legacy_serve_artifacts_still_gate(self):
+        base = self._art("base", 380.0, 0.012)
+        slow = self._art("slow", 150.0, 0.05)
+        rules = {f.rule for f in PG.diff([base], slow,
+                                         PG.Tolerances())}
+        assert rules == {"PERF001", "PERF005"}
+
+
 class TestMoeComparability:
     def test_moe_routing_config_guards_the_diff(self):
         """ISSUE 16 satellite: capacity_factor and the ep extent are

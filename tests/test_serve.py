@@ -341,8 +341,10 @@ class TestReplicaPool:
         assert all(r.state == DEPARTED for r in pool.replicas())
 
     def test_scale_signal_thresholds(self):
+        # scale_hold_s=0: the raw thresholds, no source hysteresis
+        # (tests/test_serve_fleet.py pins the hold-window behavior)
         q, pool, _ = make_plane(n_replicas=2, scale_up_depth=4,
-                                scale_down_depth=1)
+                                scale_down_depth=1, scale_hold_s=0.0)
         for i in range(4):
             q.submit(req(f"r{i}"))
         assert pool.scale_signal() == 1         # deep queue: add one

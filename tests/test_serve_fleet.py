@@ -1,0 +1,685 @@
+"""hvdfleet (ISSUE 20, docs/serving.md): multi-tenant admission with
+weighted-fair scheduling and SLO-classed overload shedding, live
+weight refresh with fingerprint-verified atomic flips, and the
+closed-loop autoscale controller — all on fake clocks, fully
+deterministic."""
+
+import math
+
+import numpy as np
+import pytest
+
+from horovod_tpu import faults
+from horovod_tpu.analysis.cost_model import plan_cost_s
+from horovod_tpu.serve import (
+    ADMITTED,
+    SHED_DEADLINE,
+    SHED_OVERLOAD,
+    AutoscaleController,
+    ExecutableCache,
+    FleetBatcher,
+    InferenceRequest,
+    MultiTenantQueue,
+    Replica,
+    ReplicaPool,
+    SLO_CLASSES,
+    WeightRefresher,
+)
+from horovod_tpu.serve.request import DONE, QUEUED
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan():
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def req(rid, model="m0", payload=1, deadline=1000.0, **kw):
+    return InferenceRequest(request_id=rid, payload=payload,
+                            model_id=model, deadline_s=deadline, **kw)
+
+
+def fleet_executor(payloads, model_id=None, weights=None):
+    return list(payloads)
+
+
+def make_fleet(models=(("m0", 4.0, "interactive"),
+                       ("m1", 2.0, "standard"),
+                       ("m2", 1.0, "batch")),
+               n_replicas=2, clk=None, depth=64, executor=None,
+               refresher=None, **pool_kw):
+    clk = clk or Clock()
+    fleet = MultiTenantQueue(clock=clk)
+    for model_id, weight, slo in models:
+        fleet.add_model(model_id, weight=weight, slo_class=slo,
+                        depth=depth)
+    pool_kw.setdefault("drain_timeout_s", 10.0)
+    pool_kw.setdefault("scale_up_depth", 8)
+    pool_kw.setdefault("scale_down_depth", 1)
+    pool_kw.setdefault("scale_hold_s", 0.0)
+    pool = ReplicaPool(fleet, clock=clk, **pool_kw)
+    executor = executor or fleet_executor
+    for i in range(n_replicas):
+        pool.add_replica(Replica(f"r{i}", executor, host=f"h{i}",
+                                 clock=clk))
+    batcher = FleetBatcher(fleet, pool, refresher=refresher,
+                           max_batch=4, clock=clk)
+    return fleet, pool, batcher, clk
+
+
+class TestSLOClasses:
+    def test_class_table_pinned(self):
+        """Tier 0 must stay the strictest deadline AND the last to
+        shed, or overload starves exactly the protected traffic."""
+        assert SLO_CLASSES["interactive"].shed_tier == 0
+        assert SLO_CLASSES["standard"].shed_tier == 1
+        assert SLO_CLASSES["batch"].shed_tier == 2
+        assert SLO_CLASSES["interactive"].deadline_budget_s == 0.25
+        assert SLO_CLASSES["standard"].deadline_budget_s == 2.0
+        assert SLO_CLASSES["batch"].deadline_budget_s == 0.0
+
+    def test_class_budget_applied_when_request_has_no_deadline(self):
+        clk = Clock(100.0)
+        fleet = MultiTenantQueue(clock=clk)
+        fleet.add_model("m0", slo_class="interactive", depth=8)
+        r = req("r1", deadline=0.0)
+        assert fleet.submit(r) == ADMITTED
+        assert r.deadline_s == pytest.approx(100.25)
+
+    def test_explicit_deadline_wins_over_the_class_budget(self):
+        fleet = MultiTenantQueue(clock=Clock())
+        fleet.add_model("m0", slo_class="interactive", depth=8)
+        r = req("r1", deadline=42.0)
+        fleet.submit(r)
+        assert r.deadline_s == 42.0
+
+    def test_unknown_slo_class_and_bad_weight_rejected(self):
+        fleet = MultiTenantQueue(clock=Clock())
+        with pytest.raises(ValueError, match="unknown SLO class"):
+            fleet.add_model("m0", slo_class="platinum")
+        with pytest.raises(ValueError, match="weight"):
+            fleet.add_model("m0", weight=0.0)
+        fleet.add_model("m0")
+        with pytest.raises(ValueError, match="already registered"):
+            fleet.add_model("m0")
+
+
+class TestWeightedFair:
+    """The smooth-WRR discipline: share converges to w/W and a
+    backlogged tenant of weight w is picked at least once per
+    ceil(W/w) picks — the ISSUE 20 starvation bound."""
+
+    WEIGHTS = (("m0", 4.0), ("m1", 2.0), ("m2", 1.0))
+
+    def backlogged_fleet(self, n_per_model=80):
+        fleet = MultiTenantQueue(clock=Clock())
+        for m, w in self.WEIGHTS:
+            fleet.add_model(m, weight=w, slo_class="interactive",
+                            depth=n_per_model)
+        for i in range(n_per_model):
+            for m, _ in self.WEIGHTS:
+                assert fleet.submit(req(f"{m}-{i}", model=m)) == ADMITTED
+        return fleet
+
+    def test_share_tracks_weight_under_sustained_overload(self):
+        """Every tenant stays backlogged (the 2× overload shape: far
+        more queued than served) over 70 picks: shares land on
+        4/7, 2/7, 1/7 exactly — SWRR is deterministic, not just
+        convergent in expectation."""
+        fleet = self.backlogged_fleet(n_per_model=80)
+        n_picks = 70
+        for _ in range(n_picks):
+            winner, batch = fleet.take_model(1)
+            assert winner is not None and len(batch) == 1
+        total_w = sum(w for _, w in self.WEIGHTS)
+        for m, w in self.WEIGHTS:
+            assert fleet.pick_counts[m] == n_picks * w / total_w
+
+    def test_starvation_bound_ceil_w_over_w(self):
+        """The weight-1 tenant behind 4.0 and 2.0 neighbours waits at
+        most ceil(7/1) = 7 picks between wins, never forever."""
+        fleet = self.backlogged_fleet(n_per_model=80)
+        total_w = sum(w for _, w in self.WEIGHTS)
+        bound = math.ceil(total_w / 1.0)
+        winners = [fleet.take_model(1)[0] for _ in range(70)]
+        gaps, last = [], -1
+        for i, m in enumerate(winners):
+            if m == "m2":
+                gaps.append(i - last)
+                last = i
+        assert gaps and max(gaps) <= bound
+
+    def test_first_max_tie_breaks_on_registration_order(self):
+        fleet = MultiTenantQueue(clock=Clock())
+        fleet.add_model("a", weight=1.0, depth=8)
+        fleet.add_model("b", weight=1.0, depth=8)
+        fleet.submit(req("a-1", model="a"))
+        fleet.submit(req("b-1", model="b"))
+        assert fleet.take_model(1)[0] == "a"
+        assert fleet.take_model(1)[0] == "b"
+
+    def test_empty_fleet_returns_no_pick(self):
+        fleet = MultiTenantQueue(clock=Clock())
+        fleet.add_model("m0", depth=8)
+        assert fleet.take_model(4) == (None, [])
+
+    def test_only_backlogged_tenants_compete(self):
+        fleet = MultiTenantQueue(clock=Clock())
+        fleet.add_model("idle", weight=100.0, depth=8)
+        fleet.add_model("busy", weight=1.0, depth=8)
+        fleet.submit(req("b-1", model="busy"))
+        winner, batch = fleet.take_model(4)
+        assert winner == "busy"
+        assert [r.request_id for r in batch] == ["b-1"]
+
+
+class TestOverloadShedding:
+    """Graded SLO-tier shedding off the fleet fill factor: batch sheds
+    at the watermark (0.75), standard midway to full (0.875),
+    interactive never."""
+
+    def filled_fleet(self, per_queue):
+        clk = Clock()
+        fleet = MultiTenantQueue(clock=clk, overload_fraction=0.75)
+        for m, slo in (("mi", "interactive"), ("ms", "standard"),
+                       ("mb", "batch")):
+            fleet.add_model(m, slo_class=slo, depth=10)
+        # pre-fill through the per-model queues directly so the graded
+        # overload checks below see exactly the target fill factor
+        for m in ("mi", "ms", "mb"):
+            for i in range(per_queue):
+                assert fleet.queue_for(m).submit(
+                    req(f"{m}-{i}", model=m)) == ADMITTED
+        return fleet
+
+    def test_batch_sheds_at_the_watermark(self):
+        fleet = self.filled_fleet(per_queue=8)        # fill 0.8
+        assert fleet.submit(req("b-x", model="mb")) == SHED_OVERLOAD
+        assert fleet.submit(req("s-x", model="ms")) == ADMITTED
+        assert fleet.submit(req("i-x", model="mi")) == ADMITTED
+
+    def test_standard_sheds_midway_to_full(self):
+        fleet = self.filled_fleet(per_queue=9)        # fill 0.9
+        assert fleet.submit(req("s-x", model="ms")) == SHED_OVERLOAD
+        assert fleet.submit(req("i-x", model="mi")) == ADMITTED
+
+    def test_interactive_never_overload_shed(self):
+        fleet = self.filled_fleet(per_queue=10)       # fill 1.0
+        # its own queue being full is SHED_FULL territory, but the
+        # overload tier never fires for tier 0 — drain one slot and
+        # the interactive request lands even at fill ~0.97
+        fleet.queue_for("mi").take(1)
+        assert fleet.submit(req("i-x", model="mi")) == ADMITTED
+
+    def test_unknown_model_is_an_overload_verdict(self):
+        fleet = MultiTenantQueue(clock=Clock())
+        fleet.add_model("m0", depth=8)
+        assert fleet.submit(req("r1", model="nope")) == SHED_OVERLOAD
+
+
+class TestEwmaSeeding:
+    """ISSUE 20 satellite 1: the admission EWMA seeds from the cost
+    model's plan_cost_s, so the FIRST wave of deadline verdicts is
+    already load-aware."""
+
+    PLAN = "dp=4"
+    PAYLOAD = 4.0e9
+
+    def test_seed_matches_the_cost_model(self):
+        fleet = MultiTenantQueue(clock=Clock())
+        fleet.add_model("m0", plan=self.PLAN,
+                        payload_bytes=self.PAYLOAD, depth=8)
+        est = plan_cost_s(self.PLAN, self.PAYLOAD)
+        assert est > 0
+        assert fleet.queue_for("m0").service_estimate_s == \
+            pytest.approx(est)
+
+    def test_first_wave_deadline_verdicts_are_seeded(self):
+        """Before the first batch ever completes, a deadline tighter
+        than the priced batch time sheds at the front door — the
+        pre-fix behavior admitted it (estimate 0) and let it time out
+        in the queue."""
+        clk = Clock()
+        fleet = MultiTenantQueue(clock=clk)
+        fleet.add_model("m0", plan=self.PLAN,
+                        payload_bytes=self.PAYLOAD, depth=8)
+        est = plan_cost_s(self.PLAN, self.PAYLOAD)
+        assert fleet.submit(
+            req("tight", deadline=clk.t + est / 2)) == SHED_DEADLINE
+        assert fleet.submit(
+            req("ample", deadline=clk.t + est * 10)) == ADMITTED
+
+    def test_unseeded_model_still_free_admits_first_wave(self):
+        clk = Clock()
+        fleet = MultiTenantQueue(clock=clk)
+        fleet.add_model("m0", depth=8)
+        assert fleet.queue_for("m0").service_estimate_s == 0.0
+        assert fleet.submit(req("tight", deadline=clk.t + 1e-6)) \
+            == ADMITTED
+
+    def test_observed_service_time_folds_into_the_seed(self):
+        fleet = MultiTenantQueue(clock=Clock())
+        fleet.add_model("m0", plan=self.PLAN,
+                        payload_bytes=self.PAYLOAD, depth=8)
+        est = plan_cost_s(self.PLAN, self.PAYLOAD)
+        fleet.note_service_time(est * 2, "m0")
+        # EWMA-folded into the nonzero seed, not reset by it
+        assert fleet.queue_for("m0").service_estimate_s == \
+            pytest.approx(0.8 * est + 0.2 * est * 2)
+
+
+class TestExecutableCacheFleet:
+    """ISSUE 20 tentpole (a): the cache keys on (model_id, signature,
+    bucket) so the batcher hot-swaps per-tenant executables."""
+
+    def test_models_get_distinct_executables(self):
+        built = []
+
+        def build(signature, padded, model_id):
+            built.append((model_id, padded))
+            return lambda xs: [f"{model_id}:{x}" for x in xs]
+
+        cache = ExecutableCache(build, bucket_sizes=(1, 2, 4))
+        assert cache.run([1], model_id="m0") == ["m0:1"]
+        assert cache.run([1], model_id="m1") == ["m1:1"]
+        assert cache.run([2], model_id="m0") == ["m0:2"]   # cache hit
+        assert built == [("m0", 1), ("m1", 1)]
+        assert len(cache) == 2
+
+    def test_single_model_plane_keys_none(self):
+        built = []
+        cache = ExecutableCache(
+            lambda sig, n: built.append(n) or (lambda xs: list(xs)),
+            bucket_sizes=(1, 2))
+        cache.run([1])
+        cache.run([1], model_id="m0")    # named tenant: its own entry
+        assert len(cache) == 2
+
+    def test_weights_kwarg_forwarded_when_accepted(self):
+        cache = ExecutableCache(
+            lambda sig, n, model_id: (
+                lambda xs, weights=None: [x + weights for x in xs]),
+            bucket_sizes=(1,))
+        assert cache.run([1], model_id="m0", weights=10) == [11]
+
+    def test_weights_kwarg_dropped_for_weightless_executors(self):
+        cache = ExecutableCache(
+            lambda sig, n: (lambda xs: list(xs)), bucket_sizes=(1,))
+        assert cache.run([1], weights=10) == [1]
+
+
+class TestWeightRefresher:
+    def tree(self, v):
+        return {"w": np.full(4, v, np.float32)}
+
+    def test_register_and_active(self):
+        r = WeightRefresher(clock=Clock())
+        fp = r.register("m0", self.tree(1.0))
+        params, got_fp = r.active("m0")
+        assert got_fp == fp and params["w"][0] == 1.0
+        assert r.fingerprint_of("m0") == fp
+        assert r.active("nope") == (None, None)
+
+    def test_stage_then_flip_changes_the_fingerprint(self):
+        r = WeightRefresher(clock=Clock())
+        old_fp = r.register("m0", self.tree(1.0))
+        r.stage("m0", self.tree(2.0))
+        assert r.pending("m0")
+        # the flip waits for the between-batches window: active is
+        # still the old buffer until maybe_flip
+        assert r.fingerprint_of("m0") == old_fp
+        assert r.maybe_flip("m0") is True
+        assert not r.pending("m0")
+        assert r.fingerprint_of("m0") != old_fp
+        assert r.flips == 1 and r.rollbacks == 0
+        assert r.maybe_flip("m0") is False     # nothing pending now
+
+    def test_mismatch_rolls_back_and_quarantines(self):
+        quarantined = []
+        r = WeightRefresher(clock=Clock(),
+                            on_quarantine=lambda m, t:
+                            quarantined.append((m, t)))
+        old_fp = r.register("m0", self.tree(1.0))
+        r.stage("m0", self.tree(2.0), tag="ckpt-77",
+                expected_fp=0xDEAD)            # producer lied
+        assert r.maybe_flip("m0") is False
+        # old weights keep serving, the bad checkpoint is quarantined
+        assert r.fingerprint_of("m0") == old_fp
+        assert r.rollbacks == 1 and r.flips == 0
+        assert r.quarantined == [("m0", "ckpt-77")]
+        assert quarantined == [("m0", "ckpt-77")]
+
+    def test_chaos_corruption_caught_by_the_verify(self):
+        """serve.refresh 'corrupt' tampers the staged tree in transit;
+        the fingerprint verify must catch it and take the rollback
+        edge — the ISSUE 20 chaos proof, with zero requests shed."""
+        faults.set_plan(faults.FaultPlan(seed=7, sim=True).add(
+            "serve.refresh", "corrupt", at=1))
+        r = WeightRefresher(clock=Clock())
+        old_fp = r.register("m0", self.tree(1.0))
+        r.stage("m0", self.tree(2.0))
+        assert r.maybe_flip("m0") is False
+        assert r.fingerprint_of("m0") == old_fp
+        assert r.rollbacks == 1 and len(r.quarantined) == 1
+        # past the plan: the next stage flips clean
+        r.stage("m0", self.tree(3.0))
+        assert r.maybe_flip("m0") is True
+
+    def test_verify_disabled_trusts_the_producer(self):
+        faults.set_plan(faults.FaultPlan(seed=7, sim=True).add(
+            "serve.refresh", "corrupt", at=1))
+        r = WeightRefresher(verify=False, clock=Clock())
+        r.register("m0", self.tree(1.0))
+        r.stage("m0", self.tree(2.0))
+        assert r.maybe_flip("m0") is True      # trusted: no re-hash
+
+    def test_latest_wins_supersedes_the_pending_stage(self):
+        r = WeightRefresher(clock=Clock())
+        r.register("m0", self.tree(1.0))
+        r.stage("m0", self.tree(2.0))
+        r.stage("m0", self.tree(3.0))          # latest wins, whole
+        assert r.superseded == 1
+        assert r.maybe_flip("m0") is True
+        params, _ = r.active("m0")
+        assert params["w"][0] == 3.0
+        assert r.flips == 1
+
+
+class TestRefreshOnTheOffloadEngine:
+    """ISSUE 20 satellite 3: the refresh transfer rides the
+    HostOffloadEngine's double-buffered path and inherits its degrade
+    contract — a replica killed mid-H2D falls back to the retained
+    reference, no torn tree, no lost refresh."""
+
+    def test_stage_round_trips_through_the_engine(self):
+        from horovod_tpu.memory.offload import HostOffloadEngine
+
+        with HostOffloadEngine(name="refresh-test") as engine:
+            r = WeightRefresher(engine=engine, clock=Clock())
+            r.register("m0", {"w": np.full(4, 1.0, np.float32)})
+            r.stage("m0", {"w": np.full(4, 2.0, np.float32)})
+            assert r.maybe_flip("m0") is True
+            params, _ = r.active("m0")
+            np.testing.assert_array_equal(
+                np.asarray(params["w"]), np.full(4, 2.0, np.float32))
+
+    def test_kill_mid_h2d_degrades_to_the_retained_ref(self):
+        from horovod_tpu.memory.offload import HostOffloadEngine
+
+        faults.set_plan(faults.FaultPlan(sim=True).add(
+            "offload.h2d", "raise", "OSError", at=1))
+        with HostOffloadEngine(name="refresh-chaos") as engine:
+            r = WeightRefresher(engine=engine, clock=Clock())
+            r.register("m0", {"w": np.full(4, 1.0, np.float32)})
+            r.stage("m0", {"w": np.full(4, 2.0, np.float32)})
+            assert engine.fallbacks == 1       # the degrade fired
+            # the retained reference IS the staged tree, bit-identical:
+            # the fingerprint still matches and the flip commits —
+            # nothing torn, nothing lost
+            assert r.maybe_flip("m0") is True
+            params, _ = r.active("m0")
+            np.testing.assert_array_equal(
+                np.asarray(params["w"]), np.full(4, 2.0, np.float32))
+
+
+class TestFleetBatcher:
+    def test_responses_carry_model_and_fingerprint(self):
+        refresher = WeightRefresher(clock=Clock())
+        fp = refresher.register("m0", np.full(4, 1.0, np.float32))
+        fleet, pool, batcher, clk = make_fleet(
+            models=(("m0", 1.0, "standard"),), refresher=refresher)
+        fleet.submit(req("r1"))
+        (resp,) = batcher.step()
+        assert resp.model_id == "m0" and resp.weights_fp == fp
+
+    def test_flip_lands_between_batches_never_inside_one(self):
+        """Batch 1 runs whole on the old weights, batch 2 whole on the
+        new — every batch's responses carry ONE fingerprint."""
+        refresher = WeightRefresher(clock=Clock())
+        old_fp = refresher.register("m0", np.full(4, 1.0, np.float32))
+        fleet, pool, batcher, clk = make_fleet(
+            models=(("m0", 1.0, "standard"),), refresher=refresher)
+        for i in range(8):
+            fleet.submit(req(f"r{i}"))
+        first = batcher.step()                  # pre-flip batch
+        refresher.stage("m0", np.full(4, 2.0, np.float32))
+        second = batcher.step()                 # flips, then executes
+        new_fp = refresher.fingerprint_of("m0")
+        assert new_fp != old_fp
+        assert {r.weights_fp for r in first} == {old_fp}
+        assert {r.weights_fp for r in second} == {new_fp}
+
+    def test_swap_during_replica_drain_still_flips(self):
+        """ISSUE 20 satellite 3: the flip point is the batcher, not
+        the replica — a refresh staged while a replica drains commits
+        on the survivor's next batch."""
+        refresher = WeightRefresher(clock=Clock())
+        refresher.register("m0", np.full(4, 1.0, np.float32))
+        fleet, pool, batcher, clk = make_fleet(
+            models=(("m0", 1.0, "standard"),), n_replicas=2,
+            refresher=refresher)
+        assert pool.drain(pool.pick()) is True
+        refresher.stage("m0", np.full(4, 2.0, np.float32))
+        fleet.submit(req("r1"))
+        (resp,) = batcher.step()
+        assert refresher.flips == 1
+        assert resp.weights_fp == refresher.fingerprint_of("m0")
+        assert pool.serving_count() == 1
+
+    def test_crash_requeues_into_the_owning_model_queue(self):
+        """The exactly-once rule survives multi-tenancy: a dead
+        replica's lease re-admits into each request's owning queue,
+        once."""
+        fleet, pool, batcher, clk = make_fleet(n_replicas=2)
+        faults.set_plan(faults.FaultPlan(sim=True).add(
+            "serve.batch", "crash", at=1))
+        for i in range(3):
+            fleet.submit(req(f"a{i}", model="m0"))
+        assert batcher.step() == []             # died mid-batch
+        assert pool.deaths == 1
+        assert fleet.state_of("a0") == QUEUED
+        got = batcher.step()                    # survivor re-executes
+        assert sorted(r.request_id for r in got) == ["a0", "a1", "a2"]
+        assert all(r.requeues == 1 for r in got)
+        assert all(fleet.state_of(r.request_id) == DONE for r in got)
+
+    def test_no_refresher_serves_weightless(self):
+        fleet, pool, batcher, clk = make_fleet(
+            models=(("m0", 1.0, "standard"),))
+        fleet.submit(req("r1"))
+        (resp,) = batcher.step()
+        assert resp.weights_fp is None and resp.model_id == "m0"
+
+
+class TestScaleSignalHysteresis:
+    """ISSUE 20 satellite 2: the flapping fix lives at the signal
+    source — a direction reversal inside HOROVOD_SERVE_SCALE_HOLD_S is
+    suppressed, pinned on a fake clock."""
+
+    def flappy_plane(self, hold=5.0):
+        clk = Clock()
+        fleet = MultiTenantQueue(clock=clk)
+        fleet.add_model("m0", depth=64)
+        pool = ReplicaPool(fleet, clock=clk, scale_up_depth=4,
+                           scale_down_depth=1, scale_hold_s=hold)
+        for i in range(2):
+            pool.add_replica(Replica(f"r{i}", fleet_executor,
+                                     host=f"h{i}", clock=clk))
+        return fleet, pool, clk
+
+    def test_reversal_inside_the_hold_window_is_suppressed(self):
+        fleet, pool, clk = self.flappy_plane(hold=5.0)
+        for i in range(4):
+            fleet.submit(req(f"r{i}"))
+        assert pool.scale_signal() == 1
+        fleet.take_model(4)                     # queue drains instantly
+        assert pool.scale_signal() == 0         # reversal: suppressed
+        clk.t += 6.0                            # past the hold window
+        assert pool.scale_signal() == -1        # now it may reverse
+
+    def test_same_direction_repeats_are_not_suppressed(self):
+        fleet, pool, clk = self.flappy_plane(hold=5.0)
+        for i in range(4):
+            fleet.submit(req(f"r{i}"))
+        assert pool.scale_signal() == 1
+        assert pool.scale_signal() == 1         # no reversal, no hold
+
+    def test_zero_hold_restores_the_raw_signal(self):
+        fleet, pool, clk = self.flappy_plane(hold=0.0)
+        for i in range(4):
+            fleet.submit(req(f"r{i}"))
+        assert pool.scale_signal() == 1
+        fleet.take_model(4)
+        assert pool.scale_signal() == -1
+
+
+class TestAutoscaleController:
+    def plane(self, clk=None, **pool_kw):
+        clk = clk or Clock()
+        fleet = MultiTenantQueue(clock=clk)
+        fleet.add_model("m0", depth=64)
+        pool_kw.setdefault("scale_up_depth", 4)
+        pool_kw.setdefault("scale_down_depth", 1)
+        pool_kw.setdefault("scale_hold_s", 0.0)
+        pool = ReplicaPool(fleet, clock=clk, drain_timeout_s=10.0,
+                           **pool_kw)
+        for i in range(2):
+            pool.add_replica(Replica(f"r{i}", fleet_executor,
+                                     host=f"h{i}", clock=clk))
+        names = [0]
+
+        def acquire():
+            names[0] += 1
+            return Replica(f"s{names[0]}", fleet_executor,
+                           host=f"hs{names[0]}", clock=clk)
+
+        return fleet, pool, acquire, clk
+
+    def test_deep_queue_scales_up(self):
+        fleet, pool, acquire, clk = self.plane()
+        ctl = AutoscaleController(pool, acquire, cooldown_s=1.0,
+                                  max_replicas=4, clock=clk)
+        for i in range(5):
+            fleet.submit(req(f"r{i}"))
+        assert ctl.poll() == 1
+        assert pool.serving_count() == 3 and ctl.scale_ups == 1
+
+    def test_cooldown_holds_signal_driven_actions(self):
+        fleet, pool, acquire, clk = self.plane()
+        ctl = AutoscaleController(pool, acquire, cooldown_s=100.0,
+                                  max_replicas=8, clock=clk)
+        for i in range(5):
+            fleet.submit(req(f"r{i}"))
+        assert ctl.poll() == 1
+        assert ctl.poll() == 0                  # cooling: held
+        clk.t += 101.0
+        assert ctl.poll() == 1                  # cooled: acts again
+
+    def test_death_repair_bypasses_the_cooldown(self):
+        """A killed replica feeds the loop twice: its lease requeues
+        exactly once (pool.mark_dead) AND the deficit repairs through
+        the cooldown — restoring wanted capacity is not oscillation."""
+        fleet, pool, acquire, clk = self.plane()
+        ctl = AutoscaleController(pool, acquire, cooldown_s=1000.0,
+                                  max_replicas=8, clock=clk)
+        for i in range(5):
+            fleet.submit(req(f"r{i}"))
+        assert ctl.poll() == 1                  # target 3, acts
+        pool.mark_dead(pool.pick(), reason="chaos")
+        assert pool.serving_count() == 2
+        assert ctl.poll() == 1                  # repaired mid-cooldown
+        assert pool.serving_count() == 3
+        assert ctl.scale_ups == 2
+
+    def test_idle_pool_scales_down_with_a_graceful_drain(self):
+        fleet, pool, acquire, clk = self.plane()
+        ctl = AutoscaleController(pool, acquire, cooldown_s=1.0,
+                                  min_replicas=1, clock=clk)
+        assert ctl.poll() == -1
+        assert pool.serving_count() == 1 and ctl.scale_downs == 1
+        # the release took the planned-departure path, not a kill
+        assert pool.deaths == 0
+
+    def test_max_replicas_clamps_the_target(self):
+        fleet, pool, acquire, clk = self.plane()
+        ctl = AutoscaleController(pool, acquire, cooldown_s=0.0,
+                                  max_replicas=2, clock=clk)
+        for i in range(30):
+            fleet.submit(req(f"r{i}"))
+        for _ in range(5):
+            clk.t += 1.0
+            ctl.poll()
+        assert pool.serving_count() == 2 and ctl.scale_ups == 0
+
+    def test_p99_breach_scales_up_without_a_depth_signal(self):
+        fleet, pool, acquire, clk = self.plane()
+        ctl = AutoscaleController(pool, acquire, cooldown_s=1.0,
+                                  p99_target_s=0.01, max_replicas=4,
+                                  clock=clk)
+        for _ in range(10):
+            ctl.note_latency(0.05)
+        assert len(fleet) == 0                  # queue is quiet
+        assert ctl.poll() == 1                  # the tail is not
+        assert ctl.p99_ewma == pytest.approx(0.05)
+
+    def test_oscillation_free_on_a_flapping_depth_trace(self):
+        """The ISSUE 20 acceptance shape: depth flaps across both
+        thresholds every tick for 10 ticks; double hysteresis (signal
+        hold + actuation cooldown) admits exactly the first scale-up
+        and nothing else — no up/down churn."""
+        clk = Clock()
+        fleet, pool, acquire, _ = self.plane(
+            clk=clk, scale_hold_s=10.0)
+        ctl = AutoscaleController(pool, acquire, cooldown_s=10.0,
+                                  max_replicas=8, clock=clk)
+        for tick in range(10):
+            if tick % 2 == 0:
+                for i in range(5):              # flap deep
+                    fleet.submit(req(f"t{tick}-r{i}"))
+            else:
+                fleet.take_model(64)            # flap empty
+            ctl.poll()
+            clk.t += 1.0
+        assert ctl.scale_ups == 1 and ctl.scale_downs == 0
+
+    def test_capacity_change_feeds_the_degrade_resolver(self):
+        """The PR 14 wiring: on_capacity_change hands the serving
+        count to the DegradedPlanResolver, so serving capacity loss
+        re-resolves the plan like a training world-change."""
+        from horovod_tpu.elastic.degrade import DegradedPlanResolver
+
+        resolver = DegradedPlanResolver("dp=4", 4)
+        decisions = []
+        fleet, pool, acquire, clk = self.plane()
+        for i in range(2):
+            pool.add_replica(Replica(f"x{i}", fleet_executor,
+                                     host=f"hx{i}", clock=clk))
+        ctl = AutoscaleController(
+            pool, acquire, cooldown_s=1000.0, max_replicas=8,
+            on_capacity_change=lambda n:
+            decisions.append(resolver.resolve(n)), clock=clk)
+        # depth between the thresholds: no signal, no action
+        fleet.submit(req("w1"))
+        fleet.submit(req("w2"))
+        ctl.poll()                              # quiet: no callback
+        assert decisions == []
+        pool.mark_dead(pool.replicas()[0], reason="chaos")
+        ctl.poll()                              # death repair + resolve
+        assert len(decisions) == 1
+        assert decisions[0].plan is not None
+
+
+class TestFleetSmoke:
+    def test_fleet_smoke_is_green_and_deterministic(self):
+        from horovod_tpu.serve.fleet_smoke import run_smoke
+
+        assert run_smoke() == []
